@@ -1,0 +1,91 @@
+"""Headline benchmark: VGG-11/CIFAR-10 training throughput (images/sec).
+
+Runs the fused jitted DP train step (sync=allreduce over all local devices)
+at the reference's global batch size 256 and prints ONE JSON line.
+
+``vs_baseline`` compares against the north-star denominator — the reference's
+"4-node Gloo images/sec" (BASELINE.json:5).  The reference publishes no
+numbers, so the denominator is re-measured on this machine:
+``benchmarks/torch_reference_bench.py`` (torch CPU, 4 threads, batch 256)
+times the identical workload, and 4-node Gloo is bounded above by 4x that
+single-process number (perfect scaling, zero comm cost — a *generous*
+baseline).  See BASELINE.md "Measured values".
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Measured by benchmarks/torch_reference_bench.py on this machine (1-core
+# CPU host; reference config: batch 256, 4 torch threads).  Recorded in
+# BASELINE.md.  4-node Gloo upper bound = 4 * single-process.
+TORCH_CPU_IMAGES_PER_SEC = 66.17
+BASELINE_4NODE_GLOO_IPS = 4 * TORCH_CPU_IMAGES_PER_SEC
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudp.mesh import make_mesh
+    from tpudp.models.vgg import VGG11
+    from tpudp.train import init_state, make_optimizer, make_train_step
+
+    batch = int(os.environ.get("BENCH_BATCH", 256))
+    steps = int(os.environ.get("BENCH_STEPS", 50))
+    warmup = int(os.environ.get("BENCH_WARMUP", 5))
+    dtype_name = os.environ.get("BENCH_DTYPE", "bfloat16")
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+
+    mesh = make_mesh()
+    n_dev = mesh.size
+    model = VGG11(dtype=dtype)
+    tx = make_optimizer()
+    state = init_state(model, tx)
+    step = make_train_step(model, tx, mesh, sync="allreduce", donate=False)
+
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        jnp.asarray(rng.normal(size=(batch, 32, 32, 3)), jnp.float32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data")),
+    )
+    labels = jax.device_put(
+        jnp.asarray(rng.integers(0, 10, size=batch), jnp.int32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data")),
+    )
+
+    for _ in range(warmup):
+        state, loss = step(state, images, labels)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, images, labels)
+    # block on the WHOLE state: under the axon relay, blocking on the scalar
+    # loss alone returns before the step's compute has finished
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    ips = steps * batch / dt
+    ips_per_chip = ips / n_dev
+    print(json.dumps({
+        "metric": "vgg11_cifar10_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / BASELINE_4NODE_GLOO_IPS, 2),
+        "images_per_sec_total": round(ips, 1),
+        "devices": n_dev,
+        "global_batch": batch,
+        "dtype": dtype_name,
+        "sec_per_step": round(dt / steps, 5),
+        "baseline_4node_gloo_images_per_sec": BASELINE_4NODE_GLOO_IPS,
+        "final_loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

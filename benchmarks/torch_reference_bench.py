@@ -1,0 +1,79 @@
+"""Measure the reference workload's throughput on this machine's CPU.
+
+The reference publishes no numbers (BASELINE.md), so the comparison point is
+re-measured locally: a torch VGG-11(BN) CIFAR-geometry train step (batch 256,
+``torch.set_num_threads(4)``, SGD lr=0.1/momentum 0.9/wd 1e-4 — the exact
+config of ``src/Part 1/main.py:10-13,114-115``) on CPU.  The model is built
+from tpudp's own config table, not the reference's code.
+
+Usage: python benchmarks/torch_reference_bench.py [--steps 5] [--batch 256]
+Prints one JSON line: {"torch_cpu_images_per_sec": N, ...}
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import torch
+import torch.nn as nn
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_vgg11(num_classes: int = 10) -> nn.Module:
+    from tpudp.models.vgg import CONFIGS
+
+    layers, in_ch = [], 3
+    for v in CONFIGS["VGG11"]:
+        if v == "M":
+            layers.append(nn.MaxPool2d(2, 2))
+        else:
+            layers += [nn.Conv2d(in_ch, v, 3, padding=1), nn.BatchNorm2d(v),
+                       nn.ReLU(inplace=True)]
+            in_ch = v
+    return nn.Sequential(*layers, nn.Flatten(), nn.Linear(512, num_classes))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--threads", type=int, default=4)
+    args = p.parse_args()
+
+    torch.set_num_threads(args.threads)
+    torch.manual_seed(0)
+    model = build_vgg11()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9,
+                          weight_decay=1e-4)
+    criterion = nn.CrossEntropyLoss()
+    data = torch.randn(args.batch, 3, 32, 32)
+    target = torch.randint(0, 10, (args.batch,))
+
+    def step():
+        opt.zero_grad()
+        loss = criterion(model(data), target)
+        loss.backward()
+        opt.step()
+
+    for _ in range(args.warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        step()
+    dt = time.perf_counter() - t0
+    ips = args.steps * args.batch / dt
+    print(json.dumps({
+        "torch_cpu_images_per_sec": round(ips, 2),
+        "sec_per_step": round(dt / args.steps, 3),
+        "batch": args.batch,
+        "threads": args.threads,
+        "nproc": __import__("os").cpu_count(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Part 1 — single-device baseline trainer (reference: src/Part 1/main.py).
+
+No gradient synchronization; one jitted train step on one device.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from tpudp.cli import run_part
+
+if __name__ == "__main__":
+    run_part("none", "Part 1: single-device VGG-11/CIFAR-10 baseline",
+             single_device=True)

@@ -1,0 +1,14 @@
+"""Part 2a — coordinator-style gradient sync (reference: src/Part 2a/main.py:117-127).
+
+Gather→mean→broadcast semantics expressed SPMD: all_gather + local mean on
+every device (no rank-0 bottleneck).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from tpudp.cli import run_part
+
+if __name__ == "__main__":
+    run_part("coordinator", "Part 2a: DP with coordinator-style grad sync")

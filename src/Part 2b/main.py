@@ -1,0 +1,17 @@
+"""Part 2b — collective all-reduce gradient sync (reference: src/Part 2b/main.py:116-119).
+
+lax.psum over the mesh, divided by world size. Pass --ring to use the
+hand-rolled lax.ppermute ring all-reduce instead (north-star config).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from tpudp.cli import run_part
+
+if __name__ == "__main__":
+    ring = "--ring" in sys.argv
+    argv = [a for a in sys.argv[1:] if a != "--ring"]
+    run_part("ring" if ring else "allreduce",
+             "Part 2b: DP with all-reduce grad sync", argv=argv)

@@ -1,0 +1,8 @@
+"""Model re-export for reference-layout parity (reference keeps a byte-identical
+model.py in each Part; ours lives once in tpudp.models.vgg)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from tpudp.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401

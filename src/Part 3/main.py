@@ -1,0 +1,16 @@
+"""Part 3 — automatic, compiler-scheduled gradient sync (reference: src/Part 3/main.py:61).
+
+The DDP rung: the whole train step is one XLA program compiled via GSPMD
+(jit + sharding annotations, no explicit collectives) so the compiler
+inserts and overlaps the gradient all-reduce with the backward pass.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from tpudp.cli import run_part
+
+if __name__ == "__main__":
+    run_part("auto", "Part 3: DP with automatic (GSPMD) grad sync",
+             spmd_mode="gspmd")

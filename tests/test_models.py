@@ -1,0 +1,78 @@
+"""VGG family shape/param tests (SURVEY.md §7 build order step 1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudp.models.vgg import CONFIGS, VGG11, VGG13, VGG16, VGG19
+
+
+def _param_count(params):
+    return sum(np.prod(p.shape) for p in jax.tree.leaves(params))
+
+
+def _expected_params(cfg, num_classes=10):
+    """Analytic count for conv(3x3,bias)+BN stacks + Linear(512,nc)."""
+    total, in_ch = 0, 3
+    for v in cfg:
+        if v == "M":
+            continue
+        total += 3 * 3 * in_ch * v + v  # conv w + b
+        total += 2 * v  # BN scale + bias
+        in_ch = v
+    total += 512 * num_classes + num_classes
+    return total
+
+
+@pytest.mark.parametrize("factory,name", [
+    (VGG11, "VGG11"), (VGG13, "VGG13"), (VGG16, "VGG16"), (VGG19, "VGG19"),
+])
+def test_shapes_and_params(factory, name):
+    model = factory()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)),
+                           train=False)
+    logits = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert logits.shape == (2, 10)
+    assert _param_count(variables["params"]) == _expected_params(CONFIGS[name])
+
+
+def test_batch_stats_update():
+    model = VGG11()
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(variables["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_param_count_matches_torch_vgg11():
+    """Cross-check against torch's module arithmetic for the same topology
+    (reference model: src/Part 1/model.py:30-46)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    layers, in_ch = [], 3
+    for v in CONFIGS["VGG11"]:
+        if v == "M":
+            layers.append(nn.MaxPool2d(2, 2))
+        else:
+            layers += [nn.Conv2d(in_ch, v, 3, padding=1), nn.BatchNorm2d(v),
+                       nn.ReLU(True)]
+            in_ch = v
+    torch_model = nn.Sequential(*layers, nn.Flatten(), nn.Linear(512, 10))
+    torch_count = sum(p.numel() for p in torch_model.parameters())
+
+    model = VGG11()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    assert _param_count(variables["params"]) == torch_count
+
+
+def test_bfloat16_compute():
+    model = VGG11(dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.dtype == jnp.float32  # logits cast back for the loss

@@ -1,0 +1,107 @@
+"""Sync-strategy math tests (SURVEY.md §4 implications): every strategy must
+produce the mean gradient on every device; ring must match psum to tolerance."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudp.mesh import DATA_AXIS
+from tpudp.parallel.ring import ring_all_reduce, ring_all_reduce_mean
+from tpudp.parallel.sync import SYNC_STRATEGIES
+
+
+def _run_sync(mesh, name, tree):
+    fn = SYNC_STRATEGIES[name]
+    sharded = jax.shard_map(
+        partial(fn, axis_name=DATA_AXIS),
+        mesh=mesh,
+        in_specs=P(DATA_AXIS),
+        out_specs=P(DATA_AXIS) if name == "none" else P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)(tree)
+
+
+@pytest.mark.parametrize("name", ["coordinator", "allreduce", "ring", "auto"])
+def test_strategies_produce_mean(mesh8, name):
+    n = mesh8.size
+    rng = np.random.default_rng(0)
+    # A pytree of per-device gradients with awkward (non-divisible) sizes.
+    tree = {
+        "w": rng.normal(size=(n, 7, 13)).astype(np.float32),
+        "b": rng.normal(size=(n, 5)).astype(np.float32),
+    }
+    expected = jax.tree.map(lambda x: x.mean(axis=0), tree)
+    # shard along the leading axis -> each device holds (1, ...) == its grad
+    sharded_in = jax.device_put(tree, NamedSharding(mesh8, P(DATA_AXIS)))
+    out = _run_sync(mesh8, name, sharded_in)
+    # out is replicated with shape (1, ...) per spec P() after mean over axis;
+    # strategies mean over the mapped axis, leaving the local (1,...) block.
+    for k in tree:
+        np.testing.assert_allclose(
+            np.asarray(out[k]).reshape(expected[k].shape), expected[k],
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_ring_equals_psum(mesh8):
+    n = mesh8.size
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(n, 1031)).astype(np.float32)  # prime size: pad path
+
+    def body(xs):
+        return ring_all_reduce(xs, DATA_AXIS), jax.lax.psum(xs, DATA_AXIS)
+
+    ring_out, psum_out = jax.jit(
+        jax.shard_map(body, mesh=mesh8, in_specs=P(DATA_AXIS),
+                      out_specs=P(DATA_AXIS), check_vma=False)
+    )(x)
+    np.testing.assert_allclose(np.asarray(ring_out), np.asarray(psum_out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_mean_pytree(mesh8):
+    n = mesh8.size
+    rng = np.random.default_rng(2)
+    tree = {
+        "conv": {"kernel": rng.normal(size=(n, 3, 3, 4, 8)).astype(np.float32)},
+        "dense": {"bias": rng.normal(size=(n, 11)).astype(np.float32)},
+    }
+    expected = jax.tree.map(lambda x: x.mean(axis=0), tree)
+
+    def body(t):
+        local = jax.tree.map(lambda x: x[0], t)  # strip device dim
+        return ring_all_reduce_mean(local, DATA_AXIS)
+
+    out = jax.jit(
+        jax.shard_map(body, mesh=mesh8, in_specs=P(DATA_AXIS), out_specs=P(),
+                      check_vma=False)
+    )(tree)
+    for path_out, path_exp in zip(jax.tree.leaves(out), jax.tree.leaves(expected)):
+        np.testing.assert_allclose(np.asarray(path_out), path_exp,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_ring_single_device():
+    """n=1 ring is the identity (Part 1 degenerate case)."""
+    from tpudp.mesh import make_mesh
+
+    mesh1 = make_mesh(1)
+    x = np.arange(10, dtype=np.float32).reshape(1, 10)
+    out = jax.jit(
+        jax.shard_map(lambda v: ring_all_reduce(v, DATA_AXIS), mesh=mesh1,
+                      in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+                      check_vma=False)
+    )(x)
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_unknown_strategy_raises():
+    from tpudp.parallel.sync import get_sync
+
+    with pytest.raises(ValueError):
+        get_sync("nccl")

@@ -1,0 +1,115 @@
+"""CLI shared by the Part entrypoints.
+
+Maps the reference's flags (``src/Part 2a/main.py:156-175``: ``--master``
+required IP, ``--num-nodes``, ``--rank``, ``--epochs``; hardcoded port 6585
+and global batch 256 at ``:172-173``) onto the SPMD world:
+
+  * ``--master``/``--rank``/``--num-nodes`` become the
+    ``jax.distributed.initialize`` coordinator/process_id/num_processes —
+    OPTIONAL on a single host, where one process already owns all devices
+    (the reference requires one manually-launched process per node).
+  * world size for gradient math is the device-mesh size, not a process
+    count; ``--num-devices`` restricts the mesh for ladder comparisons.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from tpudp.data import DataLoader, ShardedSampler, load_cifar10
+from tpudp.mesh import initialize_distributed, make_mesh
+from tpudp.train import Trainer
+
+GLOBAL_BATCH_SIZE = 256  # reference constant, src/Part 2a/main.py:173
+PORT = 6585  # reference constant, src/Part 2a/main.py:172
+
+
+def build_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator IP for multi-host (reference --master)")
+    p.add_argument("--num-nodes", type=int, default=None,
+                   help="number of host processes (reference --num-nodes)")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this host's process id (reference --rank)")
+    p.add_argument("--epochs", type=int, default=1,
+                   help="epochs to train (reference default 1)")
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="restrict the mesh to N devices (default: all)")
+    p.add_argument("--batch-size", type=int, default=GLOBAL_BATCH_SIZE,
+                   help="GLOBAL batch size (split across devices)")
+    p.add_argument("--data-root", type=str, default="./data")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timing-mode", choices=["fused", "split"], default="fused")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--platform", type=str, default=None,
+                   help="force a JAX platform (e.g. 'cpu' with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                        "to simulate an N-chip mesh on one host)")
+    p.add_argument("--synthetic-train-size", type=int, default=50_000,
+                   help="synthetic-fallback train set size (smoke runs)")
+    p.add_argument("--synthetic-test-size", type=int, default=10_000)
+    return p
+
+
+def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
+             single_device: bool = False, argv=None) -> Trainer:
+    """Shared Part-N driver: parse flags, build mesh/data/model, fit."""
+    import jax.numpy as jnp
+
+    from tpudp.models import VGG11
+
+    args = build_parser(description).parse_args(argv)
+    if args.platform:  # must precede the first device query
+        jax.config.update("jax_platforms", args.platform)
+    initialize_distributed(args.master, args.num_nodes, args.rank, PORT)
+
+    mesh = None if single_device else make_mesh(args.num_devices)
+    world = 1 if mesh is None else mesh.size
+    num_hosts = jax.process_count()
+    host_id = jax.process_index()
+
+    if args.batch_size % world or args.batch_size % num_hosts:
+        raise SystemExit(
+            f"error: --batch-size {args.batch_size} must be divisible by the "
+            f"device count ({world}) and host count ({num_hosts}) — "
+            f"per-device batches need equal static shapes"
+        )
+
+    train_set, test_set, synthetic = load_cifar10(
+        args.data_root,
+        synthetic_train_size=args.synthetic_train_size,
+        synthetic_test_size=args.synthetic_test_size,
+    )
+    if synthetic:
+        print("[tpudp] CIFAR-10 not found on disk; using synthetic stand-in data")
+
+    # Per-host batch: the reference computes per-rank batch = global/world
+    # (src/Part 2a/main.py:22); here host-level sharding divides by process
+    # count and the mesh sharding divides across local devices.
+    host_batch = args.batch_size // num_hosts
+    train_loader = DataLoader(
+        train_set, host_batch,
+        sampler=ShardedSampler(len(train_set.images), num_hosts, host_id,
+                               shuffle=True, seed=args.seed),
+        train=True, seed=args.seed,
+    )
+    test_loader = DataLoader(
+        test_set, host_batch,
+        sampler=ShardedSampler(len(test_set.images), num_hosts, host_id,
+                               shuffle=False),
+        train=False,
+    )
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = VGG11(dtype=dtype)
+    trainer = Trainer(model, mesh, sync, seed=args.seed,
+                      spmd_mode=spmd_mode, timing_mode=args.timing_mode)
+    print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
+          f"global_batch={args.batch_size} dtype={args.dtype}")
+    print(f"[tpudp] train samples={len(train_set.images)} "
+          f"test samples={len(test_set.images)}")
+    trainer.fit(train_loader, test_loader, epochs=args.epochs)
+    return trainer

@@ -1,0 +1,109 @@
+"""Batched host loader with reference-exact augmentation, vectorized in numpy.
+
+Replaces the reference's torchvision transform stack + DataLoader
+(``src/Part 2a/main.py:24-44``):
+
+  train: RandomCrop(32, padding=4) -> RandomHorizontalFlip -> ToTensor ->
+         Normalize(CIFAR10_MEAN, CIFAR10_STD)          (src/Part 2a/main.py:26-31)
+  test:  ToTensor -> Normalize                          (src/Part 2a/main.py:33-35)
+
+Differences by design (TPU-first):
+  * NHWC float32 output (XLA:TPU conv layout) instead of NCHW tensors.
+  * Whole-batch vectorized numpy ops instead of per-sample Python transforms
+    and worker processes — the 32x32 pipeline is far from being the
+    bottleneck at TPU step times, so no separate loader processes are needed
+    (a native C++ loader is still available for the large-image path).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from tpudp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD, Dataset
+from tpudp.data.sampler import ShardedSampler
+
+
+def normalize_batch(images_u8: np.ndarray) -> np.ndarray:
+    """uint8 (B,32,32,3) -> normalized float32, the ToTensor+Normalize pair."""
+    x = images_u8.astype(np.float32) / 255.0
+    return (x - CIFAR10_MEAN) / CIFAR10_STD
+
+
+def augment_batch(images_u8: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    """RandomCrop(32, padding=4, zero fill) + RandomHorizontalFlip, batched."""
+    b = images_u8.shape[0]
+    padded = np.pad(images_u8, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    offs = rng.integers(0, 9, size=(b, 2))
+    rows = offs[:, 0, None] + np.arange(32)  # (B, 32)
+    cols = offs[:, 1, None] + np.arange(32)
+    out = padded[np.arange(b)[:, None, None], rows[:, :, None], cols[:, None, :]]
+    flip = rng.random(b) < 0.5
+    out[flip] = out[flip, :, ::-1]
+    return out
+
+
+class DataLoader:
+    """Iterates normalized (images, labels) numpy batches over a shard.
+
+    ``batch_size`` here is the *host-local* batch (the reference computes
+    per-rank batch = global / world_size at ``src/Part 2a/main.py:22``).
+    ``drop_last=True`` mirrors the torch DataLoader default used with fixed
+    batch shapes — jit-compiled steps want static shapes, so ragged final
+    batches are dropped in training and padded (with a weight mask) in eval.
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        batch_size: int,
+        *,
+        sampler: ShardedSampler | None = None,
+        train: bool = True,
+        seed: int = 0,
+        drop_last: bool | None = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler or ShardedSampler(
+            len(dataset.images), shuffle=train, seed=seed
+        )
+        self.train = train
+        self.seed = seed
+        self.drop_last = train if drop_last is None else drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Yields (images f32 NHWC, labels i32, weights f32).
+
+        ``weights`` is 1 for real samples, 0 for padding in a ragged final
+        eval batch — metrics are weight-summed so padding never counts.
+        """
+        idx, valid = self.sampler.indices_and_mask(self.epoch)
+        aug_rng = np.random.default_rng((self.seed, self.epoch, self.sampler.shard_index))
+        n_batches = len(self)
+        for b in range(n_batches):
+            sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
+            images = self.dataset.images[sel]
+            labels = self.dataset.labels[sel]
+            if self.train:  # DistributedSampler semantics: duplicates count
+                weights = np.ones(len(sel), dtype=np.float32)
+            else:  # eval: wrap-padded duplicates must not be double-counted
+                weights = valid[b * self.batch_size : (b + 1) * self.batch_size
+                                ].astype(np.float32)
+            if len(sel) < self.batch_size:  # pad ragged eval batch
+                pad = self.batch_size - len(sel)
+                images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+                labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+                weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+            if self.train:
+                images = augment_batch(images, aug_rng)
+            yield normalize_batch(images), labels.astype(np.int32), weights

@@ -1,0 +1,70 @@
+"""Deterministic shard-aware sampling — the reference's DistributedSampler.
+
+The reference shards the train set with
+``DistributedSampler(dataset, num_replicas=size, rank=rank)``
+(``src/Part 2a/main.py:38``): shuffle all indices with a seeded generator,
+pad to a multiple of world size, then take the strided slice
+``indices[rank::num_replicas]``.  This module reproduces those semantics in
+numpy for *host*-level sharding (each host loads only its slice; device-level
+splitting happens via the batch sharding in ``tpudp.mesh``).
+
+Quirk fixed (SURVEY.md §7 quirks catalog): the reference never calls
+``set_epoch`` so every epoch reuses the same shuffle
+(``src/Part 2a/main.py:38,64-68``); here the epoch is mixed into the shuffle
+seed by default.  Pass ``reshuffle_each_epoch=False`` for bug-compatible
+behavior.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ShardedSampler:
+    def __init__(
+        self,
+        dataset_size: int,
+        num_shards: int = 1,
+        shard_index: int = 0,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        reshuffle_each_epoch: bool = True,
+    ):
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard_index {shard_index} out of range [0, {num_shards})")
+        self.dataset_size = dataset_size
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.shuffle = shuffle
+        self.seed = seed
+        self.reshuffle_each_epoch = reshuffle_each_epoch
+        # Padded length: every shard sees the same number of samples
+        # (DistributedSampler pads by wrapping around).
+        self.num_samples = -(-dataset_size // num_shards)  # ceil
+        self.total_size = self.num_samples * num_shards
+
+    def indices(self, epoch: int = 0) -> np.ndarray:
+        return self.indices_and_mask(epoch)[0]
+
+    def indices_and_mask(self, epoch: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (indices, valid): ``valid`` is False for the wrap-around
+        padding entries.  Training follows DistributedSampler and treats
+        padded duplicates as real samples; *evaluation* must weight them 0,
+        or samples wrapped onto a second shard get counted twice in the
+        psum-ed metrics."""
+        if self.shuffle:
+            shuffle_seed = self.seed + (epoch if self.reshuffle_each_epoch else 0)
+            order = np.random.default_rng(shuffle_seed).permutation(self.dataset_size)
+        else:
+            order = np.arange(self.dataset_size)
+        valid = np.ones(self.total_size, dtype=bool)
+        if self.total_size > self.dataset_size:  # wrap-around padding
+            pad = self.total_size - self.dataset_size
+            order = np.concatenate([order, order[:pad]])
+            valid[self.dataset_size :] = False
+        sel = slice(self.shard_index, None, self.num_shards)
+        return order[sel], valid[sel]
+
+    def __len__(self) -> int:
+        return self.num_samples

@@ -1,0 +1,67 @@
+"""Device-mesh and multi-host bootstrap.
+
+TPU-native replacement for the reference's process-group layer
+(``src/Part 2a/main.py:148-153``: MASTER_ADDR/MASTER_PORT env vars +
+``dist.init_process_group('gloo', rank, world_size)``).  In the SPMD world
+there is no process group: a single :class:`jax.sharding.Mesh` spans every
+device, collectives ride the ICI/DCN fabric, and multi-host rendezvous is
+``jax.distributed.initialize`` whose coordinator address plays the role of
+the reference's ``--master`` flag.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def initialize_distributed(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    port: int = 6585,
+) -> None:
+    """Multi-host rendezvous — the ``--master``/``--rank`` analogue.
+
+    Maps the reference CLI (``src/Part 2a/main.py:158-165``: ``--master``,
+    ``--num-nodes``, ``--rank``; hardcoded port 6585 at ``:172``) onto
+    ``jax.distributed.initialize``.  On a single host (all arguments None)
+    this is a no-op: one process already sees every local device.
+    """
+    if coordinator is None and num_processes in (None, 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=f"{coordinator}:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(num_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """Build a 1-D data-parallel mesh over (the first ``num_devices``) devices.
+
+    The mesh is the TPU-native "world": its size is the reference's
+    ``world_size`` (``--num-nodes``), and the ``data`` axis is the axis all
+    sync strategies reduce over.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a global batch: split along the leading (batch) axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for replicated state (params, optimizer state)."""
+    return NamedSharding(mesh, P())

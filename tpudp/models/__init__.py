@@ -1,0 +1,4 @@
+"""Model zoo: config-driven VGG family (reference parity) plus beyond-parity
+ResNet and GPT-2 families reusing the same train/sync layers."""
+
+from tpudp.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401

@@ -1,0 +1,88 @@
+"""Config-driven VGG family for 32x32 inputs (CIFAR-10 class geometry).
+
+TPU-native re-design of the reference model (``src/Part 1/model.py:1-50``,
+byte-identical across all four Parts): conv(3x3, pad 1) + BatchNorm + ReLU
+stacks with 'M' max-pool(2,2) markers driven by a per-variant config table
+(``model.py:3-8``), followed by flatten + Linear(512, num_classes)
+(``model.py:39-40,44-45``).  The reference exports only VGG11
+(``model.py:49-50``); we export all four variants.
+
+TPU-first choices (deliberate departures from the torch original):
+  * NHWC layout — XLA:TPU's native conv layout (torch uses NCHW).
+  * Optional ``dtype=jnp.bfloat16`` compute with fp32 BatchNorm statistics
+    and fp32 params — MXU-friendly mixed precision.
+  * BatchNorm ``momentum=0.9`` == torch's ``momentum=0.1`` (flax counts the
+    keep-fraction, torch the update-fraction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Variant table — same shape as the reference's `_cfg` (src/Part 1/model.py:3-8).
+CONFIGS: dict[str, tuple] = {
+    "VGG11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "VGG13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "VGG16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+              512, 512, 512, "M"),
+    "VGG19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512,
+              "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    """VGG-style convnet on NHWC inputs.
+
+    Call with ``train=True`` and ``mutable=['batch_stats']`` during training;
+    ``train=False`` uses running BatchNorm statistics (eval path, reference
+    ``src/Part 2a/main.py:130-145``).
+    """
+
+    cfg: Sequence[Any]
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(
+                    features=int(v),
+                    kernel_size=(3, 3),
+                    padding=1,
+                    use_bias=True,
+                    dtype=self.dtype,
+                )(x)
+                x = nn.BatchNorm(
+                    use_running_average=not train,
+                    momentum=0.9,
+                    epsilon=1e-5,
+                    dtype=jnp.float32,
+                )(x)
+                x = nn.relu(x)
+        # 32x32 input through five 2x2 pools -> 1x1x512; flatten == the
+        # reference's no-op AvgPool2d(1,1) + view (src/Part 1/model.py:40,44).
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def _factory(name: str):
+    def build(num_classes: int = 10, dtype: Any = jnp.float32) -> VGG:
+        return VGG(cfg=CONFIGS[name], num_classes=num_classes, dtype=dtype)
+
+    build.__name__ = name
+    build.__doc__ = f"Build a {name} (reference factory: src/Part 1/model.py:49-50)."
+    return build
+
+
+VGG11 = _factory("VGG11")
+VGG13 = _factory("VGG13")
+VGG16 = _factory("VGG16")
+VGG19 = _factory("VGG19")

@@ -1,0 +1,4 @@
+"""Parallelism layer: gradient-sync strategy ladder and hand-rolled collectives."""
+
+from tpudp.parallel.sync import SYNC_STRATEGIES, get_sync  # noqa: F401
+from tpudp.parallel.ring import ring_all_reduce_mean, ring_all_reduce  # noqa: F401

@@ -1,0 +1,89 @@
+"""The gradient-synchronization strategy ladder — the reference's core.
+
+Each strategy is a function ``(grad_tree, axis_name) -> grad_tree`` applied
+between backward and optimizer step inside a ``shard_map``-ed train step,
+mirroring where the reference calls its sync
+(between ``loss.backward()`` and ``optimizer.step()``,
+``src/Part 2a/main.py:94-96``).  All strategies produce the *mean* gradient
+on every device — the observable contract of every rung of the ladder.
+
+  none        Part 1  — no collective; single-device baseline
+              (src/Part 1/main.py:32-58 has no sync call).
+  coordinator Part 2a — semantics of gather-to-rank-0 → mean → scatter
+              (src/Part 2a/main.py:117-127).  SPMD has no privileged rank, so
+              every device all-gathers and means — numerically identical,
+              same traffic shape (each device's grad crosses the wire once,
+              the mean once), without the rank-0 serialization bottleneck.
+  allreduce   Part 2b — built-in collective: psum then divide by world size
+              (src/Part 2b/main.py:116-119: all_reduce(SUM); grad /= size).
+  ring        north-star extra — hand-rolled ring all-reduce from ppermute
+              (see tpudp.parallel.ring).
+  auto        Part 3  — like DDP (src/Part 3/main.py:61), sync is *implicit*:
+              the strategy is still psum/N, but the step is compiled as one
+              XLA program so the compiler schedules/overlaps the collective
+              with the backward pass — the TPU equivalent of DDP's bucketed
+              overlap, obtained from the compiler rather than hand-written
+              C++ hooks.  Also selectable as a GSPMD path (jit + sharding
+              annotations, no explicit collectives) via Trainer(spmd_mode=
+              'gspmd').
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+from tpudp.parallel.ring import ring_all_reduce_mean
+
+SyncFn = Callable[[object, str], object]
+
+
+def sync_none(grads, axis_name: str):
+    """Part 1: no synchronization."""
+    del axis_name
+    return grads
+
+
+def sync_coordinator(grads, axis_name: str):
+    """Part 2a semantics: every device ends with the mean gradient via
+    all-gather + local mean (rank-0 asymmetry is a Gloo API artifact, not
+    observable behavior — SURVEY.md §7 hard parts)."""
+    def gather_mean(g):
+        return lax.all_gather(g, axis_name).mean(axis=0)
+    return jax.tree.map(gather_mean, grads)
+
+
+def sync_allreduce(grads, axis_name: str):
+    """Part 2b: all-reduce(SUM) then divide by world size."""
+    n = lax.axis_size(axis_name)
+    return jax.tree.map(lambda g: lax.psum(g, axis_name) / n, grads)
+
+
+def sync_ring(grads, axis_name: str):
+    """North-star: hand-rolled ppermute ring all-reduce over one flat buffer."""
+    return ring_all_reduce_mean(grads, axis_name)
+
+
+# 'auto' shares the allreduce math; the difference is scheduling, which XLA
+# owns because the whole train step (fwd+bwd+sync+update) is one jitted
+# program.  Kept as a distinct name so the CLI ladder maps 1:1 to the parts.
+sync_auto = sync_allreduce
+
+SYNC_STRATEGIES: dict[str, SyncFn] = {
+    "none": sync_none,
+    "coordinator": sync_coordinator,
+    "allreduce": sync_allreduce,
+    "ring": sync_ring,
+    "auto": sync_auto,
+}
+
+
+def get_sync(name: str) -> SyncFn:
+    try:
+        return SYNC_STRATEGIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown sync strategy {name!r}; choose from {sorted(SYNC_STRATEGIES)}"
+        ) from None

@@ -1,0 +1,1 @@
+from tpudp.utils.timing import StepTimer  # noqa: F401
