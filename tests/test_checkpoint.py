@@ -1,0 +1,50 @@
+"""Checkpoint/resume roundtrip: restored state continues training with the
+exact same trajectory as the uninterrupted run."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpudp.models.vgg import VGG11
+from tpudp.train import init_state, make_optimizer, make_train_step
+from tpudp.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+
+def test_latest_step_dir_ignores_orbax_tmp(tmp_path):
+    """Interrupted saves leave step_N.orbax-checkpoint-tmp-* dirs; resume
+    must skip them (code-review finding, round 1)."""
+    from tpudp.utils.checkpoint import latest_step_dir
+
+    (tmp_path / "step_1").mkdir()
+    (tmp_path / "step_2").mkdir()
+    (tmp_path / "step_3.orbax-checkpoint-tmp-1234").mkdir()
+    assert latest_step_dir(tmp_path).endswith("step_2")
+    assert latest_step_dir(tmp_path / "missing") is None
+
+
+def test_roundtrip_resume(tmp_path, mesh4):
+    model = VGG11()
+    tx = make_optimizer()
+    state = init_state(model, tx)
+    step = make_train_step(model, tx, mesh4, "allreduce", donate=False)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=8), jnp.int32)
+
+    state, _ = step(state, x, y)
+    ckpt = save_checkpoint(tmp_path / "ckpt", state)
+
+    # Continue the original for 2 more steps.
+    cont = state
+    for _ in range(2):
+        cont, loss_a = step(cont, x, y)
+
+    # Restore and continue from the checkpoint: identical trajectory.
+    restored = restore_checkpoint(ckpt, init_state(model, tx))
+    assert int(restored.step) == 1
+    for _ in range(2):
+        restored, loss_b = step(restored, x, y)
+    assert float(loss_b) == float(loss_a)
+    np.testing.assert_array_equal(
+        np.asarray(cont.params["Dense_0"]["kernel"]),
+        np.asarray(restored.params["Dense_0"]["kernel"]),
+    )

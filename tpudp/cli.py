@@ -44,6 +44,9 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timing-mode", choices=["fused", "split"], default="fused")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="save TrainState each epoch and auto-resume from the "
+                        "latest checkpoint (beyond-reference capability)")
     p.add_argument("--platform", type=str, default=None,
                    help="force a JAX platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
@@ -111,5 +114,26 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
           f"global_batch={args.batch_size} dtype={args.dtype}")
     print(f"[tpudp] train samples={len(train_set.images)} "
           f"test samples={len(test_set.images)}")
-    trainer.fit(train_loader, test_loader, epochs=args.epochs)
+
+    start_epoch = 0
+    epoch_end_fn = None
+    if args.checkpoint_dir:
+        import os
+
+        from tpudp.utils.checkpoint import (latest_step_dir, restore_checkpoint,
+                                            save_checkpoint)
+
+        latest = latest_step_dir(args.checkpoint_dir)
+        if latest:
+            trainer.state = restore_checkpoint(latest, trainer.state)
+            start_epoch = int(latest.rsplit("_", 1)[1])
+            print(f"[tpudp] resumed from {latest} (epoch {start_epoch})")
+
+        def epoch_end_fn(epoch: int) -> None:
+            path = os.path.join(args.checkpoint_dir, f"step_{epoch + 1}")
+            save_checkpoint(path, trainer.state)
+            print(f"[tpudp] saved checkpoint {path}")
+
+    trainer.fit(train_loader, test_loader, epochs=args.epochs,
+                start_epoch=start_epoch, epoch_end_fn=epoch_end_fn)
     return trainer

@@ -2,3 +2,5 @@
 ResNet and GPT-2 families reusing the same train/sync layers."""
 
 from tpudp.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401
+from tpudp.models.resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from tpudp.models.gpt2 import GPT2, GPT2Config, gpt2_small, gpt2_medium  # noqa: F401

@@ -1,0 +1,135 @@
+"""GPT-2 family — beyond-parity model from the north-star benchmark matrix
+("GPT-2-small (124M) LM — transformer grads all-reduced over a v5p pod
+slice", /root/repo/BASELINE.json configs[4]).  The reference has no
+sequence models at all (SURVEY.md §5 long-context entry); this is a
+TPU-first transformer that plugs into the same Trainer/sync ladder:
+``logits = model(tokens)`` with integer-label cross entropy broadcasts over
+the (batch, time) leading axes exactly like the image models' (batch,) axis.
+
+Design notes:
+  * Pre-LN blocks, learned positional embeddings, GELU MLP, tied input/output
+    embedding (GPT-2's weight tying), causal mask via additive bias.
+  * bf16 compute / fp32 params + LayerNorm for the MXU, same policy as
+    models/vgg.py.
+  * Attention is pluggable: ``attn_impl='dense'`` (XLA fused einsums) or
+    ``'ring'`` (sequence-parallel ring attention over a mesh axis — see
+    tpudp/parallel/ring_attention.py) so long-context training shards the
+    sequence dimension across devices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50_257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    attn_impl: str = "dense"  # 'dense' | 'ring'
+    seq_axis: str | None = None  # mesh axis for ring attention
+
+
+def _axis_is_bound(axis_name: str) -> bool:
+    """True when tracing inside shard_map/pmap with this named axis.  Model
+    init happens outside any mapped context — the ring path then falls back
+    to dense so ``model.init`` works without a mesh (param shapes are
+    identical either way)."""
+    from jax import lax
+
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def gpt2_small(**overrides) -> "GPT2":
+    return GPT2(GPT2Config(**overrides))
+
+
+def gpt2_medium(**overrides) -> "GPT2":
+    return GPT2(GPT2Config(num_layers=24, num_heads=16, d_model=1024, **overrides))
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        b, t, d = x.shape
+        h = cfg.num_heads
+        qkv = nn.Dense(3 * d, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, d // h)
+        k = k.reshape(b, t, h, d // h)
+        v = v.reshape(b, t, h, d // h)
+        if (cfg.attn_impl == "ring" and cfg.seq_axis is not None
+                and _axis_is_bound(cfg.seq_axis)):
+            from tpudp.parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
+        else:
+            scale = (d // h) ** -0.5
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+            probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(b, t, d)
+        return nn.Dense(d, dtype=cfg.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        x = x + CausalSelfAttention(cfg, name="attn")(ln("ln_1")(x))
+        h = nn.Dense(cfg.mlp_ratio * cfg.d_model, dtype=cfg.dtype,
+                     name="mlp_fc")(ln("ln_2")(x))
+        h = nn.gelu(h)
+        return x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_proj")(h)
+
+
+class GPT2(nn.Module):
+    """Decoder-only LM: ``(B, T) int tokens -> (B, T, vocab) float32 logits``.
+
+    ``train`` is accepted for Trainer compatibility (no dropout is used, so
+    train/eval paths are identical and no RNG is needed)."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        del train
+        cfg = self.config
+        b, t = tokens.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
+        wpe = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="wpe")
+        positions = jnp.arange(t)
+        if (cfg.attn_impl == "ring" and cfg.seq_axis is not None
+                and _axis_is_bound(cfg.seq_axis)):
+            # Sequence-sharded: this device holds one contiguous block, so
+            # positions are offset by the block start (global positions).
+            from jax import lax
+
+            positions = positions + lax.axis_index(cfg.seq_axis) * t
+        x = wte(tokens) + wpe(positions)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = wte.attend(x.astype(cfg.dtype))  # tied embedding head
+        return logits.astype(jnp.float32)

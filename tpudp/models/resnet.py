@@ -1,0 +1,86 @@
+"""ResNet family (v1.5 bottleneck) — beyond-parity model from the north-star
+benchmark matrix ("ResNet-50 on ImageNet-1k under the same DDP harness",
+/root/repo/BASELINE.json configs[3]).  The reference contains no ResNet; this
+is a TPU-first design sharing the VGG models' conventions (NHWC, optional
+bf16 compute with fp32 BatchNorm/params) so the same Trainer/sync ladder
+drives it unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 (stride here: the v1.5 variant) -> 1x1, residual add."""
+
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                     epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = bn()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=self.strides, padding=1)(y)
+        y = bn()(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        # zero-init the last BN scale: residual branch starts as identity
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), strides=self.strides,
+                            name="proj_conv")(residual)
+            residual = bn(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Bottleneck ResNet on NHWC inputs (224x224 ImageNet geometry)."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=3,
+                    use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block in range(num_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(
+                    features=self.width * (2 ** stage),
+                    strides=strides,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = x.mean(axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def ResNet101(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes, dtype=dtype)
+
+
+def ResNet152(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), num_classes=num_classes, dtype=dtype)

@@ -55,9 +55,10 @@ def sync_coordinator(grads, axis_name: str):
     return jax.tree.map(gather_mean, grads)
 
 
-def sync_allreduce(grads, axis_name: str):
-    """Part 2b: all-reduce(SUM) then divide by world size."""
-    n = lax.axis_size(axis_name)
+def sync_allreduce(grads, axis_name):
+    """Part 2b: all-reduce(SUM) then divide by world size.  ``axis_name``
+    may be a tuple of mesh axes (DP x SP meshes reduce over both)."""
+    n = lax.psum(1, axis_name)  # product of axis sizes, handles tuples
     return jax.tree.map(lambda g: lax.psum(g, axis_name) / n, grads)
 
 

@@ -85,10 +85,15 @@ def init_state(
     tx: optax.GradientTransformation,
     input_shape: tuple = (1, 32, 32, 3),
     seed: int = 0,
+    input_dtype=None,
 ) -> TrainState:
     """Initialize params/batch_stats/optimizer state (reference seeds both
-    RNGs with 0: ``src/Part 2a/main.py:20-21``)."""
-    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros(input_shape), train=False)
+    RNGs with 0: ``src/Part 2a/main.py:20-21``).  ``input_dtype`` defaults to
+    float32 for image-shaped (>2-D) inputs and int32 for 2-D token inputs."""
+    if input_dtype is None:
+        input_dtype = jnp.float32 if len(input_shape) > 2 else jnp.int32
+    variables = model.init(jax.random.PRNGKey(seed),
+                           jnp.zeros(input_shape, input_dtype), train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     return TrainState(
@@ -199,6 +204,47 @@ def make_train_step(
         check_vma=False,  # ring's ppermute output is replicated by construction, not by type
     )
     return jax.jit(sharded, donate_argnums=donate_args)
+
+
+def make_seq_parallel_train_step(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = "seq",
+    donate: bool = True,
+) -> Callable:
+    """DP x SP train step over a 2-D ``(data, seq)`` mesh for sequence models.
+
+    Long-context capability (no reference analogue — the reference is
+    CNN-only, SURVEY.md §5): the token batch is sharded along BOTH the batch
+    axis (data parallelism) and the sequence axis (sequence parallelism);
+    attention inside the model runs ring attention over ``seq_axis``
+    (model must be built with ``attn_impl='ring', seq_axis=seq_axis``).
+    Gradients are mean-reduced over the whole mesh — ``psum`` over both axes
+    — which XLA lowers to a single fused all-reduce over ICI.
+
+    The per-device loss is the mean over local tokens; with equal block
+    sizes the ``pmean`` over both axes equals the global-batch mean, so the
+    trajectory matches a single-device run exactly (tested).
+    """
+    from tpudp.parallel.sync import sync_allreduce
+
+    axes = (data_axis, seq_axis)
+
+    def body(state, tokens, targets):
+        return _loss_and_updates(model, tx, state, tokens, targets,
+                                 sync_allreduce, axes)
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(model: nn.Module, mesh: Mesh | None) -> Callable:
@@ -396,9 +442,12 @@ class Trainer:
         )
         return avg_loss, accuracy
 
-    def fit(self, train_loader, test_loader=None, epochs: int = 1) -> None:
-        """The reference's epoch loop (``src/Part 2a/main.py:64-68``)."""
-        for epoch in range(epochs):
+    def fit(self, train_loader, test_loader=None, epochs: int = 1,
+            *, start_epoch: int = 0, epoch_end_fn=None) -> None:
+        """The reference's epoch loop (``src/Part 2a/main.py:64-68``).
+        ``start_epoch`` supports checkpoint resume; ``epoch_end_fn(epoch)``
+        runs after each epoch's eval (checkpoint hook)."""
+        for epoch in range(start_epoch, epochs):
             start = time.perf_counter()
             self.train_epoch(train_loader, epoch)
             jax.block_until_ready(self.state.params)
@@ -409,3 +458,5 @@ class Trainer:
             )
             if test_loader is not None:
                 self.evaluate(test_loader)
+            if epoch_end_fn is not None:
+                epoch_end_fn(epoch)

@@ -1,0 +1,77 @@
+"""Checkpoint / resume via orbax.
+
+The reference has NO checkpointing (SURVEY.md §5: "training state lives and
+dies with the process") — this is a beyond-reference capability: save and
+restore the full :class:`tpudp.train.TrainState` (params, BatchNorm stats,
+optimizer state, step counter) so training resumes exactly where it stopped.
+Sharded arrays round-trip with their shardings on multi-device meshes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import jax
+
+try:
+    import orbax.checkpoint as ocp
+
+    HAVE_ORBAX = True
+except ImportError:  # pragma: no cover - orbax is baked into this image
+    HAVE_ORBAX = False
+
+
+def _checkpointer():
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str | os.PathLike, state: Any, *, force: bool = True) -> str:
+    """Write ``state`` (any pytree, e.g. TrainState) to ``path``."""
+    if not HAVE_ORBAX:
+        raise RuntimeError("orbax-checkpoint is not installed")
+    path = os.path.abspath(os.fspath(path))
+    _checkpointer().save(path, state, force=force)
+    return path
+
+
+def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
+    """Restore a pytree saved by :func:`save_checkpoint`.
+
+    ``target`` is a matching pytree (e.g. a freshly built TrainState) used
+    for structure, dtypes, and shardings; its values are not read.
+    """
+    if not HAVE_ORBAX:
+        raise RuntimeError("orbax-checkpoint is not installed")
+    path = os.path.abspath(os.fspath(path))
+
+    def as_abstract(x):
+        if isinstance(x, jax.Array):
+            # Keep the target's sharding so restore places arrays on the
+            # CURRENT topology instead of whatever the checkpoint recorded
+            # (which is unsafe when resuming on a different mesh).
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return ocp.utils.to_shape_dtype_struct(x)
+
+    abstract = jax.tree.map(as_abstract, target)
+    return _checkpointer().restore(path, item=abstract)
+
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def latest_step_dir(root: str | os.PathLike) -> str | None:
+    """Return the highest-numbered ``step_N`` subdirectory, or None.
+
+    Only exact ``step_<digits>`` names count — orbax leaves
+    ``step_N.orbax-checkpoint-tmp-*`` directories behind after an
+    interrupted save, and those must never be selected (or parsed)."""
+    root = os.fspath(root)
+    if not os.path.isdir(root):
+        return None
+    steps = [m for d in os.listdir(root) if (m := _STEP_DIR.match(d))]
+    if not steps:
+        return None
+    best = max(steps, key=lambda m: int(m.group(1)))
+    return os.path.join(root, best.group(0))
