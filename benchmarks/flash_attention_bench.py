@@ -1,0 +1,52 @@
+"""Microbench: Pallas flash attention vs XLA dense attention (grad step).
+
+Source of the BASELINE.md flash-attention row. Run on the TPU chip:
+
+    python benchmarks/flash_attention_bench.py [t]
+
+Times a full gradient step (fwd+bwd) at GPT-2 head geometry, fetch-fenced
+(see BASELINE.md timing-honesty note: ``block_until_ready`` is not a
+reliable barrier under the axon relay).
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tpudp.ops.flash_attention import flash_attention  # noqa: E402
+
+
+def main(t: int = 4096, b: int = 4, h: int = 12, dh: int = 64) -> None:
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, t, h, dh), jnp.bfloat16) for kk in ks)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    def loss_dense(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * dh ** -0.5
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(jnp.bfloat16)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(jnp.float32))
+
+    for name, lf in [("flash", loss_flash), ("dense", loss_dense)]:
+        f = jax.jit(jax.grad(lf, argnums=(0, 1, 2)))
+        for _ in range(3):
+            np.asarray(f(q, k, v)[0]).ravel()  # warmup + fence
+        t0 = time.perf_counter()
+        reps = 10
+        for _ in range(reps):
+            r = f(q, k, v)
+        np.asarray(r[0]).ravel()  # fence
+        print(f"{name}: {(time.perf_counter() - t0) / reps * 1e3:.2f} ms/grad-step "
+              f"(b={b} t={t} h={h} dh={dh} bf16)")
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:]))

@@ -1,0 +1,91 @@
+"""Pallas flash attention vs dense oracle — forward and gradients.
+
+Runs in Pallas interpret mode on the CPU simulator (the kernel auto-selects
+interpret off-TPU). Interpret mode checks the kernel math, not Mosaic
+lowering constraints — the small block sizes used here (64) are
+interpret-only; compiled TPU mode enforces 128-multiples and is exercised
+by benchmarks/flash_attention_bench.py on real hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudp.ops.flash_attention import flash_attention
+from tpudp.parallel.ring_attention import dense_causal_attention
+
+
+def _dense(q, k, v, causal):
+    b, t, h, dh = q.shape
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * dh ** -0.5
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+
+
+def _rand_qkv(key, b=2, t=256, h=2, dh=32):
+    ks = jax.random.split(key, 3)
+    shape = (b, t, h, dh)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_dense(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = _dense(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_forward_matches_ring_oracle():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1))
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_dense(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), b=1, t=128, h=2, dh=16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        return jnp.sum(o * jnp.cos(o))  # nonlinear reduction
+
+    def loss_dense(q, k, v):
+        o = _dense(q, k, v, causal).astype(q.dtype)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=5e-4, atol=5e-4, err_msg=name)
+
+
+def test_uneven_blocks():
+    # block_q != block_k exercises the causal loop-bound arithmetic
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), t=256)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=64)
+    ref = _dense(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    out2 = flash_attention(q, k, v, causal=True, block_q=64, block_k=128)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_io():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), t=128, dh=64)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, causal=True, block_q=64, block_k=64)
+    assert out.dtype == jnp.bfloat16
+    ref = _dense(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.05, atol=0.05)

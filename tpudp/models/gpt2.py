@@ -35,8 +35,14 @@ class GPT2Config:
     d_model: int = 768
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
-    attn_impl: str = "dense"  # 'dense' | 'ring'
+    attn_impl: str = "dense"  # 'dense' | 'flash' | 'ring'
     seq_axis: str | None = None  # mesh axis for ring attention
+
+    def __post_init__(self):
+        if self.attn_impl not in ("dense", "flash", "ring"):
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; "
+                "choose from 'dense', 'flash', 'ring'")
 
 
 def _axis_is_bound(axis_name: str) -> bool:
@@ -79,6 +85,10 @@ class CausalSelfAttention(nn.Module):
             from tpudp.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
+        elif cfg.attn_impl == "flash":
+            from tpudp.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
         else:
             scale = (d // h) ** -0.5
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

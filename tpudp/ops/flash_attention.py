@@ -1,0 +1,303 @@
+"""Pallas TPU flash attention — the framework's owned hot-op kernel.
+
+The reference delegates every op to ATen's C++ kernels (SURVEY.md §2.3);
+here the attention hot op is a first-party Pallas kernel instead of an XLA
+einsum chain:
+
+  * Blocked online-softmax forward (flash-attention recurrence): the
+    ``(t, t)`` score matrix is never materialized — each grid step holds one
+    ``(block_q, block_k)`` tile in VMEM, so memory is O(t · d) not O(t²) and
+    the tiles feed the MXU back-to-back.
+  * Custom VJP with the standard two-kernel backward (a dq kernel gridded
+    over Q blocks and a dk/dv kernel gridded over K blocks), recomputing
+    probabilities from the saved log-sum-exp rather than storing them.
+  * Causal masking skips fully-masked K blocks via the loop bound (the tail
+    tile is masked elementwise), so causal costs ~half the FLOPs.
+  * Runs in interpret mode off-TPU, so the same code is unit-testable on the
+    CPU simulator mesh (tests/test_flash_attention.py checks fwd and grads
+    against a dense oracle).
+
+Layouts: public API takes ``(batch, time, heads, head_dim)`` (the layout the
+models use); the kernels run per ``(batch·head)`` with ``(time, head_dim)``
+blocks. Compute is fp32 regardless of input dtype (MXU accumulate).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float):
+    bq, dh = q_ref.shape[1], q_ref.shape[2]
+    qi = pl.program_id(1)
+    t = k_ref.shape[1]
+    nk = t // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, dh), jnp.float32)
+
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    # Causal: K blocks strictly above the diagonal contribute nothing — stop
+    # the loop at the diagonal block instead of masking them.  upper <= nk
+    # because t % block_k == 0 (checked in flash_attention()).
+    upper = ((qi + 1) * bq + block_k - 1) // block_k if causal else nk
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe)).reshape(1, bq)
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    """q,k,v: (bh, t, dh) fp32/bf16 -> (o (bh,t,dh), lse (bh,t) f32)."""
+    bh, t, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+    grid = (bh, t // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               scale=scale)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse.reshape(bh, t)
+
+
+# --------------------------------------------------------------- backward
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, causal: bool, scale: float):
+    bq, dh = q_ref.shape[1], q_ref.shape[2]
+    qi = pl.program_id(1)
+    t = k_ref.shape[1]
+    nk = t // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].reshape(bq, 1)
+    delta = delta_ref[0].reshape(bq, 1)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse)
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    upper = ((qi + 1) * bq + block_k - 1) // block_k if causal else nk
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((bq, dh), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float):
+    bk, dh = k_ref.shape[1], k_ref.shape[2]
+    ki = pl.program_id(1)
+    t = q_ref.shape[1]
+    nq = t // block_q
+
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # Causal: Q blocks strictly above this K block see none of it.
+    lower = (ki * bk) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lower, nq, body,
+        (jnp.zeros((bk, dh), jnp.float32), jnp.zeros((bk, dh), jnp.float32)))
+    # scale is already folded into q above, so dk = dsᵀ·(q·scale) is complete
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    bh, t, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+    # delta_i = rowsum(do_i * o_i) — the softmax-jacobian correction term.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, t)
+    lse3 = lse.reshape(bh, 1, t)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal,
+                          scale=scale),
+        grid=(bh, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dh), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, dh), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public API
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k,
+                           interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Blocked flash attention. ``q, k, v``: ``(batch, time, heads, head_dim)``.
+
+    ``time`` must be divisible by the block sizes (blocks are clamped to
+    ``time`` when shorter). Differentiable (custom VJP); off-TPU the kernels
+    run in Pallas interpret mode so tests work on the CPU simulator.
+
+    Compiled (TPU) mode requires lane-aligned blocks: ``block_q``/``block_k``
+    must be multiples of 128 (Mosaic tiling: the log-sum-exp blocks put
+    ``block_q`` in the lane dimension). Interpret mode has no such limit.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, dh = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"time {t} not divisible by blocks ({block_q},{block_k})")
+    if not interpret and (block_q % 128 or block_k % 128):
+        raise ValueError(
+            f"compiled TPU mode needs block sizes that are multiples of 128 "
+            f"(got block_q={block_q}, block_k={block_k}; time={t} — for "
+            f"shorter sequences use dense attention or interpret=True)")
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k,
+               interpret)
+    return o.reshape(b, h, t, dh).transpose(0, 2, 1, 3)
