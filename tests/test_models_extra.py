@@ -80,3 +80,16 @@ def test_init_state_int_input():
     tx = make_optimizer()
     state = init_state(model, tx, input_shape=(1, 8))
     assert state.batch_stats == {}
+
+
+def test_flash_model_short_seq_falls_back_to_dense():
+    """attn_impl='flash' must initialize and run at t < 128 (the Pallas
+    kernel needs 128-multiple blocks; short traces take the dense path)."""
+    model = gpt2_small(attn_impl="flash", vocab_size=64, max_seq_len=64,
+                       num_layers=1, num_heads=2, d_model=16)
+    tx = make_optimizer()
+    state = init_state(model, tx, input_shape=(1, 16), seed=0)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = model.apply({"params": state.params}, tokens, train=False)
+    assert logits.shape == (2, 16, 64)
+    assert np.isfinite(np.asarray(logits)).all()

@@ -85,7 +85,10 @@ class CausalSelfAttention(nn.Module):
             from tpudp.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
-        elif cfg.attn_impl == "flash":
+        elif cfg.attn_impl == "flash" and t % 128 == 0:
+            # Pallas kernel needs 128-multiple blocks on TPU; shorter/ragged
+            # sequences (e.g. the t=16 init trace) take the dense path, which
+            # has identical math and param shapes.
             from tpudp.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
