@@ -1,0 +1,132 @@
+"""Native C++ data-path kernels: bit-exact parity with the numpy backend.
+
+Both backends consume the same Python-drawn random decisions (crop offsets,
+flip flags), so equality is exact, not approximate — any mismatch is a real
+kernel bug, not float noise.
+"""
+
+import numpy as np
+import pytest
+
+from tpudp import native
+from tpudp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD, Dataset
+from tpudp.data.loader import (DataLoader, apply_crop_flip, draw_augment_params,
+                               normalize_batch)
+from tpudp.data.prefetch import Prefetcher
+from tpudp.data.sampler import ShardedSampler
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _images(n=16, h=32, w=32, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(n, h, w, 3)).astype(np.uint8)
+
+
+def test_augment_normalize_bit_exact():
+    imgs = _images()
+    rng = np.random.default_rng(7)
+    offsets, flips = draw_augment_params(len(imgs), rng)
+    want = normalize_batch(apply_crop_flip(imgs, offsets, flips))
+    got = native.augment_normalize(imgs, offsets, flips,
+                                   CIFAR10_MEAN, CIFAR10_STD)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_augment_normalize_all_flips_and_corners():
+    """Extremes: every sample flipped, crop origins at the 4 padded corners."""
+    imgs = _images(8)
+    offsets = np.array([[0, 0], [0, 8], [8, 0], [8, 8]] * 2, dtype=np.int32)
+    flips = np.ones(8, dtype=bool)
+    want = normalize_batch(apply_crop_flip(imgs, offsets, flips))
+    got = native.augment_normalize(imgs, offsets, flips,
+                                   CIFAR10_MEAN, CIFAR10_STD)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_downscale_crop_no_pad():
+    """ImageNet-style crop: 256x256 -> 224x224 with pad=0."""
+    imgs = _images(4, h=256, w=256, seed=3)
+    rng = np.random.default_rng(11)
+    offsets, flips = draw_augment_params(4, rng, crop_range=256 - 224 + 1)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    got = native.augment_normalize(imgs, offsets, flips, mean, std,
+                                   out_hw=(224, 224), pad=0)
+    assert got.shape == (4, 224, 224, 3)
+    # Spot-check sample 0 against pure numpy.
+    r0, c0 = offsets[0]
+    crop = imgs[0, r0:r0 + 224, c0:c0 + 224]
+    if flips[0]:
+        crop = crop[:, ::-1]
+    want = (crop.astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_array_equal(got[0], want)
+
+
+def test_normalize_only_bit_exact():
+    imgs = _images(8)
+    got = native.normalize(imgs, CIFAR10_MEAN, CIFAR10_STD)
+    np.testing.assert_array_equal(got, normalize_batch(imgs))
+
+
+def test_gather_matches_fancy_indexing():
+    data = _images(32)
+    idx = np.random.default_rng(5).integers(0, 32, size=20)
+    np.testing.assert_array_equal(native.gather(data, idx), data[idx])
+
+
+def _dataset(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return Dataset(
+        rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8),
+        rng.integers(0, 10, size=n).astype(np.int32),
+    )
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_loader_backends_identical(train):
+    ds = _dataset()
+    kw = dict(batch_size=16, train=train, seed=0)
+    batches_np = list(DataLoader(ds, backend="numpy", **kw))
+    batches_cc = list(DataLoader(ds, backend="native", **kw))
+    assert len(batches_np) == len(batches_cc) > 0
+    for (xi, yi, wi), (xj, yj, wj) in zip(batches_np, batches_cc):
+        np.testing.assert_array_equal(xi, xj)
+        np.testing.assert_array_equal(yi, yj)
+        np.testing.assert_array_equal(wi, wj)
+
+
+def test_prefetcher_preserves_batches():
+    ds = _dataset(48)
+    loader = DataLoader(ds, 16, train=True, seed=1)
+    direct = list(loader)
+    prefetched = list(Prefetcher(loader, depth=2))
+    assert len(direct) == len(prefetched)
+    for (xi, yi, wi), (xj, yj, wj) in zip(direct, prefetched):
+        np.testing.assert_array_equal(xi, xj)
+        np.testing.assert_array_equal(yi, yj)
+
+
+def test_prefetcher_propagates_exceptions():
+    class Boom:
+        def __iter__(self):
+            yield 1
+            raise RuntimeError("boom")
+
+        def __len__(self):
+            return 2
+
+    it = iter(Prefetcher(Boom(), depth=1))
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_prefetcher_early_break_stops_worker():
+    ds = _dataset(64)
+    loader = DataLoader(ds, 8, train=True)
+    for i, _ in enumerate(Prefetcher(loader, depth=1)):
+        if i == 1:
+            break  # generator close -> stop event; no hang, no leak

@@ -54,6 +54,14 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--synthetic-train-size", type=int, default=50_000,
                    help="synthetic-fallback train set size (smoke runs)")
     p.add_argument("--synthetic-test-size", type=int, default=10_000)
+    p.add_argument("--data-backend", choices=["auto", "native", "numpy"],
+                   default="auto",
+                   help="host augmentation backend: fused C++/OpenMP kernel "
+                        "(tpudp/native) or bit-identical numpy")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="batches prepared ahead on a background thread "
+                        "(reference DataLoader num_workers=2 analogue); "
+                        "0 disables")
     return p
 
 
@@ -97,21 +105,28 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         train_set, host_batch,
         sampler=ShardedSampler(len(train_set.images), num_hosts, host_id,
                                shuffle=True, seed=args.seed),
-        train=True, seed=args.seed,
+        train=True, seed=args.seed, backend=args.data_backend,
     )
     test_loader = DataLoader(
         test_set, host_batch,
         sampler=ShardedSampler(len(test_set.images), num_hosts, host_id,
                                shuffle=False),
-        train=False,
+        train=False, backend=args.data_backend,
     )
+    if args.prefetch > 0:
+        from tpudp.data.prefetch import Prefetcher
+
+        train_loader = Prefetcher(train_loader, depth=args.prefetch)
+        test_loader = Prefetcher(test_loader, depth=args.prefetch)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     model = VGG11(dtype=dtype)
     trainer = Trainer(model, mesh, sync, seed=args.seed,
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode)
+    data_backend = getattr(train_loader, "loader", train_loader).backend
     print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
-          f"global_batch={args.batch_size} dtype={args.dtype}")
+          f"global_batch={args.batch_size} dtype={args.dtype} "
+          f"data={data_backend}+prefetch{args.prefetch}")
     print(f"[tpudp] train samples={len(train_set.images)} "
           f"test samples={len(test_set.images)}")
 

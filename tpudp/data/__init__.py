@@ -4,3 +4,4 @@ augmentation, and per-shard sampling (DistributedSampler equivalent)."""
 from tpudp.data.cifar10 import load_cifar10, CIFAR10_MEAN, CIFAR10_STD  # noqa: F401
 from tpudp.data.sampler import ShardedSampler  # noqa: F401
 from tpudp.data.loader import DataLoader, augment_batch, normalize_batch  # noqa: F401
+from tpudp.data.prefetch import Prefetcher  # noqa: F401

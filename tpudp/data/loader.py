@@ -9,10 +9,11 @@ Replaces the reference's torchvision transform stack + DataLoader
 
 Differences by design (TPU-first):
   * NHWC float32 output (XLA:TPU conv layout) instead of NCHW tensors.
-  * Whole-batch vectorized numpy ops instead of per-sample Python transforms
-    and worker processes — the 32x32 pipeline is far from being the
-    bottleneck at TPU step times, so no separate loader processes are needed
-    (a native C++ loader is still available for the large-image path).
+  * Whole-batch vectorized ops instead of per-sample Python transforms and
+    worker processes: the fused native C++/OpenMP kernel (tpudp/native/)
+    when available, else bit-identical vectorized numpy.  Random crop/flip
+    decisions are drawn here in Python from one RNG stream, so backend
+    choice never changes the data.
 """
 
 from __future__ import annotations
@@ -21,6 +22,7 @@ from typing import Iterator
 
 import numpy as np
 
+from tpudp import native
 from tpudp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD, Dataset
 from tpudp.data.sampler import ShardedSampler
 
@@ -31,17 +33,34 @@ def normalize_batch(images_u8: np.ndarray) -> np.ndarray:
     return (x - CIFAR10_MEAN) / CIFAR10_STD
 
 
+def draw_augment_params(
+    b: int, rng: np.random.Generator, *, crop_range: int = 9
+) -> tuple[np.ndarray, np.ndarray]:
+    """Draw (offsets (B,2) int32, flips (B,) bool) — the per-sample random
+    decisions of RandomCrop + RandomHorizontalFlip, shared by both backends.
+    ``crop_range`` = H_in + 2*pad - H_out + 1 (9 for CIFAR's 32+8-32+1)."""
+    offsets = rng.integers(0, crop_range, size=(b, 2)).astype(np.int32)
+    flips = rng.random(b) < 0.5
+    return offsets, flips
+
+
+def apply_crop_flip(
+    images_u8: np.ndarray, offsets: np.ndarray, flips: np.ndarray, *, pad: int = 4
+) -> np.ndarray:
+    """numpy backend: zero-pad + crop(H,W) at ``offsets`` + flip where set."""
+    b, h, w, _ = images_u8.shape
+    padded = np.pad(images_u8, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    rows = offsets[:, 0, None] + np.arange(h)  # (B, H)
+    cols = offsets[:, 1, None] + np.arange(w)
+    out = padded[np.arange(b)[:, None, None], rows[:, :, None], cols[:, None, :]]
+    out[flips] = out[flips, :, ::-1]
+    return out
+
+
 def augment_batch(images_u8: np.ndarray, rng: np.random.Generator) -> np.ndarray:
     """RandomCrop(32, padding=4, zero fill) + RandomHorizontalFlip, batched."""
-    b = images_u8.shape[0]
-    padded = np.pad(images_u8, ((0, 0), (4, 4), (4, 4), (0, 0)))
-    offs = rng.integers(0, 9, size=(b, 2))
-    rows = offs[:, 0, None] + np.arange(32)  # (B, 32)
-    cols = offs[:, 1, None] + np.arange(32)
-    out = padded[np.arange(b)[:, None, None], rows[:, :, None], cols[:, None, :]]
-    flip = rng.random(b) < 0.5
-    out[flip] = out[flip, :, ::-1]
-    return out
+    offsets, flips = draw_augment_params(images_u8.shape[0], rng)
+    return apply_crop_flip(images_u8, offsets, flips)
 
 
 class DataLoader:
@@ -63,6 +82,7 @@ class DataLoader:
         train: bool = True,
         seed: int = 0,
         drop_last: bool | None = None,
+        backend: str = "auto",
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -73,6 +93,14 @@ class DataLoader:
         self.seed = seed
         self.drop_last = train if drop_last is None else drop_last
         self.epoch = 0
+        if backend == "auto":
+            backend = "native" if native.available() else "numpy"
+        elif backend == "native" and not native.available():
+            raise RuntimeError("native backend requested but the C++ library "
+                               "failed to build/load (see tpudp/native)")
+        elif backend not in ("native", "numpy"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -89,10 +117,14 @@ class DataLoader:
         """
         idx, valid = self.sampler.indices_and_mask(self.epoch)
         aug_rng = np.random.default_rng((self.seed, self.epoch, self.sampler.shard_index))
+        use_native = self.backend == "native"
         n_batches = len(self)
         for b in range(n_batches):
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
-            images = self.dataset.images[sel]
+            if use_native:
+                images = native.gather(self.dataset.images, sel)
+            else:
+                images = self.dataset.images[sel]
             labels = self.dataset.labels[sel]
             if self.train:  # DistributedSampler semantics: duplicates count
                 weights = np.ones(len(sel), dtype=np.float32)
@@ -105,5 +137,15 @@ class DataLoader:
                 labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
                 weights = np.concatenate([weights, np.zeros(pad, np.float32)])
             if self.train:
-                images = augment_batch(images, aug_rng)
-            yield normalize_batch(images), labels.astype(np.int32), weights
+                offsets, flips = draw_augment_params(len(images), aug_rng)
+                if use_native:
+                    images = native.augment_normalize(
+                        images, offsets, flips, CIFAR10_MEAN, CIFAR10_STD)
+                else:
+                    images = normalize_batch(
+                        apply_crop_flip(images, offsets, flips))
+            elif use_native:
+                images = native.normalize(images, CIFAR10_MEAN, CIFAR10_STD)
+            else:
+                images = normalize_batch(images)
+            yield images, labels.astype(np.int32), weights

@@ -1,0 +1,73 @@
+"""Background-thread batch prefetching.
+
+The reference overlaps host-side data work with compute via torch DataLoader
+worker processes (``num_workers=2``, ``src/Part 2a/main.py:39-44``).  Under
+JAX async dispatch the device is already busy while Python prepares the next
+batch, but the *host* augmentation (gather + crop/flip + normalize) still
+runs serially with step dispatch; a single daemon thread with a small queue
+hides it entirely.  Threads suffice (no worker processes): the heavy lifting
+is numpy/native C++ code that releases the GIL.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+
+class Prefetcher:
+    """Wraps any loader (iterable of batches, with ``set_epoch``/``__len__``)
+    and prepares up to ``depth`` batches ahead on a daemon thread.  Batch
+    order and content are identical to the wrapped loader's."""
+
+    _DONE = object()
+
+    def __init__(self, loader, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.loader = loader
+        self.depth = depth
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self) -> Iterator:
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            """Bounded put that aborts when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker() -> None:
+            try:
+                for batch in self.loader:
+                    if not put(batch):
+                        return
+                put(self._DONE)
+            except BaseException as e:  # re-raise on the consumer side
+                put(e)
+
+        t = threading.Thread(target=worker, daemon=True, name="tpudp-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()

@@ -1,0 +1,89 @@
+// Native host data-path kernels for tpudp (C++/OpenMP).
+//
+// TPU-native replacement for the capability the reference gets from torch's
+// C++ DataLoader worker pool (src/Part 2a/main.py:39-44: num_workers=2,
+// pin_memory) and torchvision's per-sample C transforms
+// (src/Part 2a/main.py:24-31: RandomCrop(32, padding=4) ->
+// RandomHorizontalFlip -> ToTensor -> Normalize).  One fused pass over the
+// uint8 batch produces the normalized float32 NHWC tensor XLA wants, with
+// OpenMP supplying the worker-pool parallelism in-process (no IPC, no
+// per-sample Python).
+//
+// Random decisions (crop origins, flip flags) are made by the caller in
+// Python so the numpy fallback path and this kernel are bit-identical given
+// the same RNG stream.  Float math is ordered exactly like the numpy path
+// ((x / 255 - mean) / std, all fp32) and the build disables FP contraction,
+// so outputs match numpy to the last bit.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Fused zero-pad -> crop -> horizontal-flip -> normalize.
+//   in:      (b, hi, wi, c) uint8, NHWC
+//   out:     (b, ho, wo, c) float32, NHWC
+//   offsets: (b, 2) int32 crop origins (row, col) in the zero-padded frame;
+//            valid range [0, hi + 2*pad - ho] x [0, wi + 2*pad - wo]
+//   flips:   (b,) uint8 booleans — flip the crop along the width axis
+//   mean/std: (c,) float32 channel statistics
+void tpudp_augment_normalize(const uint8_t* in, float* out,
+                             const int32_t* offsets, const uint8_t* flips,
+                             int64_t b, int64_t hi, int64_t wi,
+                             int64_t ho, int64_t wo, int64_t c, int64_t pad,
+                             const float* mean, const float* std_) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < b; ++i) {
+    const uint8_t* img = in + i * hi * wi * c;
+    float* dst = out + i * ho * wo * c;
+    // Crop origin in unpadded source coordinates (may be negative: zero pad).
+    const int64_t r0 = (int64_t)offsets[2 * i] - pad;
+    const int64_t c0 = (int64_t)offsets[2 * i + 1] - pad;
+    const bool flip = flips[i] != 0;
+    for (int64_t r = 0; r < ho; ++r) {
+      const int64_t sr = r0 + r;
+      const bool row_in = sr >= 0 && sr < hi;
+      for (int64_t col = 0; col < wo; ++col) {
+        const int64_t dc = flip ? wo - 1 - col : col;
+        float* o = dst + (r * wo + dc) * c;
+        const int64_t sc = c0 + col;
+        if (row_in && sc >= 0 && sc < wi) {
+          const uint8_t* p = img + (sr * wi + sc) * c;
+          for (int64_t k = 0; k < c; ++k)
+            o[k] = ((float)p[k] / 255.0f - mean[k]) / std_[k];
+        } else {  // zero-padding region: normalize a zero pixel
+          for (int64_t k = 0; k < c; ++k)
+            o[k] = (0.0f - mean[k]) / std_[k];
+        }
+      }
+    }
+  }
+}
+
+// Normalize only (the eval-path ToTensor+Normalize pair): uint8 -> float32,
+// n pixels of c channels each.
+void tpudp_normalize(const uint8_t* in, float* out, int64_t n, int64_t c,
+                     const float* mean, const float* std_) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* p = in + i * c;
+    float* o = out + i * c;
+    for (int64_t k = 0; k < c; ++k)
+      o[k] = ((float)p[k] / 255.0f - mean[k]) / std_[k];
+  }
+}
+
+// Parallel batch gather: out[i] = data[idx[i]] for fixed-size samples.
+// (numpy fancy indexing is single-threaded; at ImageNet sample sizes the
+// copy is worth spreading across cores.)
+void tpudp_gather_u8(const uint8_t* data, const int64_t* idx, uint8_t* out,
+                     int64_t b, int64_t sample_bytes) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < b; ++i)
+    std::memcpy(out + i * sample_bytes, data + idx[i] * sample_bytes,
+                (size_t)sample_bytes);
+}
+
+int tpudp_native_abi_version(void) { return 1; }
+
+}  // extern "C"
