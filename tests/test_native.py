@@ -12,7 +12,6 @@ from tpudp import native
 from tpudp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD, Dataset
 from tpudp.data.loader import (DataLoader, apply_crop_flip, draw_augment_params,
                                normalize_batch)
-from tpudp.data.prefetch import Prefetcher
 from tpudp.data.sampler import ShardedSampler
 
 pytestmark = pytest.mark.skipif(
@@ -96,37 +95,3 @@ def test_loader_backends_identical(train):
         np.testing.assert_array_equal(xi, xj)
         np.testing.assert_array_equal(yi, yj)
         np.testing.assert_array_equal(wi, wj)
-
-
-def test_prefetcher_preserves_batches():
-    ds = _dataset(48)
-    loader = DataLoader(ds, 16, train=True, seed=1)
-    direct = list(loader)
-    prefetched = list(Prefetcher(loader, depth=2))
-    assert len(direct) == len(prefetched)
-    for (xi, yi, wi), (xj, yj, wj) in zip(direct, prefetched):
-        np.testing.assert_array_equal(xi, xj)
-        np.testing.assert_array_equal(yi, yj)
-
-
-def test_prefetcher_propagates_exceptions():
-    class Boom:
-        def __iter__(self):
-            yield 1
-            raise RuntimeError("boom")
-
-        def __len__(self):
-            return 2
-
-    it = iter(Prefetcher(Boom(), depth=1))
-    assert next(it) == 1
-    with pytest.raises(RuntimeError, match="boom"):
-        next(it)
-
-
-def test_prefetcher_early_break_stops_worker():
-    ds = _dataset(64)
-    loader = DataLoader(ds, 8, train=True)
-    for i, _ in enumerate(Prefetcher(loader, depth=1)):
-        if i == 1:
-            break  # generator close -> stop event; no hang, no leak

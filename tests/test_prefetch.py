@@ -1,0 +1,68 @@
+"""Background prefetcher: order/content preservation, exception propagation,
+and clean worker shutdown.  Pure-Python — independent of the native library
+(these tests must run even where g++ is unavailable)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpudp.data.cifar10 import Dataset
+from tpudp.data.loader import DataLoader
+from tpudp.data.prefetch import Prefetcher
+
+
+def _dataset(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return Dataset(
+        rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8),
+        rng.integers(0, 10, size=n).astype(np.int32),
+    )
+
+
+def test_prefetcher_preserves_batches():
+    ds = _dataset(48)
+    loader = DataLoader(ds, 16, train=True, seed=1)
+    direct = list(loader)
+    prefetched = list(Prefetcher(loader, depth=2))
+    assert len(direct) == len(prefetched)
+    for (xi, yi, wi), (xj, yj, wj) in zip(direct, prefetched):
+        np.testing.assert_array_equal(xi, xj)
+        np.testing.assert_array_equal(yi, yj)
+
+
+def test_prefetcher_propagates_exceptions():
+    class Boom:
+        def __iter__(self):
+            yield 1
+            raise RuntimeError("boom")
+
+        def __len__(self):
+            return 2
+
+    it = iter(Prefetcher(Boom(), depth=1))
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_prefetcher_early_break_stops_worker():
+    ds = _dataset(64)
+    loader = DataLoader(ds, 8, train=True)
+    for i, _ in enumerate(Prefetcher(loader, depth=1)):
+        if i == 1:
+            break  # generator close -> stop event fires in the finally
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        workers = [t for t in threading.enumerate()
+                   if t.name == "tpudp-prefetch" and t.is_alive()]
+        if not workers:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"prefetch worker leaked: {workers}")
+
+
+def test_prefetcher_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        Prefetcher([], depth=0)

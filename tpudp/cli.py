@@ -113,6 +113,7 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                                shuffle=False),
         train=False, backend=args.data_backend,
     )
+    data_backend = train_loader.backend  # before any wrapper hides it
     if args.prefetch > 0:
         from tpudp.data.prefetch import Prefetcher
 
@@ -123,7 +124,6 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     model = VGG11(dtype=dtype)
     trainer = Trainer(model, mesh, sync, seed=args.seed,
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode)
-    data_backend = getattr(train_loader, "loader", train_loader).backend
     print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
           f"data={data_backend}+prefetch{args.prefetch}")

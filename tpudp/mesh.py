@@ -57,6 +57,32 @@ def make_mesh(num_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mes
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def make_mesh_nd(shape: dict[str, int], devices=None) -> Mesh:
+    """Build an N-D mesh from ``{axis_name: size}`` (insertion-ordered).
+
+    Multi-axis analogue of :func:`make_mesh` for the DPxTP / DPxSP / DPxPP
+    rungs — e.g. ``make_mesh_nd({"data": 2, "model": 4})``.  Axis order
+    matters on real hardware: put the fastest-communicating axis (tensor/
+    sequence parallel) innermost so its collectives ride the shortest ICI
+    links.
+    """
+    explicit = devices is not None
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(list(shape.values())))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    if n < len(devices) and not explicit:
+        import warnings
+
+        warnings.warn(
+            f"make_mesh_nd({shape}) uses {n} of {len(devices)} devices; the "
+            f"other {len(devices) - n} idle. Pass devices= explicitly to "
+            "silence.", stacklevel=2)
+    grid = np.asarray(devices[:n]).reshape(tuple(shape.values()))
+    return Mesh(grid, tuple(shape.keys()))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a global batch: split along the leading (batch) axis."""
     return NamedSharding(mesh, P(DATA_AXIS))

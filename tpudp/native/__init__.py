@@ -113,6 +113,7 @@ def augment_normalize(
     b, hi, wi, c = images_u8.shape
     ho, wo = out_hw if out_hw is not None else (hi, wi)
     images_u8 = np.ascontiguousarray(images_u8)
+    assert images_u8.dtype == np.uint8, images_u8.dtype
     offsets = np.ascontiguousarray(offsets, dtype=np.int32)
     flips = np.ascontiguousarray(flips, dtype=np.uint8)
     mean = np.ascontiguousarray(mean, dtype=np.float32)
@@ -131,6 +132,7 @@ def normalize(images_u8: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.nd
     lib = load()
     assert lib is not None, "native library unavailable"
     images_u8 = np.ascontiguousarray(images_u8)
+    assert images_u8.dtype == np.uint8, images_u8.dtype
     c = images_u8.shape[-1]
     n = images_u8.size // c
     mean = np.ascontiguousarray(mean, dtype=np.float32)
