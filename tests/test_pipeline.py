@@ -1,0 +1,103 @@
+"""Pipeline parallelism: the GPipe shard_map schedule must match the
+single-device oracle exactly in loss and parameter trajectory — this is the
+referee for the masked-loss / structural-psum gradient assembly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudp.mesh import make_mesh_nd
+from tpudp.models.gpt2 import gpt2_small
+from tpudp.parallel.pipeline import (make_pp_train_step, stack_block_params,
+                                     unstack_block_params)
+from tpudp.parallel.sync import get_sync
+from tpudp.train import _loss_and_updates, init_state, make_optimizer
+
+TINY = dict(vocab_size=64, max_seq_len=32, num_layers=4, num_heads=2, d_model=32)
+
+
+def _data(steps=3, batch=8, t=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, size=(steps, batch, t)).astype(np.int32)
+    return [(jnp.asarray(x), jnp.roll(jnp.asarray(x), -1, axis=1)) for x in toks]
+
+
+def test_stack_unstack_roundtrip():
+    model = gpt2_small(**TINY)
+    tx = make_optimizer()
+    params = init_state(model, tx, input_shape=(1, 8)).params
+    back = unstack_block_params(stack_block_params(params, TINY["num_layers"]))
+    jax.tree.map(np.testing.assert_array_equal, params, back)
+
+
+@pytest.mark.parametrize("dp,pp,micro", [(1, 4, 2), (2, 4, 4), (1, 2, 1)])
+def test_pp_matches_single_device_trajectory(dp, pp, micro):
+    mesh = make_mesh_nd({"data": dp, "pipe": pp},
+                        devices=jax.devices()[: dp * pp])
+    model = gpt2_small(**TINY)
+    tx = make_optimizer(learning_rate=0.01)
+
+    ref_state = init_state(model, tx, input_shape=(1, 8), seed=0)
+    pp_state, pp_step = make_pp_train_step(
+        model, tx, mesh, init_state(model, tx, input_shape=(1, 8), seed=0),
+        n_microbatches=micro, donate=False)
+
+    # block params actually shard over the pipe axis
+    qkv = pp_state.params["blocks"]["attn"]["qkv"]["kernel"]
+    assert qkv.shape[0] == TINY["num_layers"]
+    layer_rows = {s.data.shape[0] for s in qkv.addressable_shards}
+    assert layer_rows == {TINY["num_layers"] // pp}
+
+    @jax.jit
+    def ref_step(state, x, y):
+        return _loss_and_updates(model, tx, state, x, y, get_sync("none"), None)
+
+    for x, y in _data(vocab=TINY["vocab_size"]):
+        ref_state, ref_loss = ref_step(ref_state, x, y)
+        pp_state, pp_loss = pp_step(pp_state, x, y)
+        np.testing.assert_allclose(float(ref_loss), float(pp_loss),
+                                   rtol=1e-5, atol=1e-6)
+
+    want = stack_block_params(ref_state.params, TINY["num_layers"])
+    got = jax.device_get(pp_state.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5),
+        want, got)
+
+
+def test_pp_preserves_resumed_momentum():
+    """A mid-training state handed to make_pp_train_step keeps its SGD
+    momentum: the pipelined continuation matches the single-device one."""
+    mesh = make_mesh_nd({"data": 1, "pipe": 4}, devices=jax.devices()[:4])
+    model = gpt2_small(**TINY)
+    tx = make_optimizer(learning_rate=0.01)
+    state = init_state(model, tx, input_shape=(1, 8), seed=0)
+
+    @jax.jit
+    def ref_step(state, x, y):
+        return _loss_and_updates(model, tx, state, x, y, get_sync("none"), None)
+
+    data = _data(steps=4, vocab=TINY["vocab_size"])
+    for x, y in data[:2]:  # warm up momentum on the single-device path
+        state, _ = ref_step(state, x, y)
+
+    pp_state, pp_step = make_pp_train_step(model, tx, mesh, state,
+                                           n_microbatches=2, donate=False)
+    ref_state = state
+    for x, y in data[2:]:
+        ref_state, ref_loss = ref_step(ref_state, x, y)
+        pp_state, pp_loss = pp_step(pp_state, x, y)
+        np.testing.assert_allclose(float(ref_loss), float(pp_loss),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_pp_rejects_indivisible_layers():
+    mesh = make_mesh_nd({"data": 1, "pipe": 8})
+    model = gpt2_small(**TINY)  # 4 layers, 8 stages
+    tx = make_optimizer()
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pp_train_step(model, tx, mesh,
+                           init_state(model, tx, input_shape=(1, 8)),
+                           n_microbatches=2)

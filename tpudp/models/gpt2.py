@@ -117,6 +117,28 @@ class Block(nn.Module):
         return x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_proj")(h)
 
 
+def embed_tokens(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
+                 positions: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Raw-param twin of the embedding stage of :meth:`GPT2.__call__`
+    (``wte(tokens) + wpe(positions)``), for rungs that drive the params
+    directly (pipeline parallelism).  Must stay in lockstep with
+    ``GPT2.__call__``; the oracle-parity test in tests/test_pipeline.py is
+    the referee."""
+    if positions is None:
+        positions = jnp.arange(tokens.shape[-1])
+    wte = params["wte"]["embedding"].astype(cfg.dtype)
+    wpe = params["wpe"]["embedding"].astype(cfg.dtype)
+    return wte[tokens] + wpe[positions]
+
+
+def lm_head(cfg: GPT2Config, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Raw-param twin of the output stage of :meth:`GPT2.__call__`
+    (final LayerNorm + tied-embedding head)."""
+    x = nn.LayerNorm(dtype=jnp.float32).apply({"params": params["ln_f"]}, x)
+    wte = params["wte"]["embedding"].astype(cfg.dtype)
+    return (x.astype(cfg.dtype) @ wte.T).astype(jnp.float32)
+
+
 class GPT2(nn.Module):
     """Decoder-only LM: ``(B, T) int tokens -> (B, T, vocab) float32 logits``.
 

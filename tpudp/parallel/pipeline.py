@@ -1,0 +1,253 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pipe``
+mesh axis, built from ``lax.scan`` + ``lax.ppermute`` inside ``shard_map``.
+
+Beyond-parity capability (the reference runs a single ``model(data)`` call
+per step — no pipelining anywhere, SURVEY.md §2.2).  TPU-first design: the
+transformer's blocks are *stacked* into one ``(L, ...)`` pytree and sharded
+over the ``pipe`` axis, so each device owns ``L/S`` contiguous layers.
+Activations travel stage-to-stage over the ICI ring via ``ppermute``; the
+schedule is the classic GPipe fill-drain loop over ``M`` microbatches in
+``M + S - 1`` ticks, expressed as a single ``lax.scan`` so the whole
+pipeline (forward AND backward) is one compiled XLA program.
+
+Autodiff gives the backward pipeline for free: the transpose of
+``ppermute`` is the reverse-ring ``ppermute``, so cotangents flow from the
+loss (computed on the last stage only, masked elsewhere) back through each
+stage, depositing exactly that stage's block gradients on its own device.
+Shared params (embedding / final LayerNorm / tied head) receive gradient
+contributions only on the stages that actually use them (stage 0: lookup,
+stage S-1: head), and one structural ``psum`` over the pipe axis assembles
+the full gradient — no double counting, verified against the single-device
+oracle in tests/test_pipeline.py.
+
+Known non-goal (documented): this is GPipe (fill/drain bubble of
+``(S-1)/(M+S-1)``), not interleaved/looping 1F1B — the schedule slot is a
+clean extension point and the bubble shrinks with more microbatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudp.mesh import DATA_AXIS
+
+PIPE_AXIS = "pipe"
+
+
+def stack_block_params(params: dict, num_layers: int, prefix: str = "h_") -> dict:
+    """Re-layout standard GPT-2 params (``h_0`` .. ``h_{L-1}`` subtrees)
+    into a pipeline layout: one stacked ``blocks`` pytree with a leading
+    ``(L, ...)`` layer axis (the axis the ``pipe`` mesh dimension shards),
+    alongside the shared (non-block) params."""
+    blocks = [params[f"{prefix}{i}"] for i in range(num_layers)]
+    out = {k: v for k, v in params.items() if not k.startswith(prefix)}
+    out["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return out
+
+
+def unstack_block_params(params_pp: dict, prefix: str = "h_") -> dict:
+    """Inverse of :func:`stack_block_params` (checkpoint interop)."""
+    blocks = params_pp["blocks"]
+    num_layers = jax.tree.leaves(blocks)[0].shape[0]
+    out = {k: v for k, v in params_pp.items() if k != "blocks"}
+    for i in range(num_layers):
+        out[f"{prefix}{i}"] = jax.tree.map(lambda x: x[i], blocks)
+    return out
+
+
+def _map_params_subtrees(node: Any, params_struct, fn: Callable) -> Any:
+    """Apply ``fn`` to every subtree of ``node`` whose pytree structure
+    equals the param tree's (e.g. the SGD momentum trace inside an optax
+    state), rebuilding containers around everything else."""
+    if jax.tree.structure(node) == params_struct:
+        return fn(node)
+    if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+        return type(node)(*(
+            _map_params_subtrees(c, params_struct, fn) for c in node))
+    if isinstance(node, (tuple, list)):
+        return type(node)(
+            _map_params_subtrees(c, params_struct, fn) for c in node)
+    if isinstance(node, dict):
+        return {k: _map_params_subtrees(v, params_struct, fn)
+                for k, v in node.items()}
+    return node
+
+
+def pipeline_spec_tree(tree: Any, pipe_axis: str = PIPE_AXIS) -> Any:
+    """Per-leaf shard_map specs for a pipeline-layout pytree: leaves under a
+    ``blocks`` key shard their leading (layer) axis over ``pipe``; everything
+    else is replicated."""
+
+    def one(path, _leaf):
+        keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        return P(pipe_axis) if "blocks" in keys else P()
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def gpipe(
+    stage_params: Any,
+    x_microbatches: jnp.ndarray,
+    block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    axis_name: str = PIPE_AXIS,
+) -> jnp.ndarray:
+    """Run the GPipe schedule inside ``shard_map``.
+
+    Args:
+      stage_params: this device's ``(L/S, ...)`` stacked slice of block params.
+      x_microbatches: ``(M, mb, ...)`` microbatched input, replicated over
+        the pipe axis (only stage 0 reads it).
+      block_fn: ``(one_layer_params, x) -> x`` — applied sequentially over
+        this stage's layers.
+      axis_name: the pipe mesh axis.
+
+    Returns:
+      ``(M, mb, ...)`` outputs of the final stage — VALID ONLY on the last
+      stage (zeros elsewhere); callers mask their loss with
+      ``lax.axis_index(axis_name) == S - 1`` and ``psum`` the result.
+    """
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    perm = [(j, (j + 1) % s) for j in range(s)]
+
+    def stage_apply(x):
+        return lax.scan(lambda h, p: (block_fn(p, h), None), x, stage_params)[0]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # Stage 0 ingests microbatch t while t < M (garbage afterwards is
+        # never written); later stages consume what arrived on the ring.
+        x0 = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+        inp = jnp.where(idx == 0, x0, incoming)
+        out = stage_apply(inp)
+        # The last stage emits microbatch t-(S-1) once the pipe has filled.
+        out_idx = jnp.clip(t - (s - 1), 0, m - 1)
+        write = (idx == s - 1) & (t >= s - 1)
+        prev = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, out, prev), out_idx, 0)
+        return (lax.ppermute(out, axis_name, perm), outputs), None
+
+    init = (
+        jnp.zeros_like(x_microbatches[0]),
+        jnp.zeros_like(x_microbatches),
+    )
+    (_, outputs), _ = lax.scan(tick, init, jnp.arange(m + s - 1))
+    return outputs
+
+
+def make_pp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state,
+    *,
+    n_microbatches: int,
+    data_axis: str | None = DATA_AXIS,
+    pipe_axis: str = PIPE_AXIS,
+    donate: bool = True,
+):
+    """DP x PP train step for tpudp.models.gpt2.GPT2.
+
+    Takes a standard (single-device-layout) TrainState, re-lays params and
+    momentum out into the stacked pipeline layout, shards blocks over the
+    ``pipe`` mesh axis and the batch over ``data``, and returns
+    ``(pp_state, step_fn)`` with ``step_fn(state, tokens, targets) ->
+    (state, loss)`` — the same contract as every other rung, so the Trainer
+    drives it unchanged.
+
+    The optimizer update runs inside the shard_map on each device's local
+    shard: SGD/weight-decay/momentum are elementwise, so sharded application
+    is exact.
+    """
+    from tpudp.models.gpt2 import Block, embed_tokens, lm_head
+
+    cfg = model.config
+    num_layers = cfg.num_layers
+    s = mesh.shape[pipe_axis]
+    if num_layers % s != 0:
+        raise ValueError(f"{num_layers} layers not divisible by {s} stages")
+
+    def relayout(tree):
+        return stack_block_params(tree, num_layers)
+
+    pp_params = relayout(state.params)
+    # Momentum (and any other params-shaped optimizer leaves) re-lays out
+    # with its params so a resumed mid-training state keeps its trajectory.
+    params_struct = jax.tree.structure(state.params)
+    pp_opt = _map_params_subtrees(state.opt_state, params_struct, relayout)
+    pp_state = state.replace(params=pp_params, opt_state=pp_opt)
+
+    block_fn = lambda p, x: Block(cfg).apply({"params": p}, x)
+
+    def body(st, tokens, targets):
+        b, t = tokens.shape
+        if b % n_microbatches:
+            raise ValueError(
+                f"per-data-shard batch {b} not divisible by "
+                f"{n_microbatches} microbatches")
+        mb = b // n_microbatches
+        sidx = lax.axis_index(pipe_axis)
+        last = s - 1
+
+        def loss_fn(params):
+            x = embed_tokens(cfg, params, tokens)
+            x_mb = x.reshape(n_microbatches, mb, t, cfg.d_model)
+            h = gpipe(params["blocks"], x_mb, block_fn, pipe_axis)
+            h = h.reshape(b, t, cfg.d_model)
+            logits = lm_head(cfg, params, h)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+            # Only the last stage saw real outputs; mask so dead-stage
+            # garbage carries zero loss and zero gradient.
+            return jnp.where(sidx == last, ce, 0.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(st.params)
+        # Assemble: shared-param grads live on the stages that produced them
+        # (stage 0: embedding lookup; last: head) -> structural psum over
+        # pipe; block grads are already stage-local. Then mean over data.
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: g if "blocks" in jax.tree_util.keystr(path)
+            else lax.psum(g, pipe_axis),
+            grads)
+        loss = lax.psum(loss, pipe_axis)
+        if data_axis is not None:
+            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+            loss = lax.pmean(loss, data_axis)
+        updates, new_opt = tx.update(grads, st.opt_state, st.params)
+        new_params = optax.apply_updates(st.params, updates)
+        return st.replace(
+            step=st.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            loss_sum=st.loss_sum + loss,
+        ), loss
+
+    pp_state_specs = pipeline_spec_tree(pp_state, pipe_axis)
+    tok_spec = P(data_axis) if data_axis is not None else P()
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pp_state_specs, tok_spec, tok_spec),
+        out_specs=(pp_state_specs, P()),
+        check_vma=False,
+    )
+    step = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    from jax.sharding import NamedSharding
+
+    placed = jax.device_put(
+        pp_state,
+        jax.tree.map(lambda sp: NamedSharding(mesh, sp), pp_state_specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+    return placed, step
