@@ -57,6 +57,20 @@ def make_mesh(num_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mes
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def axis_is_bound(axis_name: str | None) -> bool:
+    """True when tracing inside shard_map/pmap with this named axis bound.
+    Model init happens outside any mapped context — axis-aware layers (ring
+    attention, MoE all_to_all) use this to fall back to their dense path so
+    ``model.init`` works without a mesh (param shapes are identical)."""
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
 def make_mesh_nd(shape: dict[str, int], devices=None) -> Mesh:
     """Build an N-D mesh from ``{axis_name: size}`` (insertion-ordered).
 

@@ -37,26 +37,23 @@ class GPT2Config:
     dtype: Any = jnp.float32
     attn_impl: str = "dense"  # 'dense' | 'flash' | 'ring'
     seq_axis: str | None = None  # mesh axis for ring attention
+    mlp_impl: str = "dense"  # 'dense' | 'moe'
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    expert_axis: str | None = None  # mesh axis for expert parallelism
 
     def __post_init__(self):
         if self.attn_impl not in ("dense", "flash", "ring"):
             raise ValueError(
                 f"unknown attn_impl {self.attn_impl!r}; "
                 "choose from 'dense', 'flash', 'ring'")
+        if self.mlp_impl not in ("dense", "moe"):
+            raise ValueError(
+                f"unknown mlp_impl {self.mlp_impl!r}; "
+                "choose from 'dense', 'moe'")
 
 
-def _axis_is_bound(axis_name: str) -> bool:
-    """True when tracing inside shard_map/pmap with this named axis.  Model
-    init happens outside any mapped context — the ring path then falls back
-    to dense so ``model.init`` works without a mesh (param shapes are
-    identical either way)."""
-    from jax import lax
-
-    try:
-        lax.axis_index(axis_name)
-        return True
-    except NameError:
-        return False
+from tpudp.mesh import axis_is_bound as _axis_is_bound  # noqa: E402
 
 
 def gpt2_small(**overrides) -> "GPT2":
@@ -111,6 +108,17 @@ class Block(nn.Module):
         cfg = self.config
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
         x = x + CausalSelfAttention(cfg, name="attn")(ln("ln_1")(x))
+        if cfg.mlp_impl == "moe":
+            from tpudp.models.moe import MoeMlp
+
+            return x + MoeMlp(
+                num_experts=cfg.num_experts,
+                mlp_ratio=cfg.mlp_ratio,
+                capacity_factor=cfg.capacity_factor,
+                expert_axis=cfg.expert_axis,
+                dtype=cfg.dtype,
+                name="moe",
+            )(ln("ln_2")(x))
         h = nn.Dense(cfg.mlp_ratio * cfg.d_model, dtype=cfg.dtype,
                      name="mlp_fc")(ln("ln_2")(x))
         h = nn.gelu(h)

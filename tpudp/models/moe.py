@@ -1,0 +1,130 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+Beyond-parity capability (SURVEY.md §2.2 lists EP/MoE as absent from the
+reference).  Switch-Transformer-style top-1 routing with a fixed per-expert
+capacity, so every shape is static and the whole layer stays jit/MXU
+friendly: dispatch and combine are one-hot einsums, expert FFNs run as one
+``vmap``-ed batched matmul over the expert axis.
+
+Expert parallelism is the TPU-native all-to-all pattern: expert weights are
+stacked ``(E, ...)`` and sharded over an ``expert`` mesh axis; inside
+``shard_map`` each device routes its local tokens to per-expert capacity
+slots, one ``lax.all_to_all`` regroups the slots so each device holds the
+tokens bound for *its* experts, the FFNs run locally, and the reverse
+``all_to_all`` brings results home for the weighted combine.  Without a
+bound expert axis the same module runs dense (all experts local) — init and
+single-device tests take that path with identical math, which is the oracle
+the EP tests compare against.
+
+Capacity overflow drops tokens (the standard Switch behavior): a dropped
+token contributes zero from the MoE layer and rides the transformer block's
+residual connection unchanged.  Router balance metrics (per-expert load
+fraction and the Switch aux loss ``E * sum(f_e * P_e)``) are sown into the
+``intermediates`` collection for a trainer to pull and add to its loss.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpudp.mesh import axis_is_bound as _axis_is_bound
+
+
+class MoeMlp(nn.Module):
+    """Drop-in MLP replacement: ``(..., d) -> (..., d)``.
+
+    Attributes:
+      num_experts: global expert count E.
+      mlp_ratio: hidden width multiplier (f = mlp_ratio * d).
+      capacity_factor: per-expert slots = ceil(cf * local_tokens / E).
+      expert_axis: mesh axis to shard experts over (None/unbound = dense).
+      dtype: compute dtype (params stay fp32, router runs fp32).
+    """
+
+    num_experts: int = 8
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    expert_axis: str | None = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        d = x.shape[-1]
+        f = self.mlp_ratio * d
+        e = self.num_experts
+        orig_shape = x.shape
+        xt = x.reshape(-1, d)
+        t = xt.shape[0]
+
+        gate = self.param("gate", nn.initializers.lecun_normal(), (d, e),
+                          jnp.float32)
+        # Stacked expert FFNs; the leading E axis is what expert parallelism
+        # shards.  Inside shard_map the leaves arrive pre-sharded, so the
+        # declared shape is the LOCAL expert count (init always runs
+        # unbound -> full (E, ...) shapes).
+        ep = self.expert_axis is not None and _axis_is_bound(self.expert_axis)
+        n = lax.axis_size(self.expert_axis) if ep else 1
+        if e % n:
+            raise ValueError(
+                f"{e} experts not divisible by expert-axis size {n}")
+        e_local = e // n
+        w1 = self.param("experts_w1", nn.initializers.lecun_normal(),
+                        (e_local, d, f), jnp.float32)
+        b1 = self.param("experts_b1", nn.initializers.zeros, (e_local, f),
+                        jnp.float32)
+        w2 = self.param("experts_w2", nn.initializers.lecun_normal(),
+                        (e_local, f, d), jnp.float32)
+        b2 = self.param("experts_b2", nn.initializers.zeros, (e_local, d),
+                        jnp.float32)
+
+        # --- route (fp32 for a stable softmax/argmax) ---
+        logits = xt.astype(jnp.float32) @ gate
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)
+        top_p = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+
+        capacity = max(int(math.ceil(self.capacity_factor * t / e)), 1)
+        position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based queue slot
+        keep = (position > 0) & (position <= capacity)
+        slot = jax.nn.one_hot(
+            jnp.clip(position.astype(jnp.int32) - 1, 0, capacity - 1),
+            capacity, dtype=jnp.float32)
+        dispatch = slot * keep[..., None].astype(jnp.float32)  # (T, E, C)
+
+        # balance metrics for an aux loss (Switch: E * sum(f_e * P_e))
+        load_fraction = onehot.mean(axis=0)
+        self.sow("intermediates", "moe_load", load_fraction)
+        self.sow("intermediates", "moe_aux",
+                 e * jnp.sum(load_fraction * probs.mean(axis=0)))
+
+        expert_inputs = jnp.einsum(
+            "tec,td->ecd", dispatch, xt.astype(jnp.float32)
+        ).astype(self.dtype)  # (E, C, d)
+
+        if ep:
+            # slots for my experts, gathered from every peer
+            expert_inputs = lax.all_to_all(
+                expert_inputs, self.expert_axis, split_axis=0, concat_axis=1,
+                tiled=True)  # (E_local, C * n, d)
+
+        def ffn(w1_e, b1_e, w2_e, b2_e, xe):
+            h = nn.gelu(xe @ w1_e.astype(self.dtype) + b1_e.astype(self.dtype))
+            return h @ w2_e.astype(self.dtype) + b2_e.astype(self.dtype)
+
+        expert_outputs = jax.vmap(ffn)(w1, b1, w2, b2, expert_inputs)
+
+        if ep:
+            expert_outputs = lax.all_to_all(
+                expert_outputs, self.expert_axis, split_axis=1, concat_axis=0,
+                tiled=True)  # back to (E, C, d), my tokens' slots
+
+        combine = dispatch * top_p[:, None, None]  # (T, E, C)
+        y = jnp.einsum("ecd,tec->td", expert_outputs.astype(jnp.float32),
+                       combine)
+        return y.astype(self.dtype).reshape(orig_shape)

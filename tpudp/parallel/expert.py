@@ -1,0 +1,135 @@
+"""Expert parallelism: DP x EP train step for MoE models.
+
+Beyond-parity capability (SURVEY.md §2.2: no MoE anywhere in the
+reference).  The mesh is ``(data, expert)``: the token batch shards over
+BOTH axes (every device is a data-parallel worker), while the stacked
+``(E, ...)`` expert FFN weights shard their leading axis over ``expert``
+only — so devices in the same expert-column hold the same experts and
+devices in the same data-row hold disjoint ones.  Token routing crosses the
+``expert`` axis via ``lax.all_to_all`` inside the model
+(tpudp/models/moe.py); this module supplies the matching gradient assembly:
+
+  * shared params (attention, norms, router gate, embeddings): local grads
+    mean-reduced over the WHOLE mesh — the plain DP contract.
+  * expert params: devices in one expert-column compute grads for the same
+    expert slice from different data shards -> mean over ``data`` only,
+    then divide by the ``expert``-axis size so the result is the gradient
+    of the same global-mean loss the shared params use (other columns
+    contribute exactly zero to these experts, so the division replaces the
+    missing zero terms of a whole-mesh mean).
+
+Verified against the dense single-device oracle in tests/test_expert.py
+(exact trajectory match when capacity is large enough that no token drops;
+capacity is a function of local token count, so drop *patterns* — like the
+reference's per-rank BatchNorm statistics, SURVEY.md §7 — legitimately
+depend on the partitioning).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudp.mesh import DATA_AXIS
+
+EXPERT_AXIS = "expert"
+
+
+def expert_spec_tree(tree: Any, expert_axis: str = EXPERT_AXIS) -> Any:
+    """Per-leaf specs: stacked expert weights (param names prefixed
+    ``experts_``, and their momentum twins) shard their leading E axis over
+    ``expert``; everything else replicates."""
+
+    def one(path, _leaf):
+        name = jax.tree_util.keystr(path)
+        return P(expert_axis) if "experts_" in name else P()
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def make_ep_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state,
+    *,
+    data_axis: str = DATA_AXIS,
+    expert_axis: str = EXPERT_AXIS,
+    aux_loss_coef: float = 0.01,
+    donate: bool = True,
+):
+    """Build ``(ep_state, step_fn)`` with the framework-wide step contract
+    ``step_fn(state, tokens, targets) -> (state, loss)``.
+
+    ``model`` must be built with ``expert_axis=expert_axis`` so its MoE
+    layers issue the all_to_all when the axis is bound.
+
+    ``aux_loss_coef`` weights the Switch load-balancing loss the MoE layers
+    sow (``E * sum(f_e * P_e)``, minimized at 1 by uniform routing) — it
+    keeps the top-1 router from collapsing onto few experts and overflowing
+    their capacity.  The returned/logged loss stays the pure CE term so it
+    remains comparable across rungs; set 0.0 to disable balancing."""
+    n_exp = mesh.shape[expert_axis]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        if "experts_" in jax.tree_util.keystr(path) and leaf.shape[0] % n_exp:
+            raise ValueError(
+                f"{leaf.shape[0]} experts not divisible by expert-axis "
+                f"size {n_exp} ({jax.tree_util.keystr(path)})")
+
+    def body(st, tokens, targets):
+        def loss_fn(params):
+            logits, inter = model.apply(
+                {"params": params}, tokens, train=True,
+                mutable=["intermediates"])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+            aux = 0.0
+            if aux_loss_coef:
+                auxes = [v for path, v in
+                         jax.tree_util.tree_flatten_with_path(inter)[0]
+                         if "moe_aux" in jax.tree_util.keystr(path)]
+                if auxes:
+                    aux = aux_loss_coef * sum(auxes) / len(auxes)
+            return ce + aux, ce
+
+        (_, loss), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(st.params)
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: (
+                lax.pmean(g, data_axis) / n_exp
+                if "experts_" in jax.tree_util.keystr(path)
+                else lax.pmean(g, (data_axis, expert_axis))),
+            grads)
+        loss = lax.pmean(loss, (data_axis, expert_axis))
+        updates, new_opt = tx.update(grads, st.opt_state, st.params)
+        new_params = optax.apply_updates(st.params, updates)
+        return st.replace(
+            step=st.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            loss_sum=st.loss_sum + loss,
+        ), loss
+
+    state_specs = expert_spec_tree(state, expert_axis)
+    tok_spec = P((data_axis, expert_axis))
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, tok_spec, tok_spec),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    step = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    placed = jax.device_put(
+        state,
+        jax.tree.map(lambda sp: NamedSharding(mesh, sp), state_specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+    return placed, step
