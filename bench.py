@@ -28,6 +28,13 @@ BASELINE_4NODE_GLOO_IPS = 4 * TORCH_CPU_IMAGES_PER_SEC
 
 def main() -> None:
     import jax
+
+    # The axon sitecustomize pins jax_platforms to the TPU plugin; plain
+    # JAX_PLATFORMS env is ignored.  BENCH_PLATFORM=cpu (+
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N) runs the bench
+    # logic on a simulated mesh for smoke testing.
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     import jax.numpy as jnp
     import numpy as np
 
@@ -58,13 +65,14 @@ def main() -> None:
         jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data")),
     )
 
+    from tpudp.utils.profiler import fetch_fence
+
     def fence(s):
         # Under the axon relay even jax.block_until_ready can return before
         # compute finishes; a device->host fetch of a param leaf is the only
         # reliable barrier (verified: it changes measured step time ~100x on
         # large programs).  The fetched leaf depends on the whole update.
-        leaf = jax.tree.leaves(s.params)[0]
-        np.asarray(leaf).ravel()[0]
+        fetch_fence(s.params)
 
     for _ in range(warmup):
         state, loss = step(state, images, labels)
@@ -78,6 +86,26 @@ def main() -> None:
 
     ips = steps * batch / dt
     ips_per_chip = ips / n_dev
+
+    # North-star companion metric (BASELINE.json:2): wall-time of the DP
+    # gradient all-reduce over this mesh, on a pytree shaped like the
+    # model's gradients.  Guarded by a join-timeout so a wedged relay can
+    # never stop the headline JSON line from printing (the thread is a
+    # daemon; a hang here abandons the measurement, not the benchmark).
+    coll = {"allreduce_wall_time_s": None, "bytes": None, "gbps": None}
+
+    def _measure():
+        from tpudp.utils.profiler import measure_collective
+
+        grad_shaped = jax.tree.map(jnp.zeros_like, state.params)
+        coll.update(measure_collective(mesh, grad_shaped, steps=10, warmup=2))
+
+    import threading
+
+    th = threading.Thread(target=_measure, daemon=True)
+    th.start()
+    th.join(timeout=float(os.environ.get("BENCH_COLLECTIVE_TIMEOUT", 120)))
+
     print(json.dumps({
         "metric": "vgg11_cifar10_images_per_sec_per_chip",
         "value": round(ips_per_chip, 1),
@@ -90,6 +118,12 @@ def main() -> None:
         "sec_per_step": round(dt / steps, 5),
         "baseline_4node_gloo_images_per_sec": BASELINE_4NODE_GLOO_IPS,
         "final_loss": round(float(loss), 4),
+        "grad_allreduce_wall_time_s": (
+            round(coll["allreduce_wall_time_s"], 6)
+            if coll["allreduce_wall_time_s"] is not None else None),
+        "grad_bytes": coll["bytes"],
+        "allreduce_gbps": (round(coll["gbps"], 2)
+                           if coll["gbps"] is not None else None),
     }))
 
 

@@ -1,0 +1,96 @@
+"""Failure-detection watchdog: hang detection, callbacks, fast path."""
+
+import time
+
+import pytest
+
+from tpudp.utils.watchdog import StepHangError, Watchdog, check_finite
+
+
+def test_fast_steps_never_trip():
+    wd = Watchdog(timeout_s=0.5, kill=False, poll_s=0.02).start()
+    try:
+        for _ in range(20):
+            with wd.step():
+                pass
+    finally:
+        wd.stop()
+    assert not wd._hang_seen.is_set()
+
+
+def test_hang_detected_and_callbacks_fire():
+    fired = []
+    wd = Watchdog(timeout_s=0.1, kill=False, poll_s=0.02,
+                  on_hang=[lambda: fired.append("dump")]).start()
+    try:
+        with wd.step():
+            time.sleep(0.4)  # exceeds deadline while armed
+        with pytest.raises(StepHangError):
+            with wd.step():
+                pass
+    finally:
+        wd.stop()
+    assert fired == ["dump"]
+
+
+def test_callback_exception_does_not_break_monitor():
+    def boom():
+        raise RuntimeError("cb failed")
+
+    fired = []
+    wd = Watchdog(timeout_s=0.1, kill=False, poll_s=0.02,
+                  on_hang=[boom, lambda: fired.append("second")]).start()
+    try:
+        with wd.step():
+            time.sleep(0.4)
+    finally:
+        wd.stop()
+    assert fired == ["second"]
+
+
+def test_idle_periods_are_not_hangs():
+    wd = Watchdog(timeout_s=0.1, kill=False, poll_s=0.02).start()
+    try:
+        time.sleep(0.3)  # not armed -> no deadline
+        with wd.step():
+            pass
+    finally:
+        wd.stop()
+    assert not wd._hang_seen.is_set()
+
+
+def test_heartbeat_mode_covers_slow_gaps():
+    """No beat within the timeout -> hang; regular beats -> no hang."""
+    wd = Watchdog(timeout_s=0.15, kill=False, poll_s=0.02).start()
+    try:
+        wd.arm()
+        for _ in range(5):
+            time.sleep(0.05)  # gaps well under the timeout
+            wd.beat()
+        assert not wd._hang_seen.is_set()
+        time.sleep(0.4)  # a wedged blocking call: no beats
+        with pytest.raises(StepHangError):
+            wd.beat()
+        wd.disarm()
+    finally:
+        wd.stop()
+
+
+def test_disarmed_idle_is_not_a_hang():
+    wd = Watchdog(timeout_s=0.1, kill=False, poll_s=0.02).start()
+    try:
+        wd.arm()
+        wd.beat()
+        wd.disarm()
+        time.sleep(0.3)  # idle but disarmed
+        assert not wd._hang_seen.is_set()
+    finally:
+        wd.stop()
+
+
+def test_check_finite():
+    assert check_finite(1.25) == 1.25
+    with pytest.raises(FloatingPointError, match="step 7"):
+        check_finite(float("nan"), step=7)
+    with pytest.raises(FloatingPointError):
+        check_finite(float("inf"))

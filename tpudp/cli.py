@@ -62,6 +62,18 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="batches prepared ahead on a background thread "
                         "(reference DataLoader num_workers=2 analogue); "
                         "0 disables")
+    p.add_argument("--profile-dir", type=str, default=None,
+                   help="capture an XLA/TPU profiler trace of the training "
+                        "run into this directory (TensorBoard trace-viewer "
+                        "format; beyond-reference capability)")
+    p.add_argument("--step-timeout", type=float, default=None,
+                   help="failure detection: exit if training makes no "
+                        "iteration progress for this many seconds (wedged "
+                        "collective, dead peer host) so the scheduler can "
+                        "restart + --checkpoint-dir resume. Must exceed one "
+                        "full log window (log-every steps) plus first-step "
+                        "compile time. The reference hangs forever in this "
+                        "case (SURVEY.md §5); default: disabled")
     return p
 
 
@@ -122,8 +134,20 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     model = VGG11(dtype=dtype)
+    watchdog = None
+    if args.step_timeout:
+        from tpudp.utils.watchdog import Watchdog
+
+        watchdog = Watchdog(
+            timeout_s=args.step_timeout,
+            on_hang=[lambda: print(
+                f"[tpudp] FAILURE DETECTED: step exceeded "
+                f"{args.step_timeout}s (wedged collective or dead peer); "
+                "exiting for scheduler restart", flush=True)],
+        ).start()
     trainer = Trainer(model, mesh, sync, seed=args.seed,
-                      spmd_mode=spmd_mode, timing_mode=args.timing_mode)
+                      spmd_mode=spmd_mode, timing_mode=args.timing_mode,
+                      watchdog=watchdog)
     print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
           f"data={data_backend}+prefetch{args.prefetch}")
@@ -149,6 +173,13 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
             save_checkpoint(path, trainer.state)
             print(f"[tpudp] saved checkpoint {path}")
 
-    trainer.fit(train_loader, test_loader, epochs=args.epochs,
-                start_epoch=start_epoch, epoch_end_fn=epoch_end_fn)
+    from tpudp.utils.profiler import trace
+
+    with trace(args.profile_dir):
+        trainer.fit(train_loader, test_loader, epochs=args.epochs,
+                    start_epoch=start_epoch, epoch_end_fn=epoch_end_fn)
+    if watchdog is not None:
+        watchdog.stop()
+    if args.profile_dir:
+        print(f"[tpudp] profiler trace written to {args.profile_dir}")
     return trainer

@@ -27,10 +27,11 @@ from tpudp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD, Dataset
 from tpudp.data.sampler import ShardedSampler
 
 
-def normalize_batch(images_u8: np.ndarray) -> np.ndarray:
-    """uint8 (B,32,32,3) -> normalized float32, the ToTensor+Normalize pair."""
+def normalize_batch(images_u8: np.ndarray, mean: np.ndarray = CIFAR10_MEAN,
+                    std: np.ndarray = CIFAR10_STD) -> np.ndarray:
+    """uint8 (B,H,W,3) -> normalized float32, the ToTensor+Normalize pair."""
     x = images_u8.astype(np.float32) / 255.0
-    return (x - CIFAR10_MEAN) / CIFAR10_STD
+    return (x - mean) / std
 
 
 def draw_augment_params(
@@ -83,9 +84,19 @@ class DataLoader:
         seed: int = 0,
         drop_last: bool | None = None,
         backend: str = "auto",
+        mean: np.ndarray | None = None,
+        std: np.ndarray | None = None,
+        pad: int = 4,
     ):
+        """``mean``/``std``/``pad`` default to the reference's CIFAR-10
+        constants (``src/Part 2a/main.py:24-31``); pass ImageNet values for
+        224-geometry datasets — the augmentation pipeline is size-agnostic."""
         self.dataset = dataset
         self.batch_size = batch_size
+        self.mean = np.asarray(CIFAR10_MEAN if mean is None else mean,
+                               np.float32)
+        self.std = np.asarray(CIFAR10_STD if std is None else std, np.float32)
+        self.pad = pad
         self.sampler = sampler or ShardedSampler(
             len(dataset.images), shuffle=train, seed=seed
         )
@@ -137,15 +148,18 @@ class DataLoader:
                 labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
                 weights = np.concatenate([weights, np.zeros(pad, np.float32)])
             if self.train:
-                offsets, flips = draw_augment_params(len(images), aug_rng)
+                offsets, flips = draw_augment_params(
+                    len(images), aug_rng, crop_range=2 * self.pad + 1)
                 if use_native:
                     images = native.augment_normalize(
-                        images, offsets, flips, CIFAR10_MEAN, CIFAR10_STD)
+                        images, offsets, flips, self.mean, self.std,
+                        pad=self.pad)
                 else:
                     images = normalize_batch(
-                        apply_crop_flip(images, offsets, flips))
+                        apply_crop_flip(images, offsets, flips, pad=self.pad),
+                        self.mean, self.std)
             elif use_native:
-                images = native.normalize(images, CIFAR10_MEAN, CIFAR10_STD)
+                images = native.normalize(images, self.mean, self.std)
             else:
-                images = normalize_batch(images)
+                images = normalize_batch(images, self.mean, self.std)
             yield images, labels.astype(np.int32), weights

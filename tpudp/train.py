@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudp.mesh import DATA_AXIS
 from tpudp.parallel.sync import get_sync
+from tpudp.utils.watchdog import check_finite
 
 
 class TrainState(struct.PyTreeNode):
@@ -379,10 +380,12 @@ class Trainer:
         timing_mode: str = "fused",
         log_every: int = 20,
         log_fn: Callable[[str], None] = print,
+        watchdog=None,
     ):
         self.model = model
         self.mesh = mesh
         self.sync = sync
+        self.watchdog = watchdog  # tpudp.utils.watchdog.Watchdog or None
         self.tx = make_optimizer(learning_rate, momentum, weight_decay)
         self.state = init_state(model, self.tx, seed=seed)
         self.timing_mode = timing_mode
@@ -425,6 +428,7 @@ class Trainer:
         prev_loss_sum = float(self.state.loss_sum)
         window_start = time.perf_counter()
         it = 0
+        beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         for it, (images, labels, _w) in enumerate(loader, start=1):
             images, labels = self._device_batch(images, labels)
             if self.timing_mode == "split":
@@ -447,7 +451,8 @@ class Trainer:
                 jax.block_until_ready(self.state)
                 window_time = time.perf_counter() - window_start
                 cum = float(self.state.loss_sum)
-                losses.append((cum - prev_loss_sum) / self.log_every)
+                losses.append(check_finite(
+                    (cum - prev_loss_sum) / self.log_every, step=it))
                 prev_loss_sum = cum
                 self.log(
                     "Training loss after {} iterations is {}".format(it, losses[-1])
@@ -462,14 +467,18 @@ class Trainer:
                         it, window_time / self.log_every))
                 fwd_t, bwd_t = 0.0, 0.0
                 window_start = time.perf_counter()
+            beat()  # watchdog heartbeat: an iteration completed
         if it % self.log_every:  # flush ragged final window
             cum = float(self.state.loss_sum)
-            losses.append((cum - prev_loss_sum) / (it % self.log_every))
+            losses.append(check_finite(
+                (cum - prev_loss_sum) / (it % self.log_every), step=it))
+            beat()
         return float(np.mean(losses)) if losses else 0.0
 
     def evaluate(self, loader) -> tuple[float, float]:
         """Full test pass; returns (avg_loss_per_sample, accuracy)."""
         # accumulate on device; fetch once at the end (async-dispatch friendly)
+        beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         loss_sum = correct = count = jnp.zeros((), jnp.float32)
         for images, labels, weights in loader:
             images, labels = self._device_batch(images, labels)
@@ -477,6 +486,7 @@ class Trainer:
                 weights = self._put(weights)
             ls, c, n = self.eval_step(self.state, images, labels, weights)
             loss_sum, correct, count = loss_sum + ls, correct + c, count + n
+            beat()
         loss_sum, correct, count = (float(loss_sum), float(correct),
                                     max(float(count), 1.0))
         avg_loss = loss_sum / count
@@ -492,7 +502,24 @@ class Trainer:
             *, start_epoch: int = 0, epoch_end_fn=None) -> None:
         """The reference's epoch loop (``src/Part 2a/main.py:64-68``).
         ``start_epoch`` supports checkpoint resume; ``epoch_end_fn(epoch)``
-        runs after each epoch's eval (checkpoint hook)."""
+        runs after each epoch's eval (checkpoint hook).
+
+        With a watchdog attached, the whole loop runs under heartbeat
+        monitoring: every train/eval iteration beats, so any blocking host
+        call in between (window fetch, epoch barrier, eval) is covered —
+        the timeout bounds the gap between completed iterations and must
+        exceed one full log window plus the first-step compile."""
+        if self.watchdog is not None:
+            self.watchdog.arm()
+        try:
+            self._fit(train_loader, test_loader, epochs, start_epoch,
+                      epoch_end_fn)
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+
+    def _fit(self, train_loader, test_loader, epochs, start_epoch,
+             epoch_end_fn) -> None:
         for epoch in range(start_epoch, epochs):
             start = time.perf_counter()
             self.train_epoch(train_loader, epoch)

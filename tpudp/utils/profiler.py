@@ -1,0 +1,105 @@
+"""Profiling and tracing.
+
+The reference's entire observability story is ``time.time()`` brackets and
+``print`` (SURVEY.md §5: no profiler, no traces).  tpudp keeps those
+parity metrics (tpudp/utils/timing.py, Trainer's window prints) and adds
+the TPU-native layer the reference never had:
+
+  * :func:`trace` — capture a real XLA/TPU profile (TensorBoard `trace
+    viewer` format) around any region, with per-step boundaries marked via
+    :class:`jax.profiler.StepTraceAnnotation` so the trace viewer groups
+    work by training step.
+  * :func:`measure_collective` — the north-star "grad all-reduce wall-time"
+    metric (BASELINE.json:2): times a jitted shard_map psum over a pytree
+    shaped exactly like the model's gradients, fetch-fenced (see
+    BASELINE.md on why ``block_until_ready`` alone is not a barrier under
+    the axon relay).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudp.mesh import DATA_AXIS
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None) -> Iterator[None]:
+    """XLA profiler capture into ``log_dir`` (no-op when None).  View with
+    TensorBoard's profile plugin or xprof."""
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def step_annotation(step: int):
+    """Mark a training step in an active trace."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+def fetch_fence(tree: Any) -> None:
+    """Device->host fetch of one leaf element — the only reliable compute
+    barrier under relay transports (BASELINE.md); the single shared
+    implementation used by bench.py and the collective timer."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return
+    np.asarray(jax.device_get(leaves[0].ravel()[0]))
+
+
+def measure_collective(
+    mesh: Mesh,
+    grad_tree: Any,
+    *,
+    axis: str = DATA_AXIS,
+    steps: int = 20,
+    warmup: int = 3,
+) -> dict:
+    """Wall-time one mean-all-reduce of ``grad_tree`` over ``mesh``.
+
+    Returns ``{"allreduce_wall_time_s", "bytes", "gbps"}`` — the measured
+    cost of exactly the collective every DP sync strategy issues per step
+    (reference analogue: the Gloo ``all_reduce`` in
+    ``src/Part 2b/main.py:118``, there paid once PER PARAMETER; here one
+    fused all-reduce over the whole tree).
+    """
+    size = mesh.shape[axis]
+
+    def body(tree):
+        return jax.tree.map(
+            lambda g: jax.lax.psum(g, axis) / size, tree)
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(),), out_specs=P(), check_vma=False))
+
+    tree = jax.device_put(
+        grad_tree, jax.sharding.NamedSharding(mesh, P()))
+    out = fn(tree)
+    fetch_fence(out)  # compile + warm
+    for _ in range(warmup):
+        out = fn(tree)
+    fetch_fence(out)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(out)
+    fetch_fence(out)
+    dt = (time.perf_counter() - t0) / steps
+
+    nbytes = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(grad_tree))
+    # ring all-reduce moves 2(n-1)/n of the payload per device
+    wire = 2 * (size - 1) / size * nbytes if size > 1 else 0
+    return {
+        "allreduce_wall_time_s": dt,
+        "bytes": nbytes,
+        "gbps": (wire / dt / 1e9) if dt > 0 else 0.0,
+    }
