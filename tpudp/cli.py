@@ -58,6 +58,11 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    default="auto",
                    help="host augmentation backend: fused C++/OpenMP kernel "
                         "(tpudp/native) or bit-identical numpy")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="split each device batch into N sequential "
+                        "microbatches, accumulating gradients before the "
+                        "sync+update (trade steps for activation memory; "
+                        "beyond-reference capability)")
     p.add_argument("--prefetch", type=int, default=2,
                    help="batches prepared ahead on a background thread "
                         "(reference DataLoader num_workers=2 analogue); "
@@ -147,7 +152,7 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         ).start()
     trainer = Trainer(model, mesh, sync, seed=args.seed,
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode,
-                      watchdog=watchdog)
+                      watchdog=watchdog, grad_accum=args.grad_accum)
     print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
           f"data={data_backend}+prefetch{args.prefetch}")

@@ -128,6 +128,41 @@ def state_shardings(state: Any, mesh: Mesh, rules: Rules) -> Any:
     return tree_shardings(state, mesh, rules)
 
 
+def fsdp_shardings(
+    tree: Any,
+    mesh: Mesh,
+    axis: str = "data",
+    *,
+    min_size: int = 1024,
+) -> Any:
+    """ZeRO-3/FSDP-style shardings: every large leaf shards its first
+    ``axis``-divisible dimension over the DATA axis, so each device stores
+    only ``1/N`` of the parameters and optimizer state.
+
+    This is the TPU-native FSDP: no gather/scatter bookkeeping code — the
+    sharding annotation alone makes XLA all-gather each parameter just
+    before use in the forward/backward and reduce-scatter its gradient,
+    overlapping both with compute.  Leaves smaller than ``min_size``
+    elements stay replicated (the collective would cost more than the
+    memory saved — FSDP implementations have the same threshold knob).
+    Applies uniformly to params and momentum (same tree shapes).
+    """
+    n = mesh.shape[axis]
+
+    def one(leaf):
+        shape = getattr(leaf, "shape", ())
+        size = int(np.prod(shape)) if shape else 0
+        if size < min_size:
+            return NamedSharding(mesh, P())
+        for dim, d in enumerate(shape):
+            if d % n == 0:
+                spec = [None] * dim + [axis]  # trailing dims implicit
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(one, tree)
+
+
 def shard_state(state: Any, mesh: Mesh, rules: Rules) -> Any:
     """Device-put an (unsharded) TrainState onto its TP layout."""
     return jax.device_put(state, state_shardings(state, mesh, rules))
