@@ -10,10 +10,22 @@ numbers, so the denominator is re-measured on this machine:
 times the identical workload, and 4-node Gloo is bounded above by 4x that
 single-process number (perfect scaling, zero comm cost — a *generous*
 baseline).  See BASELINE.md "Measured values".
+
+Reliability (round-1 postmortem): the TPU backend behind the axon relay can
+(a) raise transient ``UNAVAILABLE`` at init, or (b) HANG in device discovery
+with no exception to catch.  BENCH_r01 died on (a) with rc=1 and no JSON.
+So the measurement now runs in a CHILD process (``BENCH_CHILD=1``): the
+parent retries crashed/hung children with backoff and, if every attempt
+fails, still emits one parseable JSON line recording the error — the
+headline line always prints.
+
+Env knobs: BENCH_TRIES (3), BENCH_TIMEOUT (600s per attempt), BENCH_BATCH,
+BENCH_STEPS, BENCH_WARMUP, BENCH_DTYPE, BENCH_PLATFORM (cpu smoke mode).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -25,8 +37,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 TORCH_CPU_IMAGES_PER_SEC = 66.17
 BASELINE_4NODE_GLOO_IPS = 4 * TORCH_CPU_IMAGES_PER_SEC
 
+METRIC = "vgg11_cifar10_images_per_sec_per_chip"
 
-def main() -> None:
+
+def child_main() -> None:
+    """One measurement attempt; prints the JSON line on success."""
     import jax
 
     # The axon sitecustomize pins jax_platforms to the TPU plugin; plain
@@ -41,6 +56,7 @@ def main() -> None:
     from tpudp.mesh import make_mesh
     from tpudp.models.vgg import VGG11
     from tpudp.train import init_state, make_optimizer, make_train_step
+    from tpudp.utils.flops import mfu, train_step_flops, vgg_fwd_flops
 
     batch = int(os.environ.get("BENCH_BATCH", 256))
     steps = int(os.environ.get("BENCH_STEPS", 50))
@@ -50,6 +66,7 @@ def main() -> None:
 
     mesh = make_mesh()
     n_dev = mesh.size
+    device_kind = jax.devices()[0].device_kind
     model = VGG11(dtype=dtype)
     tx = make_optimizer()
     state = init_state(model, tx)
@@ -86,6 +103,11 @@ def main() -> None:
 
     ips = steps * batch / dt
     ips_per_chip = ips / n_dev
+    sec_per_step = dt / steps
+
+    # Single-chip perf criterion: analytic model FLOPs / (time * peak).
+    flops_per_step = train_step_flops(vgg_fwd_flops(batch))
+    step_mfu = mfu(flops_per_step, sec_per_step, device_kind, n_dev)
 
     # North-star companion metric (BASELINE.json:2): wall-time of the DP
     # gradient all-reduce over this mesh, on a pytree shaped like the
@@ -107,15 +129,18 @@ def main() -> None:
     th.join(timeout=float(os.environ.get("BENCH_COLLECTIVE_TIMEOUT", 120)))
 
     print(json.dumps({
-        "metric": "vgg11_cifar10_images_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(ips_per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips / BASELINE_4NODE_GLOO_IPS, 2),
         "images_per_sec_total": round(ips, 1),
         "devices": n_dev,
+        "device_kind": device_kind,
         "global_batch": batch,
         "dtype": dtype_name,
-        "sec_per_step": round(dt / steps, 5),
+        "sec_per_step": round(sec_per_step, 5),
+        "mfu": round(step_mfu, 4) if step_mfu is not None else None,
+        "model_flops_per_step": flops_per_step,
         "baseline_4node_gloo_images_per_sec": BASELINE_4NODE_GLOO_IPS,
         "final_loss": round(float(loss), 4),
         "grad_allreduce_wall_time_s": (
@@ -125,6 +150,72 @@ def main() -> None:
         "allreduce_gbps": (round(coll["gbps"], 2)
                            if coll["gbps"] is not None else None),
     }))
+
+
+def _extract_json_line(text: str) -> str | None:
+    """Last stdout line that parses as the headline JSON object."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            if json.loads(line).get("metric") == METRIC:
+                return line
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD"):
+        child_main()
+        return
+
+    tries = int(os.environ.get("BENCH_TRIES", 3))
+    timeout = float(os.environ.get("BENCH_TIMEOUT", 600))
+    errors: list[str] = []
+    for attempt in range(tries):
+        if attempt:
+            delay = 20.0 * (2 ** (attempt - 1))
+            print(f"[bench] attempt {attempt} failed "
+                  f"({errors[-1][:200]}); retrying in {delay:.0f}s",
+                  file=sys.stderr, flush=True)
+            time.sleep(delay)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, "BENCH_CHILD": "1"},
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt hung past {timeout:.0f}s "
+                          "(wedged backend init or device discovery)")
+            continue
+        line = _extract_json_line(proc.stdout)
+        if line:
+            # A parsed headline line is a successful measurement even if the
+            # child's exit was dirty (e.g. a wedged measure_collective daemon
+            # thread poisoning interpreter shutdown after the line printed).
+            if proc.returncode != 0:
+                print(f"[bench] child exited rc={proc.returncode} after "
+                      "printing a valid headline line; keeping it",
+                      file=sys.stderr, flush=True)
+            print(line)
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        errors.append(f"rc={proc.returncode}: "
+                      + (tail[-1] if tail else "no output"))
+
+    # Every attempt failed — the headline line must still parse.
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": f"all {tries} attempts failed",
+        "attempt_errors": [e[:500] for e in errors],
+    }))
+    sys.exit(0)
 
 
 if __name__ == "__main__":

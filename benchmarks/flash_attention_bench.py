@@ -1,14 +1,22 @@
 """Microbench: Pallas flash attention vs XLA dense attention (grad step).
 
-Source of the BASELINE.md flash-attention row. Run on the TPU chip:
+Source of the BASELINE.md flash-attention rows. Run on the TPU chip:
 
-    python benchmarks/flash_attention_bench.py [t]
+    python benchmarks/flash_attention_bench.py [t ...]     # default 4096 8192 16384
 
 Times a full gradient step (fwd+bwd) at GPT-2 head geometry, fetch-fenced
 (see BASELINE.md timing-honesty note: ``block_until_ready`` is not a
-reliable barrier under the axon relay).
+reliable barrier under the axon relay).  At long sequences the dense
+baseline materializes the (t, t) score matrix and runs out of HBM — the
+bench then halves the dense batch until it fits and normalizes times to
+per-sample, so the ratio stays an equal-work comparison (flash's memory is
+O(t·d), so its batch never shrinks).  Prints one JSON line per sequence
+length: flash/dense ms, the speedup ratio, and the flash kernel's MFU from
+the analytic attention FLOPs (7 blocked matmuls per grad step, halved by
+causality).
 """
 
+import json
 import sys
 import time
 
@@ -19,33 +27,83 @@ import numpy as np
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from tpudp.ops.flash_attention import flash_attention  # noqa: E402
+from tpudp.utils.flops import chip_peak_flops  # noqa: E402
 
 
-def main(t: int = 4096, b: int = 4, h: int = 12, dh: int = 64) -> None:
-    ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (jax.random.normal(kk, (b, t, h, dh), jnp.bfloat16) for kk in ks)
+def _time_grad(loss_fn, q, k, v, reps=10):
+    f = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))
+    for _ in range(3):
+        np.asarray(f(q, k, v)[0]).ravel()  # warmup + fence
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(q, k, v)
+    np.asarray(r[0]).ravel()  # fence
+    return (time.perf_counter() - t0) / reps
 
-    def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
 
-    def loss_dense(q, k, v):
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * dh ** -0.5
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        logits = jnp.where(mask[None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, -1).astype(jnp.bfloat16)
-        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(jnp.float32))
+def attention_grad_flops(b, t, h, dh, causal=True):
+    """fwd: QK^T + PV (2 matmuls); bwd: S recompute, dP, dQ, dK, dV (5) —
+    7 passes of 2*b*h*t^2*dh each, halved by the causal triangle."""
+    full = 7 * 2 * b * h * t * t * dh
+    return full // 2 if causal else full
 
-    for name, lf in [("flash", loss_flash), ("dense", loss_dense)]:
-        f = jax.jit(jax.grad(lf, argnums=(0, 1, 2)))
-        for _ in range(3):
-            np.asarray(f(q, k, v)[0]).ravel()  # warmup + fence
-        t0 = time.perf_counter()
-        reps = 10
-        for _ in range(reps):
-            r = f(q, k, v)
-        np.asarray(r[0]).ravel()  # fence
-        print(f"{name}: {(time.perf_counter() - t0) / reps * 1e3:.2f} ms/grad-step "
-              f"(b={b} t={t} h={h} dh={dh} bf16)")
+
+def main(*ts: int) -> None:
+    ts = ts or (4096, 8192, 16384)
+    b, h, dh = 4, 12, 64
+    kind = jax.devices()[0].device_kind
+    peak = chip_peak_flops(kind)
+
+    for t in ts:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, t, h, dh), jnp.bfloat16)
+                   for kk in ks)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True).astype(jnp.float32))
+
+        def make_loss_dense(tt):
+            def loss_dense(q, k, v):
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(
+                    jnp.float32) * dh ** -0.5
+                mask = jnp.tril(jnp.ones((tt, tt), bool))
+                logits = jnp.where(mask[None, None], logits, -1e30)
+                probs = jax.nn.softmax(logits, -1).astype(jnp.bfloat16)
+                return jnp.sum(
+                    jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(
+                        jnp.float32))
+            return loss_dense
+
+        flash_ms = _time_grad(loss_flash, q, k, v) * 1e3
+
+        dense_ms = None
+        dense_b = b
+        while dense_b >= 1:
+            try:
+                per = _time_grad(make_loss_dense(t),
+                                 q[:dense_b], k[:dense_b], v[:dense_b])
+                dense_ms = per * 1e3 * (b / dense_b)  # normalize to b samples
+                break
+            except Exception as e:  # RESOURCE_EXHAUSTED at long t
+                if "RESOURCE_EXHAUSTED" not in repr(e) and \
+                        "Out of memory" not in repr(e):
+                    raise
+                dense_b //= 2
+
+        flops = attention_grad_flops(b, t, h, dh)
+        row = {
+            "t": t, "b": b, "h": h, "dh": dh, "dtype": "bfloat16",
+            "flash_ms": round(flash_ms, 2),
+            "dense_ms": round(dense_ms, 2) if dense_ms else None,
+            "dense_batch": dense_b if dense_ms else 0,
+            "ratio_dense_over_flash": (round(dense_ms / flash_ms, 2)
+                                       if dense_ms else None),
+            "flash_mfu": (round(flops / (flash_ms / 1e3) / peak, 4)
+                          if peak else None),
+            "device_kind": kind,
+        }
+        print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,175 @@
+"""Full benchmark matrix — the BASELINE.json config list, measured.
+
+Covers (BASELINE.json configs[0-4] + the GSPMD/coordinator rungs):
+
+  part1_single   VGG-11 single-device baseline (reference Part 1)
+  dp_psum        VGG-11 DP, fused psum all-reduce (Part 2b analogue)
+  dp_ring        VGG-11 DP, manual ppermute ring all-reduce (north star)
+  dp_coordinator VGG-11 DP, gather->mean->broadcast (Part 2a analogue)
+  dp_gspmd       VGG-11 DP, XLA-partitioned (Part 3 analogue)
+  resnet50       ResNet-50 at ImageNet geometry, synthetic data, DP psum
+  gpt2_small     GPT-2-small (124M) DP, tokens/sec/chip
+
+Prints one JSON line per config (machine-readable) and a final summary
+line.  Each VGG DP config also reports the measured wall-time of its
+gradient collective so ring-vs-psum is a direct comparison.  Run on the
+TPU chip by default; MATRIX_PLATFORM=cpu (+ forced device count) for the
+simulated-mesh smoke mode.  Knobs: MATRIX_STEPS, MATRIX_WARMUP,
+MATRIX_CONFIGS (comma-separated subset).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(step, state, args, steps, warmup):
+    """Fenced sec/step for a (state, *args) -> (state, loss) step."""
+    from tpudp.utils.profiler import fetch_fence
+
+    for _ in range(warmup):
+        state, loss = step(state, *args)
+    fetch_fence(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, *args)
+    fetch_fence(state.params)
+    return (time.perf_counter() - t0) / steps, float(loss)
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("MATRIX_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["MATRIX_PLATFORM"])
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudp.mesh import make_mesh
+    from tpudp.models import VGG11, ResNet50
+    from tpudp.models.gpt2 import gpt2_small
+    from tpudp.train import init_state, make_optimizer, make_train_step
+    from tpudp.utils.flops import (gpt2_fwd_flops, mfu, resnet_fwd_flops,
+                                   train_step_flops, vgg_fwd_flops)
+    from tpudp.utils.profiler import measure_collective
+
+    steps = int(os.environ.get("MATRIX_STEPS", 30))
+    warmup = int(os.environ.get("MATRIX_WARMUP", 3))
+    only = os.environ.get("MATRIX_CONFIGS")
+    only = set(only.split(",")) if only else None
+
+    mesh = make_mesh()
+    n_dev = mesh.size
+    kind = jax.devices()[0].device_kind
+    rng = np.random.default_rng(0)
+    results = []
+
+    def emit(name, sec_per_step, loss, *, unit, per_sec, flops,
+             extra=None):
+        row = {
+            "config": name,
+            "sec_per_step": round(sec_per_step, 5),
+            "unit": unit,
+            "value": round(per_sec / n_dev, 1),
+            "total_per_sec": round(per_sec, 1),
+            "devices": n_dev,
+            "device_kind": kind,
+            "mfu": (round(m, 4)
+                    if (m := mfu(flops, sec_per_step, kind, n_dev))
+                    is not None else None),
+            "final_loss": round(loss, 4),
+        }
+        if extra:
+            row.update(extra)
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # ---- VGG-11 ladder -------------------------------------------------
+    vgg_batch = int(os.environ.get("MATRIX_VGG_BATCH", 256))
+    vgg_flops = train_step_flops(vgg_fwd_flops(vgg_batch))
+    images = jnp.asarray(rng.normal(size=(vgg_batch, 32, 32, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=vgg_batch), jnp.int32)
+    data_sh = jax.sharding.NamedSharding(mesh,
+                                         jax.sharding.PartitionSpec("data"))
+
+    vgg_ladder = [
+        ("part1_single", None, "none", "single"),
+        ("dp_psum", mesh, "allreduce", "shard_map"),
+        ("dp_ring", mesh, "ring", "shard_map"),
+        ("dp_coordinator", mesh, "coordinator", "shard_map"),
+        ("dp_gspmd", mesh, "allreduce", "gspmd"),
+    ]
+    grad_tree = None
+    for name, m, sync, mode in vgg_ladder:
+        if only and name not in only:
+            continue
+        model = VGG11(dtype=jnp.bfloat16)
+        tx = make_optimizer()
+        state = init_state(model, tx)
+        step = make_train_step(model, tx, m, sync, spmd_mode=mode,
+                               donate=False)
+        x = images if m is None else jax.device_put(images, data_sh)
+        y = labels if m is None else jax.device_put(labels, data_sh)
+        sec, loss = measure(step, state, (x, y), steps, warmup)
+        extra = {"sync": sync, "spmd_mode": mode}
+        if m is not None and n_dev > 1:
+            if grad_tree is None:
+                grad_tree = jax.tree.map(jnp.zeros_like, state.params)
+            coll = measure_collective(mesh, grad_tree, steps=10, warmup=2)
+            extra["grad_allreduce_wall_time_s"] = round(
+                coll["allreduce_wall_time_s"], 6)
+        emit(name, sec, loss, unit="images/sec/chip",
+             per_sec=vgg_batch / sec, flops=vgg_flops, extra=extra)
+
+    # ---- ResNet-50 at ImageNet geometry --------------------------------
+    if only is None or "resnet50" in only:
+        rn_batch = int(os.environ.get("MATRIX_RESNET_BATCH", 256))
+        image_size = int(os.environ.get("MATRIX_RESNET_IMAGE", 224))
+        model = ResNet50(dtype=jnp.bfloat16)
+        tx = make_optimizer()
+        state = init_state(model, tx,
+                           input_shape=(1, image_size, image_size, 3))
+        step = make_train_step(model, tx, mesh, "allreduce", donate=False)
+        x = jax.device_put(
+            jnp.asarray(rng.normal(size=(rn_batch, image_size, image_size, 3)),
+                        jnp.float32), data_sh)
+        y = jax.device_put(
+            jnp.asarray(rng.integers(0, 1000, size=rn_batch), jnp.int32),
+            data_sh)
+        sec, loss = measure(step, state, (x, y), steps, warmup)
+        emit("resnet50", sec, loss, unit="images/sec/chip",
+             per_sec=rn_batch / sec,
+             flops=train_step_flops(
+                 resnet_fwd_flops(rn_batch, image_size=image_size)),
+             extra={"global_batch": rn_batch, "image_size": image_size})
+
+    # ---- GPT-2-small ---------------------------------------------------
+    if only is None or "gpt2_small" in only:
+        g_batch = int(os.environ.get("MATRIX_GPT2_BATCH", 8))
+        seq = int(os.environ.get("MATRIX_GPT2_SEQ", 1024))
+        model = gpt2_small(dtype=jnp.bfloat16)
+        cfg = model.config
+        tx = make_optimizer(learning_rate=0.01)
+        state = init_state(model, tx, input_shape=(1, seq))
+        step = make_train_step(model, tx, mesh, "allreduce", donate=False)
+        toks = jax.device_put(
+            jnp.asarray(rng.integers(0, cfg.vocab_size, size=(g_batch, seq)),
+                        jnp.int32), data_sh)
+        tgts = jax.device_put(jnp.roll(toks, -1, axis=1), data_sh)
+        sec, loss = measure(step, state, (toks, tgts), steps, warmup)
+        emit("gpt2_small", sec, loss, unit="tokens/sec/chip",
+             per_sec=g_batch * seq / sec,
+             flops=train_step_flops(gpt2_fwd_flops(
+                 g_batch, seq, num_layers=cfg.num_layers,
+                 d_model=cfg.d_model, vocab_size=cfg.vocab_size,
+                 mlp_ratio=cfg.mlp_ratio)),
+             extra={"global_batch": g_batch, "seq_len": seq})
+
+    print(json.dumps({"matrix": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -110,7 +110,9 @@ def main() -> None:
         tokens, targets = sample_batch()
         state, _ = step(state, tokens, targets)
         if it % args.log_every == 0:
-            jax.block_until_ready(state)
+            from tpudp.utils.profiler import fetch_fence
+
+            fetch_fence(state.params)  # honest timing edge (BASELINE.md)
             cum = float(state.loss_sum)
             dt = time.perf_counter() - t0
             tok_s = args.log_every * args.batch_size * args.seq_len / dt

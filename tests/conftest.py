@@ -12,6 +12,8 @@ import sys
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Hermetic tests: never attempt the CIFAR-10 network fetch.
+os.environ.setdefault("TPUDP_NO_DOWNLOAD", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

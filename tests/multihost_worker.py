@@ -1,0 +1,85 @@
+"""Subprocess worker for tests/test_multihost.py (not itself a test module).
+
+One OS process per "host": forces a CPU backend with N local virtual
+devices, joins the jax.distributed rendezvous (the reference's
+``init_process`` analogue, ``src/Part 2a/main.py:148-153``), loads its
+host-local shard through ShardedSampler+DataLoader, and drives the Trainer
+— whose multi-process branch assembles global batches with
+``jax.make_array_from_process_local_data``.  Rank 0 writes the final loss,
+eval metrics, and parameters to a JSON file for trajectory comparison.
+
+Usage: python multihost_worker.py RANK NPROC PORT LOCAL_DEVICES OUT_JSON
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = int(sys.argv[3])
+    local_devices = int(sys.argv[4])
+    out_path = sys.argv[5]
+
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}")
+    os.environ.setdefault("TPUDP_NO_DOWNLOAD", "1")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpudp.mesh import initialize_distributed, make_mesh
+
+    if nproc > 1:
+        initialize_distributed("127.0.0.1", nproc, rank, port=port)
+
+    import flax.linen as nn
+    import numpy as np
+
+    from tpudp.data.cifar10 import _synthetic
+    from tpudp.data.loader import DataLoader
+    from tpudp.data.sampler import ShardedSampler
+    from tpudp.train import Trainer
+
+    class TinyNet(nn.Module):
+        """BatchNorm-free so the trajectory is invariant to how samples
+        land on devices (global-mean gradients only)."""
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(10)(x)
+
+    assert jax.process_count() == nproc
+    mesh = make_mesh()  # all global devices
+    global_batch = 8
+    ds = _synthetic(32, seed=7)
+    loader = DataLoader(
+        ds, global_batch // nproc,
+        sampler=ShardedSampler(len(ds.images), nproc, rank, shuffle=False),
+        train=False, backend="numpy")
+
+    trainer = Trainer(TinyNet(), mesh, "allreduce", learning_rate=0.01,
+                      log_every=2, log_fn=lambda s: None, seed=0)
+    loss = trainer.train_epoch(loader, 0)
+    eval_loss, eval_acc = trainer.evaluate(loader)
+
+    if rank == 0:
+        params = [np.asarray(jax.device_get(p)).ravel().tolist()
+                  for p in jax.tree.leaves(trainer.state.params)]
+        with open(out_path, "w") as f:
+            json.dump({"loss": loss, "eval_loss": eval_loss,
+                       "eval_acc": eval_acc, "params": params}, f)
+
+    if nproc > 1:
+        jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Integration: every advanced rung (TP/FSDP/PP/EP/SP) driven end-to-end by
+the Trainer — fit() with reference-format logging, sharded eval, watchdog
+heartbeats, and an orbax checkpoint round-trip (VERDICT r1 #5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudp.mesh import make_mesh, make_mesh_nd
+from tpudp.models.gpt2 import gpt2_small
+from tpudp.train import Trainer
+from tpudp.utils.checkpoint import restore_checkpoint, save_checkpoint
+from tpudp.utils.watchdog import Watchdog
+
+VOCAB, T, BATCH = 64, 16, 8
+DENSE = dict(vocab_size=VOCAB, max_seq_len=T, num_layers=2, num_heads=2,
+             d_model=32)
+MOE = dict(**DENSE, mlp_impl="moe", num_experts=4, capacity_factor=4.0)
+
+
+class TokenLoader:
+    """Tiny synthetic LM loader with the framework loader contract."""
+
+    def __init__(self, steps=4, seed=0):
+        rng = np.random.default_rng(seed)
+        toks = rng.integers(0, VOCAB, size=(steps, BATCH, T)).astype(np.int32)
+        self.batches = [
+            (jnp.asarray(x), jnp.roll(jnp.asarray(x), -1, axis=1),
+             jnp.ones((BATCH,), jnp.float32))
+            for x in toks
+        ]
+
+    def set_epoch(self, epoch):
+        pass
+
+    def __iter__(self):
+        return iter(self.batches)
+
+    def __len__(self):
+        return len(self.batches)
+
+
+def _drive(strategy, mesh, model_kwargs, options, tmp_path):
+    """fit + eval + checkpoint round-trip for one rung; returns log lines."""
+    lines = []
+    wd = Watchdog(timeout_s=300.0, kill=False, poll_s=0.1).start()
+    try:
+        trainer = Trainer(
+            gpt2_small(**model_kwargs), mesh,
+            strategy=strategy, strategy_options=options,
+            input_shape=(1, T), learning_rate=0.01, log_every=2,
+            log_fn=lines.append, watchdog=wd, seed=0)
+        loader = TokenLoader()
+        trainer.fit(loader, test_loader=loader, epochs=1)
+    finally:
+        wd.stop()
+
+    # reference-format logging reached the rung
+    assert any(l.startswith("Training loss after 2 iterations") for l in lines)
+    assert any(l.startswith("Training time after 1 epoch") for l in lines)
+    assert any(l.startswith("Test set: Average loss") for l in lines)
+
+    # eval contract: finite per-token loss, accuracy in [0, 1]
+    loss, acc = trainer.evaluate(loader)
+    assert np.isfinite(loss) and 0.0 <= acc <= 1.0
+
+    # checkpoint round-trip on the rung's (sharded) state
+    path = save_checkpoint(tmp_path / "ckpt", trainer.state)
+    fresh = Trainer(
+        gpt2_small(**model_kwargs), mesh,
+        strategy=strategy, strategy_options=options,
+        input_shape=(1, T), learning_rate=0.01, log_every=2,
+        log_fn=lambda s: None, seed=1)  # different seed: restore must win
+    restored = restore_checkpoint(path, fresh.state)
+    for a, b in zip(jax.tree.leaves(trainer.state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # the restored state drives the rung's step function
+    x, y, _ = next(iter(TokenLoader()))
+    if fresh._put is not None:
+        x, y = fresh._put(x), fresh._put(y)
+    _, loss2 = fresh.train_step(restored, x, y)
+    assert np.isfinite(float(loss2))
+    return lines
+
+
+def test_trainer_tp_rung(tmp_path):
+    from tpudp.parallel.tensor import gpt2_tp_rules
+
+    mesh = make_mesh_nd({"data": 2, "model": 2}, devices=jax.devices()[:4])
+    _drive("tp", mesh, DENSE, {"rules": gpt2_tp_rules()}, tmp_path)
+
+
+def test_trainer_fsdp_rung(tmp_path):
+    mesh = make_mesh(8)
+    _drive("fsdp", mesh, DENSE, {"min_size": 128}, tmp_path)
+
+
+def test_trainer_pp_rung(tmp_path):
+    mesh = make_mesh_nd({"data": 2, "pipe": 2}, devices=jax.devices()[:4])
+    _drive("pp", mesh, DENSE, {"n_microbatches": 2}, tmp_path)
+
+
+def test_trainer_ep_rung(tmp_path):
+    mesh = make_mesh_nd({"data": 2, "expert": 2}, devices=jax.devices()[:4])
+    _drive("ep", mesh, dict(**MOE, expert_axis="expert"), {}, tmp_path)
+
+
+def test_trainer_sp_rung(tmp_path):
+    mesh = make_mesh_nd({"data": 2, "seq": 2}, devices=jax.devices()[:4])
+    _drive("sp", mesh, dict(**DENSE, attn_impl="ring", seq_axis="seq"), {},
+           tmp_path)
+
+
+def test_trainer_rejects_bad_strategy_combos():
+    mesh = make_mesh(4)
+    with pytest.raises(ValueError, match="unknown strategy"):
+        Trainer(gpt2_small(**DENSE), mesh, strategy="zz", input_shape=(1, T))
+    with pytest.raises(ValueError, match="split"):
+        Trainer(gpt2_small(**DENSE), mesh, strategy="fsdp",
+                timing_mode="split", input_shape=(1, T))
+    with pytest.raises(ValueError, match="grad_accum"):
+        Trainer(gpt2_small(**DENSE), mesh, strategy="fsdp", grad_accum=2,
+                input_shape=(1, T))

@@ -49,11 +49,65 @@ def _read_pickle_batches(batch_dir: str, names: list[str]) -> Dataset:
     return Dataset(np.concatenate(images), np.concatenate(labels))
 
 
-def _maybe_extract(root: str) -> str | None:
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+CIFAR10_TGZ_MD5 = "c58f30108f718f92721af3b95e74349a"
+
+# One failed attempt per process: zero-egress hosts (this build image) must
+# not pay the connect timeout on every load_cifar10 call.
+_DOWNLOAD_FAILED = False
+
+
+def _download(root: str, timeout: float = 30.0) -> str | None:
+    """Fetch the CIFAR-10 tarball into ``root`` — the ``download=True``
+    analogue of the reference (``src/Part 2a/main.py:36-37``).  Verifies the
+    torchvision-published md5 before accepting; returns the tarball path or
+    None on any network failure (zero-egress environments fall through to
+    the synthetic stand-in silently)."""
+    import hashlib
+    import urllib.error
+    import urllib.request
+
+    global _DOWNLOAD_FAILED
+    if _DOWNLOAD_FAILED or os.environ.get("TPUDP_NO_DOWNLOAD"):
+        return None
+    os.makedirs(root, exist_ok=True)
+    tgz = os.path.join(root, "cifar-10-python.tar.gz")
+    tmp = tgz + ".part"
+    try:
+        with urllib.request.urlopen(CIFAR10_URL, timeout=timeout) as resp, \
+                open(tmp, "wb") as out:
+            md5 = hashlib.md5()
+            while chunk := resp.read(1 << 20):
+                out.write(chunk)
+                md5.update(chunk)
+    except (urllib.error.URLError, OSError, TimeoutError):
+        _DOWNLOAD_FAILED = True
+        if os.path.isfile(tmp):
+            os.remove(tmp)
+        return None
+    # Verify OUTSIDE the network-failure catch: a corrupted tarball must be
+    # loud, not silently replaced by synthetic data.
+    if md5.hexdigest() != CIFAR10_TGZ_MD5:
+        os.remove(tmp)
+        _DOWNLOAD_FAILED = True
+        import warnings
+
+        warnings.warn(
+            "CIFAR-10 download failed md5 verification (corrupted or "
+            "proxy-mangled tarball); falling back as if offline",
+            stacklevel=2)
+        return None
+    os.replace(tmp, tgz)
+    return tgz
+
+
+def _maybe_extract(root: str, download: bool = False) -> str | None:
     batch_dir = os.path.join(root, "cifar-10-batches-py")
     if os.path.isdir(batch_dir):
         return batch_dir
     tgz = os.path.join(root, "cifar-10-python.tar.gz")
+    if not os.path.isfile(tgz) and download:
+        _download(root)
     if os.path.isfile(tgz):
         with tarfile.open(tgz, "r:gz") as tar:
             tar.extractall(root)
@@ -75,6 +129,7 @@ def _synthetic(n: int, seed: int, num_classes: int = 10) -> Dataset:
 def load_cifar10(
     root: str = "./data",
     *,
+    download: bool = True,
     synthetic_fallback: bool = True,
     synthetic_train_size: int = 50_000,
     synthetic_test_size: int = 10_000,
@@ -82,9 +137,12 @@ def load_cifar10(
     """Return ``(train, test, is_synthetic)``.
 
     Real data is used when ``root/cifar-10-batches-py`` (or the tarball)
-    exists; otherwise a deterministic synthetic stand-in of the same shape.
+    exists; with ``download=True`` (the reference's default behavior) a
+    missing dataset is fetched + md5-verified first.  Network failure is
+    silent — offline hosts fall back to a deterministic synthetic stand-in
+    of the same shape (or raise if ``synthetic_fallback=False``).
     """
-    batch_dir = _maybe_extract(root)
+    batch_dir = _maybe_extract(root, download=download)
     if batch_dir is not None:
         return (
             _read_pickle_batches(batch_dir, _TRAIN_BATCHES),

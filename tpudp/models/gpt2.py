@@ -40,6 +40,7 @@ class GPT2Config:
     mlp_impl: str = "dense"  # 'dense' | 'moe'
     num_experts: int = 8
     capacity_factor: float = 1.25
+    moe_top_k: int = 1  # experts per token (1 = Switch, >=2 = GShard-style)
     expert_axis: str | None = None  # mesh axis for expert parallelism
 
     def __post_init__(self):
@@ -115,6 +116,7 @@ class Block(nn.Module):
                 num_experts=cfg.num_experts,
                 mlp_ratio=cfg.mlp_ratio,
                 capacity_factor=cfg.capacity_factor,
+                top_k=cfg.moe_top_k,
                 expert_axis=cfg.expert_axis,
                 dtype=cfg.dtype,
                 name="moe",

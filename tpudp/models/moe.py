@@ -1,10 +1,12 @@
 """Mixture-of-Experts MLP with expert parallelism.
 
 Beyond-parity capability (SURVEY.md §2.2 lists EP/MoE as absent from the
-reference).  Switch-Transformer-style top-1 routing with a fixed per-expert
-capacity, so every shape is static and the whole layer stays jit/MXU
-friendly: dispatch and combine are one-hot einsums, expert FFNs run as one
-``vmap``-ed batched matmul over the expert axis.
+reference).  Switch-Transformer-style top-1 routing by default, general
+top-k (``top_k>=2``, GShard/Mixtral style: choice-major capacity priority,
+renormalized combine weights) with a fixed per-expert capacity, so every
+shape is static and the whole layer stays jit/MXU friendly: dispatch and
+combine are one-hot einsums, expert FFNs run as one ``vmap``-ed batched
+matmul over the expert axis.
 
 Expert parallelism is the TPU-native all-to-all pattern: expert weights are
 stacked ``(E, ...)`` and sharded over an ``expert`` mesh axis; inside
@@ -35,6 +37,19 @@ from jax import lax
 from tpudp.mesh import axis_is_bound as _axis_is_bound
 
 
+def collect_moe_aux(intermediates) -> jnp.ndarray | float:
+    """Mean of every ``moe_aux`` value sown into an ``intermediates``
+    collection (0.0 when none).  The single shared harvest used by BOTH the
+    default train path (tpudp.train._loss_and_updates) and the EP rung
+    (tpudp.parallel.expert) so their objectives can never diverge."""
+    auxes = [v for path, v in
+             jax.tree_util.tree_flatten_with_path(intermediates)[0]
+             if "moe_aux" in jax.tree_util.keystr(path)]
+    if not auxes:
+        return 0.0
+    return sum(auxes) / len(auxes)
+
+
 class MoeMlp(nn.Module):
     """Drop-in MLP replacement: ``(..., d) -> (..., d)``.
 
@@ -49,6 +64,7 @@ class MoeMlp(nn.Module):
     num_experts: int = 8
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
+    top_k: int = 1
     expert_axis: str | None = None
     dtype: jnp.dtype = jnp.float32
 
@@ -82,23 +98,41 @@ class MoeMlp(nn.Module):
         b2 = self.param("experts_b2", nn.initializers.zeros, (e_local, d),
                         jnp.float32)
 
-        # --- route (fp32 for a stable softmax/argmax) ---
+        # --- route (fp32 for a stable softmax/top_k) ---
+        k = self.top_k
+        if not 1 <= k <= e:
+            raise ValueError(f"top_k={k} must be in [1, num_experts={e}]")
         logits = xt.astype(jnp.float32) @ gate
         probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)
-        top_p = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        top_p, top_idx = lax.top_k(probs, k)  # (T, k), best-first
+        # Per-choice combine weights: Switch uses the raw router prob for
+        # top-1; for k>=2 renormalize over the chosen experts (Mixtral
+        # convention) so the combine is a convex mix of expert outputs.
+        weights = top_p / top_p.sum(-1, keepdims=True) if k > 1 else top_p
+        onehot_k = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (T, k, E)
 
-        capacity = max(int(math.ceil(self.capacity_factor * t / e)), 1)
-        position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based queue slot
+        # Capacity slots scale with k (k*T total assignments).  Queue
+        # priority is choice-major: every token's FIRST choice claims a slot
+        # before any second choice does (GShard ordering), so overflow drops
+        # lower-ranked assignments first.
+        capacity = max(int(math.ceil(self.capacity_factor * t * k / e)), 1)
+        flat = onehot_k.transpose(1, 0, 2).reshape(k * t, e)  # choice-major
+        position = jnp.cumsum(flat, axis=0) * flat  # 1-based queue slot
         keep = (position > 0) & (position <= capacity)
         slot = jax.nn.one_hot(
             jnp.clip(position.astype(jnp.int32) - 1, 0, capacity - 1),
             capacity, dtype=jnp.float32)
-        dispatch = slot * keep[..., None].astype(jnp.float32)  # (T, E, C)
+        disp_k = (slot * keep[..., None].astype(jnp.float32)).reshape(
+            k, t, e, capacity)
+        # A token occupies at most one slot per (choice, expert): summing
+        # over choices keeps dispatch one-hot along (E, C).
+        dispatch = disp_k.sum(axis=0)  # (T, E, C)
+        combine = (disp_k
+                   * weights.T[:, :, None, None]).sum(axis=0)  # (T, E, C)
 
-        # balance metrics for an aux loss (Switch: E * sum(f_e * P_e))
-        load_fraction = onehot.mean(axis=0)
+        # balance metrics for an aux loss (Switch: E * sum(f_e * P_e);
+        # f_e = fraction of routing assignments to expert e)
+        load_fraction = onehot_k.mean(axis=(0, 1))
         self.sow("intermediates", "moe_load", load_fraction)
         self.sow("intermediates", "moe_aux",
                  e * jnp.sum(load_fraction * probs.mean(axis=0)))
@@ -124,7 +158,6 @@ class MoeMlp(nn.Module):
                 expert_outputs, self.expert_axis, split_axis=1, concat_axis=0,
                 tiled=True)  # back to (E, C, d), my tokens' slots
 
-        combine = dispatch * top_p[:, None, None]  # (T, E, C)
         y = jnp.einsum("ecd,tec->td", expert_outputs.astype(jnp.float32),
                        combine)
         return y.astype(self.dtype).reshape(orig_shape)

@@ -5,14 +5,22 @@ here the attention hot op is a first-party Pallas kernel instead of an XLA
 einsum chain:
 
   * Blocked online-softmax forward (flash-attention recurrence): the
-    ``(t, t)`` score matrix is never materialized — each grid step holds one
-    ``(block_q, block_k)`` tile in VMEM, so memory is O(t · d) not O(t²) and
-    the tiles feed the MXU back-to-back.
+    ``(t, t)`` score matrix is never materialized — and K/V are BLOCKED
+    THROUGH THE GRID, not staged whole into VMEM: the grid is
+    ``(batch·head, q_blocks, k_blocks)`` with the online-softmax state
+    (running max / denominator / output accumulator) carried across the
+    innermost K dimension in VMEM scratch.  Per-invocation VMEM is
+    O((block_q + block_k)·dh) regardless of sequence length, so the kernel
+    keeps scaling at t = 8k/16k+ where a whole-sequence K/V stage would
+    overflow VMEM (round-1 weakness; Pallas double-buffers the K/V block
+    fetches so HBM reads overlap the MXU matmuls).
   * Custom VJP with the standard two-kernel backward (a dq kernel gridded
-    over Q blocks and a dk/dv kernel gridded over K blocks), recomputing
-    probabilities from the saved log-sum-exp rather than storing them.
-  * Causal masking skips fully-masked K blocks via the loop bound (the tail
-    tile is masked elementwise), so causal costs ~half the FLOPs.
+    over (q_blocks, k_blocks) and a dk/dv kernel gridded over
+    (k_blocks, q_blocks)), recomputing probabilities from the saved
+    log-sum-exp rather than storing them — same grid-blocked structure.
+  * Causal masking skips the compute of fully-masked blocks via
+    ``pl.when`` (their tiles still stream, the MXU work is elided), and
+    masks the diagonal tile elementwise.
   * Runs in interpret mode off-TPU, so the same code is unit-testable on the
     CPU simulator mesh (tests/test_flash_attention.py checks fwd and grads
     against a dense oracle).
@@ -20,6 +28,8 @@ einsum chain:
 Layouts: public API takes ``(batch, time, heads, head_dim)`` (the layout the
 models use); the kernels run per ``(batch·head)`` with ``(time, head_dim)``
 blocks. Compute is fp32 regardless of input dtype (MXU accumulate).
+The running max/denominator scratch rows are stored broadcast across a
+128-lane tile (Mosaic-friendly layout); reads reduce over lanes.
 """
 
 from __future__ import annotations
@@ -30,85 +40,106 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_LANES = 128  # scalar-per-row scratch is stored broadcast over one lane tile
 
 
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _read_rows(ref) -> jnp.ndarray:
+    """(rows, LANES) scratch -> (rows, 1); every lane holds the same value."""
+    return jnp.max(ref[...], axis=-1, keepdims=True)
+
+
+def _write_rows(ref, val) -> None:
+    ref[...] = jnp.broadcast_to(val, ref.shape)
+
+
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                causal: bool, scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, causal: bool, scale: float, nk: int):
     bq, dh = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
     qi = pl.program_id(1)
-    t = k_ref.shape[1]
-    nk = t // block_k
+    ki = pl.program_id(2)
 
-    q = q_ref[0].astype(jnp.float32) * scale
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        _write_rows(m_ref, jnp.full((bq, 1), _NEG_INF, jnp.float32))
+        _write_rows(l_ref, jnp.zeros((bq, 1), jnp.float32))
 
-    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, dh), jnp.float32)
-
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = q_pos >= k_pos
             s = jnp.where(mask, s, _NEG_INF)
-        blk_max = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, blk_max)
-        alpha = jnp.exp(m - m_new)
+        m_prev = _read_rows(m_ref)
+        l_prev = _read_rows(l_ref)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         if causal:
             p = jnp.where(mask, p, 0.0)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        _write_rows(l_ref, l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True))
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        _write_rows(m_ref, m_new)
 
-    # Causal: K blocks strictly above the diagonal contribute nothing — stop
-    # the loop at the diagonal block instead of masking them.  upper <= nk
-    # because t % block_k == 0 (checked in flash_attention()).
-    upper = ((qi + 1) * bq + block_k - 1) // block_k if causal else nk
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    if causal:
+        # K blocks strictly above the diagonal contribute nothing: elide
+        # their compute (the tile stream is pipelined regardless).
+        @pl.when(ki * bk < (qi + 1) * bq)
+        def _():
+            _compute()
+    else:
+        _compute()
 
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe)).reshape(1, bq)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(_read_rows(l_ref), 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (_read_rows(m_ref) + jnp.log(l_safe)).reshape(1, bq)
 
 
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     """q,k,v: (bh, t, dh) fp32/bf16 -> (o (bh,t,dh), lse (bh,t) f32)."""
     bh, t, dh = q.shape
     scale = 1.0 / math.sqrt(dh)
-    grid = (bh, t // block_q)
-    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               scale=scale)
+    nk = t // block_k
+    grid = (bh, t // block_q, nk)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale, nk=nk)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dh), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -118,75 +149,90 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
 # --------------------------------------------------------------- backward
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               block_k: int, causal: bool, scale: float):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, causal: bool, scale: float, nk: int):
     bq, dh = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
     qi = pl.program_id(1)
-    t = k_ref.shape[1]
-    nk = t // block_k
+    ki = pl.program_id(2)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].reshape(bq, 1)
-    delta = delta_ref[0].reshape(bq, 1)
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].reshape(bq, 1)
+        delta = delta_ref[0].reshape(bq, 1)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         p = jnp.exp(s - lse)
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dq_acc[...] += jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
 
-    upper = ((qi + 1) * bq + block_k - 1) // block_k if causal else nk
-    dq = jax.lax.fori_loop(0, upper, body,
-                           jnp.zeros((bq, dh), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    if causal:
+        @pl.when(ki * bk < (qi + 1) * bq)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float):
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                scale: float, nq: int):
     bk, dh = k_ref.shape[1], k_ref.shape[2]
+    bq = q_ref.shape[1]
     ki = pl.program_id(1)
-    t = q_ref.shape[1]
-    nq = t // block_q
+    qi = pl.program_id(2)
 
-    k_blk = k_ref[0].astype(jnp.float32)
-    v_blk = v_ref[0].astype(jnp.float32)
-    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0].reshape(bq, 1)
+        delta = delta_ref[0].reshape(bq, 1)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         p = jnp.exp(s - lse)
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+        # scale is already folded into q, so dk = dsᵀ·(q·scale) is complete
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
 
-    # Causal: Q blocks strictly above this K block see none of it.
-    lower = (ki * bk) // block_q if causal else 0
-    dk, dv = jax.lax.fori_loop(
-        lower, nq, body,
-        (jnp.zeros((bk, dh), jnp.float32), jnp.zeros((bk, dh), jnp.float32)))
-    # scale is already folded into q above, so dk = dsᵀ·(q·scale) is complete
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # Q blocks strictly above this K block see none of it.
+        @pl.when((qi + 1) * bq > ki * bk)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
@@ -196,43 +242,47 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).reshape(bh, 1, t)
     lse3 = lse.reshape(bh, 1, t)
+    nq, nk = t // block_q, t // block_k
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, causal=causal,
-                          scale=scale),
-        grid=(bh, t // block_q),
+        functools.partial(_dq_kernel, causal=causal, scale=scale, nk=nk),
+        grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, dh), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse3, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, causal=causal,
-                          scale=scale),
-        grid=(bh, t // block_k),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=nq),
+        grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, dh), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, dh), k.dtype),
             jax.ShapeDtypeStruct((bh, t, dh), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dh), jnp.float32),
+            pltpu.VMEM((block_k, dh), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse3, delta)

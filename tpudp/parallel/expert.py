@@ -52,6 +52,39 @@ def expert_spec_tree(tree: Any, expert_axis: str = EXPERT_AXIS) -> Any:
     return jax.tree_util.tree_map_with_path(one, tree)
 
 
+def make_ep_eval_step(
+    model,
+    mesh: Mesh,
+    state,
+    *,
+    data_axis: str = DATA_AXIS,
+    expert_axis: str = EXPERT_AXIS,
+):
+    """Expert-parallel eval with the Trainer contract: tokens shard over the
+    flattened (data, expert) device grid, expert weights stay sharded, the
+    MoE all_to_all fires inside the bound mesh, and the weighted metrics
+    psum over the whole mesh."""
+
+    def body(st, tokens, targets, weights):
+        from tpudp.train import eval_metrics
+
+        loss_sum, correct, count = eval_metrics(
+            model, st, tokens, targets, weights)
+        axes = (data_axis, expert_axis)
+        return (lax.psum(loss_sum, axes), lax.psum(correct, axes),
+                lax.psum(count, axes))
+
+    state_specs = expert_spec_tree(state, expert_axis)
+    tok_spec = P((data_axis, expert_axis))
+    return jax.jit(jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, tok_spec, tok_spec, tok_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    ))
+
+
 def make_ep_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -90,11 +123,9 @@ def make_ep_train_step(
                 logits, targets).mean()
             aux = 0.0
             if aux_loss_coef:
-                auxes = [v for path, v in
-                         jax.tree_util.tree_flatten_with_path(inter)[0]
-                         if "moe_aux" in jax.tree_util.keystr(path)]
-                if auxes:
-                    aux = aux_loss_coef * sum(auxes) / len(auxes)
+                from tpudp.models.moe import collect_moe_aux
+
+                aux = aux_loss_coef * collect_moe_aux(inter)
             return ce + aux, ce
 
         (_, loss), grads = jax.value_and_grad(

@@ -144,6 +144,59 @@ def gpipe(
     return outputs
 
 
+def make_pp_eval_step(
+    model,
+    mesh: Mesh,
+    state,
+    *,
+    n_microbatches: int,
+    data_axis: str | None = DATA_AXIS,
+    pipe_axis: str = PIPE_AXIS,
+):
+    """Pipelined eval: ``(state, tokens, targets, weights) -> (loss_sum,
+    correct, count)`` with the Trainer's eval contract, so a PP run gets the
+    reference's post-epoch test summary (``src/Part 2a/main.py:130-145``).
+    ``state`` must already be in the pipeline layout (stacked ``blocks``)."""
+    from tpudp.models.gpt2 import Block, embed_tokens, lm_head
+
+    cfg = model.config
+    s = mesh.shape[pipe_axis]
+    block_fn = lambda p, x: Block(cfg).apply({"params": p}, x)
+
+    def body(st, tokens, targets, weights):
+        import optax
+
+        b, t = tokens.shape
+        mb = b // n_microbatches
+        params = st.params
+        x = embed_tokens(cfg, params, tokens)
+        x_mb = x.reshape(n_microbatches, mb, t, cfg.d_model)
+        h = gpipe(params["blocks"], x_mb, block_fn, pipe_axis)
+        h = h.reshape(b, t, cfg.d_model)
+        logits = lm_head(cfg, params, h)
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        w = jnp.broadcast_to(weights[:, None], per.shape)
+        # Only the last stage saw real pipeline outputs; zero elsewhere so
+        # the structural psum over the pipe axis yields the true totals.
+        mask = (lax.axis_index(pipe_axis) == s - 1).astype(per.dtype)
+        loss_sum = mask * (per * w).sum()
+        correct = mask * ((jnp.argmax(logits, -1) == targets) * w).sum()
+        count = mask * w.sum()
+        axes = (pipe_axis,) if data_axis is None else (pipe_axis, data_axis)
+        return (lax.psum(loss_sum, axes), lax.psum(correct, axes),
+                lax.psum(count, axes))
+
+    state_specs = pipeline_spec_tree(state, pipe_axis)
+    tok_spec = P(data_axis) if data_axis is not None else P()
+    return jax.jit(jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, tok_spec, tok_spec, tok_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    ))
+
+
 def make_pp_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -170,8 +223,23 @@ def make_pp_train_step(
     """
     from tpudp.models.gpt2 import Block, embed_tokens, lm_head
 
-    cfg = model.config
+    cfg = getattr(model, "config", None)
+    if cfg is None or not hasattr(cfg, "num_layers"):
+        raise TypeError(
+            "make_pp_train_step drives tpudp.models.gpt2.GPT2 (a model with "
+            f"a GPT2Config at .config); got {type(model).__name__}")
+    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
+        raise ValueError(
+            "pipeline parallelism supports dense/flash attention and dense "
+            f"MLP blocks; got attn_impl={cfg.attn_impl!r} "
+            f"mlp_impl={cfg.mlp_impl!r} (compose PP with SP/EP on separate "
+            "mesh axes instead)")
     num_layers = cfg.num_layers
+    missing = [f"h_{i}" for i in range(num_layers) if f"h_{i}" not in state.params]
+    if missing:
+        raise ValueError(
+            f"params are missing block subtrees {missing[:3]}... — expected "
+            f"the GPT-2 layout h_0..h_{num_layers - 1}")
     s = mesh.shape[pipe_axis]
     if num_layers % s != 0:
         raise ValueError(f"{num_layers} layers not divisible by {s} stages")

@@ -1,0 +1,198 @@
+"""Parallelism strategies as first-class Trainer capabilities.
+
+Round-1 left TP/PP/EP/FSDP/SP as bare step-builders returning
+``(state, step_fn)``; this module promotes them to full framework rungs:
+:func:`build_strategy` packages the sharded state, the train step, a
+matching eval step, and the input-sharding rule, so the ``Trainer`` drives
+any rung with the same epoch loop, reference-format logging
+(``src/Part 2a/main.py:102-112``), watchdog heartbeats, and orbax
+checkpoint/resume the DP path always had.
+
+Every strategy obeys the framework-wide contracts::
+
+    train_step(state, inputs, labels)          -> (state, loss)
+    eval_step(state, inputs, labels, weights)  -> (loss_sum, correct, count)
+    shard_for(host_array)                      -> NamedSharding
+
+Mesh axis requirements (build the mesh with tpudp.mesh.make_mesh_nd):
+
+  ============  ===========================  ==========================
+  strategy      mesh axes                    options
+  ============  ===========================  ==========================
+  ``tp``        ``data`` x ``model``         ``rules`` (partition rules)
+  ``fsdp``      ``data``                     ``min_size``
+  ``pp``        [``data`` x] ``pipe``        ``n_microbatches``
+  ``ep``        ``data`` x ``expert``        ``aux_loss_coef``
+  ``sp``        ``data`` x ``seq``           —
+  ============  ===========================  ==========================
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudp.mesh import DATA_AXIS
+
+STRATEGIES = ("dp", "tp", "fsdp", "pp", "ep", "sp")
+
+
+class BuiltStrategy(NamedTuple):
+    state: Any
+    train_step: Callable
+    eval_step: Callable
+    shard_for: Callable[[Any], NamedSharding]
+
+
+def _leading_axis_sharder(mesh: Mesh, spec: P) -> Callable:
+    sh = NamedSharding(mesh, spec)
+
+    def shard_for(_arr) -> NamedSharding:
+        return sh
+
+    return shard_for
+
+
+def build_strategy(
+    name: str,
+    model,
+    tx,
+    mesh: Mesh,
+    state,
+    *,
+    donate: bool = True,
+    **options,
+) -> BuiltStrategy:
+    """Build the full rung for ``name`` from a standard (single-device
+    layout) TrainState.  See the module table for per-strategy options."""
+    if mesh is None:
+        raise ValueError(f"strategy {name!r} needs a device mesh")
+    if name == "tp":
+        return _build_tp(model, tx, mesh, state, donate, options)
+    if name == "fsdp":
+        return _build_fsdp(model, tx, mesh, state, donate, options)
+    if name == "pp":
+        return _build_pp(model, tx, mesh, state, donate, options)
+    if name == "ep":
+        return _build_ep(model, tx, mesh, state, donate, options)
+    if name == "sp":
+        return _build_sp(model, tx, mesh, state, donate, options)
+    raise ValueError(f"unknown strategy {name!r}; choose from {STRATEGIES}")
+
+
+def _gspmd_eval(model, mesh, st_sh, data_axis):
+    """Eval for GSPMD-sharded states (TP/FSDP): the global-batch metrics
+    program under jit; XLA inserts the gathers the state sharding needs."""
+    from tpudp.train import eval_metrics
+
+    data = NamedSharding(mesh, P(data_axis))
+    rep = NamedSharding(mesh, P())
+
+    @partial(jax.jit, in_shardings=(st_sh, data, data, data),
+             out_shardings=(rep, rep, rep))
+    def eval_step(state, inputs, labels, weights):
+        return eval_metrics(model, state, inputs, labels, weights)
+
+    return eval_step
+
+
+def _build_tp(model, tx, mesh, state, donate, options):
+    from tpudp.train import make_tp_train_step, resolve_state_shardings
+
+    rules = options.pop("rules")
+    data_axis = options.pop("data_axis", DATA_AXIS)
+    _no_extra(options, "tp")
+    st, step = make_tp_train_step(model, tx, mesh, state, rules,
+                                  data_axis=data_axis, donate=donate)
+    st_sh = resolve_state_shardings(state, mesh, rules)
+    return BuiltStrategy(st, step, _gspmd_eval(model, mesh, st_sh, data_axis),
+                         _leading_axis_sharder(mesh, P(data_axis)))
+
+
+def _build_fsdp(model, tx, mesh, state, donate, options):
+    from tpudp.parallel.tensor import fsdp_shardings
+    from tpudp.train import make_fsdp_train_step, resolve_state_shardings
+
+    data_axis = options.pop("data_axis", DATA_AXIS)
+    min_size = options.pop("min_size", 1024)
+    _no_extra(options, "fsdp")
+    st, step = make_fsdp_train_step(model, tx, mesh, state,
+                                    data_axis=data_axis, min_size=min_size,
+                                    donate=donate)
+    st_sh = resolve_state_shardings(
+        state, mesh, partial(fsdp_shardings, axis=data_axis,
+                             min_size=min_size))
+    return BuiltStrategy(st, step, _gspmd_eval(model, mesh, st_sh, data_axis),
+                         _leading_axis_sharder(mesh, P(data_axis)))
+
+
+def _build_pp(model, tx, mesh, state, donate, options):
+    from tpudp.parallel.pipeline import (PIPE_AXIS, make_pp_eval_step,
+                                         make_pp_train_step)
+
+    n_microbatches = options.pop("n_microbatches")
+    pipe_axis = options.pop("pipe_axis", PIPE_AXIS)
+    data_axis = options.pop(
+        "data_axis", DATA_AXIS if DATA_AXIS in mesh.shape else None)
+    _no_extra(options, "pp")
+    st, step = make_pp_train_step(model, tx, mesh, state,
+                                  n_microbatches=n_microbatches,
+                                  data_axis=data_axis, pipe_axis=pipe_axis,
+                                  donate=donate)
+    eval_step = make_pp_eval_step(model, mesh, st,
+                                  n_microbatches=n_microbatches,
+                                  data_axis=data_axis, pipe_axis=pipe_axis)
+    spec = P(data_axis) if data_axis is not None else P()
+    return BuiltStrategy(st, step, eval_step,
+                         _leading_axis_sharder(mesh, spec))
+
+
+def _build_ep(model, tx, mesh, state, donate, options):
+    from tpudp.parallel.expert import (EXPERT_AXIS, make_ep_eval_step,
+                                       make_ep_train_step)
+
+    data_axis = options.pop("data_axis", DATA_AXIS)
+    expert_axis = options.pop("expert_axis", EXPERT_AXIS)
+    aux_loss_coef = options.pop("aux_loss_coef", 0.01)
+    _no_extra(options, "ep")
+    st, step = make_ep_train_step(model, tx, mesh, state,
+                                  data_axis=data_axis,
+                                  expert_axis=expert_axis,
+                                  aux_loss_coef=aux_loss_coef, donate=donate)
+    eval_step = make_ep_eval_step(model, mesh, st, data_axis=data_axis,
+                                  expert_axis=expert_axis)
+    return BuiltStrategy(
+        st, step, eval_step,
+        _leading_axis_sharder(mesh, P((data_axis, expert_axis))))
+
+
+def _build_sp(model, tx, mesh, state, donate, options):
+    from tpudp.train import make_seq_parallel_train_step, make_sp_eval_step
+
+    data_axis = options.pop("data_axis", DATA_AXIS)
+    seq_axis = options.pop("seq_axis", "seq")
+    _no_extra(options, "sp")
+    step = make_seq_parallel_train_step(model, tx, mesh,
+                                        data_axis=data_axis,
+                                        seq_axis=seq_axis, donate=donate)
+    eval_step = make_sp_eval_step(model, mesh, data_axis=data_axis,
+                                  seq_axis=seq_axis)
+    st = jax.device_put(state, NamedSharding(mesh, P()))
+    two_d = NamedSharding(mesh, P(data_axis, seq_axis))
+    one_d = NamedSharding(mesh, P(data_axis))
+
+    def shard_for(arr) -> NamedSharding:
+        # token/target matrices shard (batch, seq); per-sample vectors
+        # (eval weights) shard batch only
+        return two_d if getattr(arr, "ndim", 0) >= 2 else one_d
+
+    return BuiltStrategy(st, step, eval_step, shard_for)
+
+
+def _no_extra(options: dict, name: str) -> None:
+    if options:
+        raise TypeError(
+            f"unknown option(s) for strategy {name!r}: {sorted(options)}")

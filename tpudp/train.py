@@ -19,9 +19,11 @@ TPU-native re-design of the reference's training driver + hot loop
     ``src/Part 2a/main.py:100-112``), with the "epochs"/"iterations" wording
     drift resolved to Part 3's corrected form (``src/Part 3/main.py:105``).
   * Timing honesty under async dispatch (SURVEY.md §7 hard parts): the
-    default ``fused`` mode times the whole step with ``block_until_ready`` at
-    window edges; ``split`` mode jits forward and backward+sync+step as
-    separate programs to reproduce the reference's fwd/bwd split faithfully.
+    default ``fused`` mode times the whole step with a device->host
+    ``fetch_fence`` at window edges (BASELINE.md: ``block_until_ready`` is
+    not a reliable barrier under relay transports); ``split`` mode jits
+    forward and backward+sync+step as separate programs to reproduce the
+    reference's fwd/bwd split faithfully.
 
 Deliberate deviations (documented per SURVEY.md §7):
   * BatchNorm running statistics are pmean-averaged across devices each step
@@ -50,6 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudp.mesh import DATA_AXIS
 from tpudp.parallel.sync import get_sync
+from tpudp.utils.profiler import fetch_fence
 from tpudp.utils.watchdog import check_finite
 
 
@@ -135,7 +138,8 @@ def init_state(
 
 
 def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
-                      axis_name, grad_accum: int = 1):
+                      axis_name, grad_accum: int = 1,
+                      aux_loss_coef: float = 0.01):
     """fwd + loss + bwd + sync + SGD update — shared by all SPMD wrappers.
 
     ``grad_accum > 1`` splits the (per-device) batch into that many
@@ -145,24 +149,35 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
     With equal microbatch sizes the accumulated mean gradient is identical
     to the one-shot gradient (tested); BatchNorm models see sequential
     running-stat updates and per-microbatch batch statistics, the same
-    semantics torch users get when they accumulate."""
+    semantics torch users get when they accumulate.
+
+    ``aux_loss_coef`` weights any ``moe_aux`` balance losses the model sows
+    (tpudp.models.moe) into the optimized objective, so MoE models trained
+    through the DEFAULT path get router balancing, not only the EP rung.
+    Dense models sow nothing — the term vanishes and the trajectory is
+    untouched.  The returned/logged loss stays the pure CE term so curves
+    are comparable across rungs and with the reference."""
 
     def loss_fn(params, batch_stats, x, y):
         variables = {"params": params}
+        mutable = ["intermediates"]
         if batch_stats:
             variables["batch_stats"] = batch_stats
-            logits, mutated = model.apply(
-                variables, x, train=True, mutable=["batch_stats"]
-            )
-            new_bs = mutated["batch_stats"]
-        else:
-            logits = model.apply(variables, x, train=True)
-            new_bs = batch_stats
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-        return loss, new_bs
+            mutable.append("batch_stats")
+        logits, mutated = model.apply(variables, x, train=True,
+                                      mutable=mutable)
+        new_bs = mutated.get("batch_stats", batch_stats)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        loss = ce
+        if aux_loss_coef:
+            from tpudp.models.moe import collect_moe_aux
+
+            loss = ce + aux_loss_coef * collect_moe_aux(
+                mutated.get("intermediates", {}))
+        return loss, (new_bs, ce)
 
     if grad_accum == 1:
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (_, (new_bs, loss)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, images, labels)
     else:
         x_mb = images.reshape(grad_accum, -1, *images.shape[1:])
@@ -171,7 +186,7 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
         def micro(carry, xy):
             g_acc, l_acc, bs = carry
             x, y = xy
-            (l, bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            (_, (bs, l)), g = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, bs, x, y)
             g_acc = jax.tree.map(lambda a, b: a + b, g_acc, g)
             return (g_acc, l_acc + l, bs), None
@@ -210,6 +225,7 @@ def make_train_step(
     spmd_mode: str = "shard_map",
     donate: bool = True,
     grad_accum: int = 1,
+    aux_loss_coef: float = 0.01,
 ) -> Callable:
     """Build the jitted ``(state, images, labels) -> (state, loss)`` step.
 
@@ -236,7 +252,8 @@ def make_train_step(
         @partial(jax.jit, donate_argnums=donate_args)
         def train_step(state, images, labels):
             return _loss_and_updates(model, tx, state, images, labels,
-                                      sync_fn, None, grad_accum)
+                                      sync_fn, None, grad_accum,
+                                      aux_loss_coef)
 
         return train_step
 
@@ -252,7 +269,8 @@ def make_train_step(
         )
         def train_step(state, images, labels):
             return _loss_and_updates(model, tx, state, images, labels,
-                                      sync_fn, None, grad_accum)
+                                      sync_fn, None, grad_accum,
+                                      aux_loss_coef)
 
         return train_step
 
@@ -261,7 +279,8 @@ def make_train_step(
 
     def body(state, images, labels):
         return _loss_and_updates(model, tx, state, images, labels,
-                                  sync_fn, DATA_AXIS, grad_accum)
+                                  sync_fn, DATA_AXIS, grad_accum,
+                                  aux_loss_coef)
 
     sharded = jax.shard_map(
         body,
@@ -271,6 +290,20 @@ def make_train_step(
         check_vma=False,  # ring's ppermute output is replicated by construction, not by type
     )
     return jax.jit(sharded, donate_argnums=donate_args)
+
+
+def resolve_state_shardings(state: TrainState, mesh: Mesh, rules):
+    """Shared rules->shardings resolution for the TP/FSDP rungs: ``rules``
+    is either a partition-rule table (tpudp.parallel.tensor.Rules) or a
+    callable ``(state, mesh) -> sharding tree`` (e.g. ``fsdp_shardings`` via
+    functools.partial).  The train-step builders and the strategy layer's
+    eval steps both resolve through here so their layouts can never
+    diverge."""
+    from tpudp.parallel.tensor import state_shardings
+
+    if callable(rules):
+        return rules(state, mesh)
+    return state_shardings(state, mesh, rules)
 
 
 def make_tp_train_step(
@@ -301,12 +334,7 @@ def make_tp_train_step(
     TP layout so each device holds only its parameter shard (model memory
     per chip shrinks by the ``model``-axis size).
     """
-    from tpudp.parallel.tensor import state_shardings
-
-    if callable(rules):  # e.g. tensor.fsdp_shardings via functools.partial
-        st_sh = rules(state, mesh)
-    else:
-        st_sh = state_shardings(state, mesh, rules)
+    st_sh = resolve_state_shardings(state, mesh, rules)
     data = NamedSharding(mesh, P(data_axis))
     sync_none = get_sync("none")
 
@@ -391,6 +419,54 @@ def make_seq_parallel_train_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def eval_metrics(model: nn.Module, state, inputs, labels, weights):
+    """Shared weighted eval metrics: ``(loss_sum, correct, count)``.
+
+    ``weights`` is per-sample ``(batch,)``; for token models the per-token
+    loss/accuracy broadcast each sample's weight over its sequence, so
+    ``count`` counts weighted TOKENS and the averages are per-token — the
+    natural LM analogues of the reference's per-sample metrics."""
+    variables = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    logits = model.apply(variables, inputs, train=False)
+    per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    w = jnp.broadcast_to(
+        weights.reshape(weights.shape + (1,) * (per.ndim - weights.ndim)),
+        per.shape)
+    loss_sum = (per * w).sum()
+    correct = ((jnp.argmax(logits, -1) == labels) * w).sum()
+    return loss_sum, correct, w.sum()
+
+
+def make_sp_eval_step(
+    model: nn.Module,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = "seq",
+) -> Callable:
+    """Sequence-parallel eval: tokens shard over (batch, seq), ring
+    attention runs inside the bound mesh, per-token metrics psum over both
+    axes.  Trainer eval contract."""
+
+    def body(state, tokens, targets, weights):
+        loss_sum, correct, count = eval_metrics(
+            model, state, tokens, targets, weights)
+        axes = (data_axis, seq_axis)
+        return (lax.psum(loss_sum, axes), lax.psum(correct, axes),
+                lax.psum(count, axes))
+
+    return jax.jit(jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis), P(data_axis, seq_axis),
+                  P(data_axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    ))
+
+
 def make_eval_step(model: nn.Module, mesh: Mesh | None) -> Callable:
     """Jitted sharded eval: ``(state, images, labels, weights) ->
     (loss_sum, correct, count)`` — weight-masked so padded samples in the
@@ -398,14 +474,7 @@ def make_eval_step(model: nn.Module, mesh: Mesh | None) -> Callable:
     per rank, ``src/Part 2a/main.py:130-145``; we shard + psum instead)."""
 
     def metrics(state, images, labels, weights):
-        variables = {"params": state.params}
-        if state.batch_stats:
-            variables["batch_stats"] = state.batch_stats
-        logits = model.apply(variables, images, train=False)
-        per_sample = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-        loss_sum = (per_sample * weights).sum()
-        correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
-        return loss_sum, correct, weights.sum()
+        return eval_metrics(model, state, images, labels, weights)
 
     if mesh is None:
         return jax.jit(metrics)
@@ -461,6 +530,14 @@ class Trainer:
     (``src/Part 2a/main.py:19-68,71-114,130-145``): per-epoch wall time,
     mean training loss every ``log_every`` iterations, fwd/bwd/total times
     with the first window excluded, and a post-epoch test summary.
+
+    ``strategy`` selects the parallelism rung (tpudp.strategy): the default
+    ``'dp'`` is the reference's ladder; ``'tp'/'fsdp'/'pp'/'ep'/'sp'`` drive
+    the beyond-parity rungs through the SAME epoch loop — eval,
+    checkpointing, watchdog, and reference-format logging included.
+    ``strategy_options`` passes rung-specific options (e.g.
+    ``{"n_microbatches": 4}`` for pp); ``input_shape`` feeds ``init_state``
+    for non-image models (e.g. ``(1, seq_len)`` for GPT-2).
     """
 
     def __init__(
@@ -469,6 +546,9 @@ class Trainer:
         mesh: Mesh | None = None,
         sync: str = "allreduce",
         *,
+        strategy: str = "dp",
+        strategy_options: dict | None = None,
+        input_shape: tuple = (1, 32, 32, 3),
         learning_rate: float = 0.1,
         momentum: float = 0.9,
         weight_decay: float = 1e-4,
@@ -483,29 +563,58 @@ class Trainer:
         self.model = model
         self.mesh = mesh
         self.sync = sync
+        self.strategy = strategy
         self.watchdog = watchdog  # tpudp.utils.watchdog.Watchdog or None
         self.tx = make_optimizer(learning_rate, momentum, weight_decay)
-        self.state = init_state(model, self.tx, seed=seed)
+        self.state = init_state(model, self.tx, input_shape=input_shape,
+                                seed=seed)
         self.timing_mode = timing_mode
         self.log_every = log_every
         self.log = log_fn
-        self.train_step = make_train_step(
-            model, self.tx, mesh, sync, spmd_mode=spmd_mode,
-            donate=(timing_mode != "split"), grad_accum=grad_accum,
-        )
-        self.fwd_step = make_forward_step(model, mesh) if timing_mode == "split" else None
-        self.eval_step = make_eval_step(model, mesh)
+        self.fwd_step = None
+        if strategy == "dp":
+            self.train_step = make_train_step(
+                model, self.tx, mesh, sync, spmd_mode=spmd_mode,
+                donate=(timing_mode != "split"), grad_accum=grad_accum,
+            )
+            if timing_mode == "split":
+                self.fwd_step = make_forward_step(model, mesh)
+            self.eval_step = make_eval_step(model, mesh)
+            self._shard_for = None
+            if mesh is not None:
+                data_sh = NamedSharding(mesh, P(DATA_AXIS))
+                self._shard_for = lambda a: data_sh
+        else:
+            if timing_mode == "split":
+                raise ValueError(
+                    "timing_mode='split' reproduces the reference's DP "
+                    "fwd/bwd split; advanced strategies time fused steps")
+            if grad_accum != 1:
+                raise ValueError(
+                    f"grad_accum is a DP-rung option (strategy={strategy!r})")
+            if sync != "allreduce" or spmd_mode != "shard_map":
+                raise ValueError(
+                    f"sync={sync!r}/spmd_mode={spmd_mode!r} are DP-rung "
+                    f"options; strategy={strategy!r} defines its own "
+                    "collectives")
+            from tpudp.strategy import build_strategy
+
+            built = build_strategy(
+                strategy, model, self.tx, mesh, self.state,
+                donate=True, **(strategy_options or {}))
+            self.state = built.state
+            self.train_step = built.train_step
+            self.eval_step = built.eval_step
+            self._shard_for = built.shard_for
         self._put = None
-        if mesh is not None:
-            data_sh = NamedSharding(mesh, P(DATA_AXIS))
+        if self._shard_for is not None:
             if jax.process_count() > 1:
                 # Multi-host: each process holds only its host-local slice of
                 # the global batch; assemble the distributed global array.
                 self._put = lambda a: jax.make_array_from_process_local_data(
-                    data_sh, np.asarray(a)
-                )
+                    self._shard_for(a), np.asarray(a))
             else:
-                self._put = lambda a: jax.device_put(a, data_sh)
+                self._put = lambda a: jax.device_put(a, self._shard_for(a))
 
     def _device_batch(self, images, labels):
         if self._put is not None:
@@ -530,12 +639,16 @@ class Trainer:
         for it, (images, labels, _w) in enumerate(loader, start=1):
             images, labels = self._device_batch(images, labels)
             if self.timing_mode == "split":
+                # fetch_fence, not block_until_ready: under relay transports
+                # the latter can return before compute completes
+                # (BASELINE.md "timing-honesty"); the fetched leaf
+                # data-depends on the bracketed program.
                 t0 = time.perf_counter()
                 out = self.fwd_step(self.state, images)
-                jax.block_until_ready(out)
+                fetch_fence(out)
                 t1 = time.perf_counter()
                 self.state, _ = self.train_step(self.state, images, labels)
-                jax.block_until_ready(self.state)
+                fetch_fence(self.state.params)
                 t2 = time.perf_counter()
                 fwd_t += t1 - t0
                 # fused step recomputes fwd; attribute the remainder to bwd
@@ -543,10 +656,12 @@ class Trainer:
             else:
                 self.state, _ = self.train_step(self.state, images, labels)
             if it % self.log_every == 0:
-                # Window barrier: block on the FULL state — under some device
-                # transports (axon relay) a scalar's readiness does not imply
-                # the step's compute finished (see BASELINE.md).
-                jax.block_until_ready(self.state)
+                # Window barrier: a device->host FETCH of a parameter leaf —
+                # under some device transports (axon relay) even
+                # block_until_ready on the full state can return before the
+                # step's compute finished (see BASELINE.md); the fetched
+                # param data-depends on the window's last fwd+bwd+update.
+                fetch_fence(self.state.params)
                 window_time = time.perf_counter() - window_start
                 cum = float(self.state.loss_sum)
                 losses.append(check_finite(
@@ -621,7 +736,7 @@ class Trainer:
         for epoch in range(start_epoch, epochs):
             start = time.perf_counter()
             self.train_epoch(train_loader, epoch)
-            jax.block_until_ready(self.state.params)
+            fetch_fence(self.state.params)  # honest epoch wall-time edge
             self.log(
                 "Training time after {} epoch is {}".format(
                     epoch + 1, time.perf_counter() - start

@@ -58,6 +58,17 @@ def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
     return _checkpointer().restore(path, item=abstract)
 
 
+def emergency_dir(root: str | os.PathLike) -> str | None:
+    """Return the watchdog's emergency-dump directory if one exists.
+
+    The watchdog saves a mid-epoch TrainState to ``root/emergency`` when it
+    detects a hang (see tpudp/cli.py); callers restore it in preference to
+    the epoch-level ``step_N`` series and then consume (rename) it."""
+    root = os.fspath(root)
+    path = os.path.join(root, "emergency")
+    return path if os.path.isdir(path) else None
+
+
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
 

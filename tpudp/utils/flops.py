@@ -1,0 +1,123 @@
+"""Analytic FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference defines only wall-clock metrics (``src/Part 2a/main.py:
+87-98,106-109``); this module converts them into the single-chip perf
+criterion a TPU build is judged on: achieved model FLOPs/s divided by the
+chip's peak.  Counts follow the standard convention — matmul/conv FLOPs
+only (2 x MACs), elementwise/norm/pool ignored, backward = 2 x forward so
+a train step is 3 x forward.
+
+Peak numbers are the published per-chip bf16 figures (the "How to Scale
+Your Model" hardware table); MFU is reported against bf16 peak regardless
+of compute dtype, which is conservative for fp32 runs.
+"""
+
+from __future__ import annotations
+
+# Published per-chip dense bf16 peak FLOPs/s, keyed by substrings of
+# jax.Device.device_kind.  Order matters: first match wins, so the more
+# specific "lite" kinds precede their generation's full-size chip.
+_PEAK_BF16: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 918e12),  # Trillium
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def chip_peak_flops(device_kind: str) -> float | None:
+    """Per-chip bf16 peak for a ``jax.Device.device_kind`` string, or None
+    when the chip isn't in the table (e.g. the CPU smoke-test platform)."""
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def mfu(flops_per_step: float, sec_per_step: float,
+        device_kind: str, n_devices: int = 1) -> float | None:
+    """Achieved fraction of peak: ``flops / (time * n * peak)``."""
+    peak = chip_peak_flops(device_kind)
+    if peak is None or sec_per_step <= 0:
+        return None
+    return flops_per_step / (sec_per_step * n_devices * peak)
+
+
+# --- per-model analytic counts (forward, per batch) ----------------------
+
+def conv2d_flops(batch: int, h_out: int, w_out: int, c_in: int, c_out: int,
+                 kh: int, kw: int) -> int:
+    return 2 * batch * h_out * w_out * c_in * c_out * kh * kw
+
+
+def dense_flops(batch: int, d_in: int, d_out: int) -> int:
+    return 2 * batch * d_in * d_out
+
+
+def vgg_fwd_flops(batch: int, variant: str = "VGG11", image_size: int = 32,
+                  num_classes: int = 10) -> int:
+    """Walk the variant's config table (tpudp.models.vgg.CONFIGS — the
+    reference's ``_cfg``, ``src/Part 1/model.py:3-8``)."""
+    from tpudp.models.vgg import CONFIGS
+
+    h = image_size
+    c_in = 3
+    total = 0
+    for v in CONFIGS[variant]:
+        if v == "M":
+            h //= 2
+        else:
+            total += conv2d_flops(batch, h, h, c_in, int(v), 3, 3)
+            c_in = int(v)
+    total += dense_flops(batch, c_in * h * h, num_classes)
+    return total
+
+
+def resnet_fwd_flops(batch: int, stage_sizes=(3, 4, 6, 3),
+                     image_size: int = 224, num_classes: int = 1000,
+                     width: int = 64) -> int:
+    """Bottleneck-ResNet walk matching tpudp.models.resnet.ResNet: 7x7/2
+    stem, 3x3/2 maxpool, stages of (1x1 -> 3x3 -> 1x1 x4) bottlenecks with
+    a projection on each stage's first block."""
+    h = image_size // 2  # stem conv stride 2
+    total = conv2d_flops(batch, h, h, 3, width, 7, 7)
+    h = (h + 1) // 2  # maxpool stride 2
+    c_in = width
+    for stage, num_blocks in enumerate(stage_sizes):
+        w = width * (2 ** stage)
+        for block in range(num_blocks):
+            stride = 2 if (stage > 0 and block == 0) else 1
+            h_out = h // stride
+            total += conv2d_flops(batch, h, h, c_in, w, 1, 1)
+            total += conv2d_flops(batch, h_out, h_out, w, w, 3, 3)
+            total += conv2d_flops(batch, h_out, h_out, w, 4 * w, 1, 1)
+            if block == 0:  # projection shortcut
+                total += conv2d_flops(batch, h_out, h_out, c_in, 4 * w, 1, 1)
+            c_in, h = 4 * w, h_out
+    total += dense_flops(batch, c_in, num_classes)
+    return total
+
+
+def gpt2_fwd_flops(batch: int, seq_len: int, *, num_layers: int = 12,
+                   d_model: int = 768, vocab_size: int = 50_257,
+                   mlp_ratio: int = 4) -> int:
+    """Per-layer matmuls (QKV 3d^2 + proj d^2 + MLP 2*ratio*d^2 per token)
+    plus the quadratic attention score/value matmuls and the LM head."""
+    tokens = batch * seq_len
+    per_layer = dense_flops(tokens, d_model, 3 * d_model)      # qkv
+    per_layer += dense_flops(tokens, d_model, d_model)         # out proj
+    per_layer += 2 * dense_flops(tokens, d_model, mlp_ratio * d_model)
+    per_layer += 2 * 2 * batch * seq_len * seq_len * d_model   # QK^T + AV
+    return num_layers * per_layer + dense_flops(tokens, d_model, vocab_size)
+
+
+def train_step_flops(fwd_flops: int) -> int:
+    """Backward is ~2x forward (grad wrt activations + grad wrt weights)."""
+    return 3 * fwd_flops

@@ -2,19 +2,20 @@
 
 The reference brackets ``time.time()`` around eager torch calls
 (``src/Part 2a/main.py:87-98``).  Under JAX async dispatch a naive bracket
-measures dispatch, not compute — every timer here blocks on the measured
-value before reading the clock (SURVEY.md §7 "timing honesty" hard part).
+measures dispatch, not compute — every timer here FETCHES a leaf of the
+measured value before reading the clock (SURVEY.md §7 "timing honesty"
+hard part; BASELINE.md: under relay transports even ``block_until_ready``
+can return before device compute completes, so the shared
+:func:`tpudp.utils.profiler.fetch_fence` is the only reliable edge).
 """
 
 from __future__ import annotations
 
 import time
 
-import jax
-
 
 class StepTimer:
-    """Accumulates wall time across steps with block_until_ready edges."""
+    """Accumulates wall time across steps with fetch-fenced edges."""
 
     def __init__(self):
         self.total = 0.0
@@ -25,8 +26,10 @@ class StepTimer:
         self._t0 = time.perf_counter()
 
     def stop(self, *block_on) -> float:
+        from tpudp.utils.profiler import fetch_fence
+
         for x in block_on:
-            jax.block_until_ready(x)
+            fetch_fence(x)
         dt = time.perf_counter() - self._t0
         self.total += dt
         self.count += 1

@@ -57,7 +57,7 @@ class Watchdog:
     *Scoped* — arm a deadline around one specific blocking region::
 
         with wd.step():
-            jax.block_until_ready(state)
+            fetch_fence(state.params)  # tpudp.utils.profiler
 
     ``kill=True`` (default) hard-exits the process on a hang — the correct
     behavior for a wedged collective, which no Python exception can unwind;
@@ -79,6 +79,7 @@ class Watchdog:
         self.on_hang = list(on_hang or [])
         self.kill = kill
         self.poll_s = poll_s if poll_s is not None else min(timeout_s / 4, 1.0)
+        self._armed = False
         self._deadline: float | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -103,13 +104,21 @@ class Watchdog:
     # -- heartbeat style ------------------------------------------------
     def arm(self) -> None:
         """Begin continuous monitoring: a hang fires if no :meth:`beat`
-        arrives within ``timeout_s``."""
-        self.beat()
+        arrives within ``timeout_s``.  Re-arming after a handled hang
+        (kill=False) clears the recorded hang so the watchdog is reusable."""
+        self._hang_seen.clear()
+        with self._lock:
+            self._armed = True
+            self._deadline = time.monotonic() + self.timeout_s
 
     def beat(self) -> None:
         """Record progress; pushes the deadline ``timeout_s`` into the
         future.  Raises :class:`StepHangError` (kill=False mode) if a hang
-        was detected since the last beat."""
+        was detected since the last beat.  A no-op unless :meth:`arm` is
+        active, so components that beat unconditionally (Trainer epoch/eval
+        loops) never start monitoring by accident."""
+        if not self._armed:
+            return
         if self._hang_seen.is_set() and not self.kill:
             raise StepHangError(f"no progress within {self.timeout_s}s")
         with self._lock:
@@ -117,6 +126,7 @@ class Watchdog:
 
     def disarm(self) -> None:
         with self._lock:
+            self._armed = False
             self._deadline = None
 
     # -- hot path ------------------------------------------------------
