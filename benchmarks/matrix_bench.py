@@ -68,17 +68,21 @@ def main() -> None:
     results = []
 
     def emit(name, sec_per_step, loss, *, unit, per_sec, flops,
-             extra=None):
+             extra=None, devices=None):
+        # devices defaults to the mesh size; single-device configs
+        # (part1_single) pass devices=1 so per-chip numbers aren't divided
+        # by chips they never used.
+        nd = n_dev if devices is None else devices
         row = {
             "config": name,
             "sec_per_step": round(sec_per_step, 5),
             "unit": unit,
-            "value": round(per_sec / n_dev, 1),
+            "value": round(per_sec / nd, 1),
             "total_per_sec": round(per_sec, 1),
-            "devices": n_dev,
+            "devices": nd,
             "device_kind": kind,
             "mfu": (round(m, 4)
-                    if (m := mfu(flops, sec_per_step, kind, n_dev))
+                    if (m := mfu(flops, sec_per_step, kind, nd))
                     is not None else None),
             "final_loss": round(loss, 4),
         }
@@ -122,7 +126,8 @@ def main() -> None:
             extra["grad_allreduce_wall_time_s"] = round(
                 coll["allreduce_wall_time_s"], 6)
         emit(name, sec, loss, unit="images/sec/chip",
-             per_sec=vgg_batch / sec, flops=vgg_flops, extra=extra)
+             per_sec=vgg_batch / sec, flops=vgg_flops, extra=extra,
+             devices=1 if m is None else None)
 
     # ---- ResNet-50 at ImageNet geometry --------------------------------
     if only is None or "resnet50" in only:

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Wait for the TPU relay to come back, then run the full benchmark battery.
+# Probes cheaply (fast-failing jax.devices() + tiny matmul) every PERIOD
+# seconds; on the first healthy probe runs bench.py, matrix_bench.py and
+# flash_attention_bench.py back to back (never concurrently — the relay
+# wedges if two processes touch the TPU at once) and writes their outputs
+# under bench_results/.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+PERIOD="${PERIOD:-180}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-90}"
+log() { echo "[$(date +%H:%M:%S)] $*" >> bench_results/watch.log; }
+
+probe() {
+  timeout "$PROBE_TIMEOUT" python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp, numpy as np
+d = jax.devices()
+assert d and d[0].platform != "cpu"
+x = jnp.ones((256, 256), jnp.bfloat16)
+np.asarray(jnp.sum(x @ x))
+EOF
+}
+
+log "watcher started (period=${PERIOD}s)"
+while true; do
+  if probe; then
+    log "TPU healthy; running bench battery"
+    BENCH_TRIES=2 BENCH_TIMEOUT=900 timeout 2100 python bench.py \
+      > bench_results/bench.json 2> bench_results/bench.err
+    log "bench.py rc=$? -> bench_results/bench.json"
+    MATRIX_STEPS=30 timeout 3600 python benchmarks/matrix_bench.py \
+      > bench_results/matrix.jsonl 2> bench_results/matrix.err
+    log "matrix_bench rc=$? -> bench_results/matrix.jsonl"
+    timeout 3600 python benchmarks/flash_attention_bench.py \
+      > bench_results/flash.jsonl 2> bench_results/flash.err
+    log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
+    log "battery done"
+    exit 0
+  fi
+  log "TPU unavailable; sleeping ${PERIOD}s"
+  sleep "$PERIOD"
+done

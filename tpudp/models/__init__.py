@@ -1,6 +1,7 @@
 """Model zoo: config-driven VGG family (reference parity) plus beyond-parity
-ResNet and GPT-2 families reusing the same train/sync layers."""
+ResNet, GPT-2 and ViT families reusing the same train/sync layers."""
 
 from tpudp.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401
 from tpudp.models.resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
 from tpudp.models.gpt2 import GPT2, GPT2Config, gpt2_small, gpt2_medium  # noqa: F401
+from tpudp.models.vit import ViT, ViTConfig, vit_tiny, vit_small, vit_base_224  # noqa: F401
