@@ -21,6 +21,40 @@ def test_latest_step_dir_ignores_orbax_tmp(tmp_path):
     assert latest_step_dir(tmp_path / "missing") is None
 
 
+def test_async_writer_roundtrip(tmp_path, mesh4):
+    """AsyncCheckpointWriter under the CLI's actual hazard: training
+    continues with a DONATING step while the write is in flight, so the
+    saved state's device buffers are invalidated mid-write.  The writer
+    must have copied device->host before save() returned (orbax's async
+    contract) for the restored snapshot to be intact."""
+    from tpudp.utils.checkpoint import AsyncCheckpointWriter
+
+    model = VGG11()
+    tx = make_optimizer()
+    state = init_state(model, tx)
+    step = make_train_step(model, tx, mesh4, "allreduce", donate=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=8), jnp.int32)
+    state, _ = step(state, x, y)
+    # Host-side reference copy of what the snapshot must contain.
+    expect_kernel = np.asarray(state.params["Dense_0"]["kernel"]).copy()
+
+    with AsyncCheckpointWriter() as writer:
+        path = writer.save(tmp_path / "async_ckpt", state)
+        # The donating step invalidates `state`'s buffers while the write
+        # is (potentially) still in flight — exactly what the CLI's next
+        # epoch does after epoch_end_fn staged an async save.
+        state2, _ = step(state, x, y)
+        writer.wait()
+    assert int(state2.step) == 2
+
+    restored = restore_checkpoint(path, init_state(model, tx))
+    assert int(restored.step) == 1  # the snapshot, not the later state2
+    np.testing.assert_array_equal(
+        expect_kernel, np.asarray(restored.params["Dense_0"]["kernel"]))
+
+
 def test_roundtrip_resume(tmp_path, mesh4):
     model = VGG11()
     tx = make_optimizer()

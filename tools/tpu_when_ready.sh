@@ -22,6 +22,22 @@ np.asarray(jnp.sum(x @ x))
 EOF
 }
 
+# The battery "succeeded" only if bench.py produced a real measurement
+# (a headline line with a non-zero value); a relay that wedges between the
+# probe and the bench yields empty/error output and the watcher must keep
+# waiting, not exit with empty result files.
+battery_ok() {
+  python - <<'EOF'
+import json, sys
+try:
+    lines = open("bench_results/bench.json").read().strip().splitlines()
+    head = next(json.loads(l) for l in lines if l.startswith("{"))
+    sys.exit(0 if head.get("value", 0) > 0 else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
 log "watcher started (period=${PERIOD}s)"
 while true; do
   if probe; then
@@ -29,6 +45,11 @@ while true; do
     BENCH_TRIES=2 BENCH_TIMEOUT=900 timeout 2100 python bench.py \
       > bench_results/bench.json 2> bench_results/bench.err
     log "bench.py rc=$? -> bench_results/bench.json"
+    if ! battery_ok; then
+      log "bench produced no real measurement; re-entering wait loop"
+      sleep "$PERIOD"
+      continue
+    fi
     MATRIX_STEPS=30 timeout 3600 python benchmarks/matrix_bench.py \
       > bench_results/matrix.jsonl 2> bench_results/matrix.err
     log "matrix_bench rc=$? -> bench_results/matrix.jsonl"

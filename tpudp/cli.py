@@ -47,6 +47,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", type=str, default=None,
                    help="save TrainState each epoch and auto-resume from the "
                         "latest checkpoint (beyond-reference capability)")
+    p.add_argument("--checkpoint-async", action="store_true",
+                   help="overlap checkpoint writes with the next epoch's "
+                        "training (orbax async; the epoch barrier no longer "
+                        "waits for filesystem IO)")
     p.add_argument("--platform", type=str, default=None,
                    help="force a JAX platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
@@ -90,6 +94,10 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     from tpudp.models import VGG11
 
     args = build_parser(description).parse_args(argv)
+    if args.checkpoint_async and not args.checkpoint_dir:
+        raise SystemExit(
+            "error: --checkpoint-async requires --checkpoint-dir (nothing "
+            "would be checkpointed otherwise)")
     if args.platform:  # must precede the first device query
         jax.config.update("jax_platforms", args.platform)
     initialize_distributed(args.master, args.num_nodes, args.rank, PORT)
@@ -161,6 +169,7 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
     start_epoch = 0
     epoch_end_fn = None
+    async_writer = None
     if args.checkpoint_dir:
         import os
 
@@ -218,16 +227,29 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
             watchdog.on_hang.append(_emergency_dump)
 
+        if args.checkpoint_async:
+            from tpudp.utils.checkpoint import AsyncCheckpointWriter
+
+            async_writer = AsyncCheckpointWriter()
+
         def epoch_end_fn(epoch: int) -> None:
             path = os.path.join(args.checkpoint_dir, f"step_{epoch + 1}")
-            save_checkpoint(path, trainer.state)
-            print(f"[tpudp] saved checkpoint {path}")
+            if async_writer is not None:
+                async_writer.save(path, trainer.state)
+                print(f"[tpudp] checkpoint {path} writing in background")
+            else:
+                save_checkpoint(path, trainer.state)
+                print(f"[tpudp] saved checkpoint {path}")
 
     from tpudp.utils.profiler import trace
 
-    with trace(args.profile_dir):
-        trainer.fit(train_loader, test_loader, epochs=args.epochs,
-                    start_epoch=start_epoch, epoch_end_fn=epoch_end_fn)
+    try:
+        with trace(args.profile_dir):
+            trainer.fit(train_loader, test_loader, epochs=args.epochs,
+                        start_epoch=start_epoch, epoch_end_fn=epoch_end_fn)
+    finally:
+        if async_writer is not None:
+            async_writer.close()  # join the last epoch's write
     if watchdog is not None:
         watchdog.stop()
     if args.profile_dir:

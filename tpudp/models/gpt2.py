@@ -78,25 +78,10 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(b, t, h, d // h)
         k = k.reshape(b, t, h, d // h)
         v = v.reshape(b, t, h, d // h)
-        if (cfg.attn_impl == "ring" and cfg.seq_axis is not None
-                and _axis_is_bound(cfg.seq_axis)):
-            from tpudp.parallel.ring_attention import ring_attention
+        from tpudp.ops.attention import multihead_attention
 
-            out = ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
-        elif cfg.attn_impl == "flash" and t % 128 == 0:
-            # Pallas kernel needs 128-multiple blocks on TPU; shorter/ragged
-            # sequences (e.g. the t=16 init trace) take the dense path, which
-            # has identical math and param shapes.
-            from tpudp.ops.flash_attention import flash_attention
-
-            out = flash_attention(q, k, v, causal=True)
-        else:
-            scale = (d // h) ** -0.5
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            mask = jnp.tril(jnp.ones((t, t), bool))
-            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-            probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = multihead_attention(q, k, v, causal=True, impl=cfg.attn_impl,
+                                  dtype=cfg.dtype, seq_axis=cfg.seq_axis)
         out = out.reshape(b, t, d)
         return nn.Dense(d, dtype=cfg.dtype, name="proj")(out)
 

@@ -95,16 +95,10 @@ class EncoderAttention(nn.Module):
         q = q.reshape(b, t, h, d // h)
         k = k.reshape(b, t, h, d // h)
         v = v.reshape(b, t, h, d // h)
-        if cfg.attn_impl == "flash" and t % 128 == 0:
-            from tpudp.ops.flash_attention import flash_attention
+        from tpudp.ops.attention import multihead_attention
 
-            out = flash_attention(q, k, v, causal=False)
-        else:
-            scale = (d // h) ** -0.5
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            probs = nn.softmax(logits.astype(jnp.float32),
-                               axis=-1).astype(cfg.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = multihead_attention(q, k, v, causal=False, impl=cfg.attn_impl,
+                                  dtype=cfg.dtype)
         out = out.reshape(b, t, d)
         return nn.Dense(d, dtype=cfg.dtype, name="proj")(out)
 

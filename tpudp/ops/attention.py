@@ -1,0 +1,58 @@
+"""Shared multi-head attention dispatch for the transformer models.
+
+One home for the impl-selection rule (dense XLA einsums vs the owned Pallas
+flash kernel vs sequence-parallel ring attention) and the mixed-precision
+softmax policy, so GPT-2 and ViT can never drift apart on kernel
+constraints (the 128-lane block alignment) or numerics.  The reference has
+no attention at all (SURVEY.md §5 long-context entry); this layer is where
+tpudp's sequence models meet the hot-op kernel.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def multihead_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool,
+    impl: str = "dense",
+    dtype=jnp.float32,
+    seq_axis: str | None = None,
+) -> jnp.ndarray:
+    """``(B, T, H, Dh)`` q/k/v -> ``(B, T, H, Dh)`` attention output.
+
+    ``impl``:
+      * ``'dense'`` — XLA einsum chain, fp32 softmax, ``dtype`` matmuls.
+      * ``'flash'`` — the Pallas kernel (tpudp.ops.flash_attention) when the
+        token count meets its 128-lane block alignment; silently the dense
+        path otherwise (identical math, same param-free contract).
+      * ``'ring'`` — exact sequence-parallel ring attention over the bound
+        mesh axis ``seq_axis`` (causal only); requires the caller to run
+        under ``shard_map`` with that axis, and falls back to dense when the
+        axis is unbound (e.g. the single-device init trace).
+    """
+    t = q.shape[1]
+    if impl == "ring" and seq_axis is not None:
+        from tpudp.mesh import axis_is_bound
+
+        if axis_is_bound(seq_axis):
+            from tpudp.parallel.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+    if impl == "flash" and t % 128 == 0:
+        from tpudp.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
