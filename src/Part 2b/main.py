@@ -1,7 +1,9 @@
 """Part 2b — collective all-reduce gradient sync (reference: src/Part 2b/main.py:116-119).
 
 lax.psum over the mesh, divided by world size. Pass --ring to use the
-hand-rolled lax.ppermute ring all-reduce instead (north-star config).
+hand-rolled lax.ppermute ring all-reduce instead (north-star config), or
+--bf16-grads to compress the gradient collective to bfloat16 on the wire
+(half the bytes; beyond-reference).
 """
 import os
 import sys
@@ -12,6 +14,9 @@ from tpudp.cli import run_part
 
 if __name__ == "__main__":
     ring = "--ring" in sys.argv
-    argv = [a for a in sys.argv[1:] if a != "--ring"]
-    run_part("ring" if ring else "allreduce",
-             "Part 2b: DP with all-reduce grad sync", argv=argv)
+    bf16 = "--bf16-grads" in sys.argv
+    argv = [a for a in sys.argv[1:] if a not in ("--ring", "--bf16-grads")]
+    if ring and bf16:
+        raise SystemExit("error: --ring and --bf16-grads are exclusive")
+    sync = "ring" if ring else ("allreduce_bf16" if bf16 else "allreduce")
+    run_part(sync, "Part 2b: DP with all-reduce grad sync", argv=argv)

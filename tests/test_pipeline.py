@@ -101,3 +101,23 @@ def test_pp_rejects_indivisible_layers():
         make_pp_train_step(model, tx, mesh,
                            init_state(model, tx, input_shape=(1, 8)),
                            n_microbatches=2)
+
+
+def test_pp_remat_matches_plain():
+    """remat=True (jax.checkpoint around each block) is semantics-preserving
+    for the pipelined step: same loss as the plain PP step."""
+    mesh = make_mesh_nd({"data": 1, "pipe": 4})
+    model = gpt2_small(**TINY)
+    tx = make_optimizer(learning_rate=0.01)
+    state = init_state(model, tx, input_shape=(1, 8), seed=0)
+    data = _data(steps=2, vocab=TINY["vocab_size"])
+    losses = {}
+    for remat in (False, True):
+        st, step = make_pp_train_step(model, tx, mesh, state,
+                                      n_microbatches=2, donate=False,
+                                      remat=remat)
+        for x, y in data:
+            st, loss = step(st, x, y)
+        losses[remat] = float(loss)
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-5, atol=1e-6)

@@ -48,6 +48,43 @@ def test_strategies_produce_mean(mesh8, name):
         )
 
 
+def test_allreduce_bf16_approximates_mean(mesh8):
+    """The compressed rung: mean to bf16 tolerance, output dtype restored."""
+    n = mesh8.size
+    rng = np.random.default_rng(3)
+    tree = {"w": rng.normal(size=(n, 7, 13)).astype(np.float32),
+            "b": rng.normal(size=(n, 5)).astype(np.float32)}
+    expected = jax.tree.map(lambda x: x.mean(axis=0), tree)
+    sharded_in = jax.device_put(tree, NamedSharding(mesh8, P(DATA_AXIS)))
+    out = _run_sync(mesh8, "allreduce_bf16", sharded_in)
+    for k in tree:
+        assert np.asarray(out[k]).dtype == np.float32  # dtype restored
+        np.testing.assert_allclose(
+            np.asarray(out[k]).reshape(expected[k].shape), expected[k],
+            rtol=2e-2, atol=2e-2)  # bf16 has ~8 mantissa bits
+
+
+def test_allreduce_bf16_trains_like_fp32(mesh8):
+    """End to end: the compressed rung follows the fp32 trajectory closely
+    enough to train (loose tolerance — wire precision, not exactness)."""
+    from tpudp.models.vgg import VGG11
+    from tpudp.train import init_state, make_optimizer, make_train_step
+
+    model = VGG11()
+    tx = make_optimizer(learning_rate=0.01)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=16), jnp.int32)
+    losses = {}
+    for name in ("allreduce", "allreduce_bf16"):
+        state = init_state(model, tx)
+        step = make_train_step(model, tx, mesh8, name, donate=False)
+        for _ in range(3):
+            state, loss = step(state, x, y)
+        losses[name] = float(loss)
+    assert abs(losses["allreduce"] - losses["allreduce_bf16"]) < 0.05
+
+
 def test_ring_equals_psum(mesh8):
     n = mesh8.size
     rng = np.random.default_rng(1)

@@ -149,3 +149,38 @@ def test_trainer_fit_smoke(mesh4):
     assert any("Training time after 1 epoch" in ln for ln in lines)
     assert any("Test set: Average loss" in ln for ln in lines)
     assert int(trainer.state.step) == 4  # 64/16 batches
+
+
+def test_remat_identical_trajectory(mesh8):
+    """jax.checkpoint is semantics-preserving: remat=True follows the plain
+    step's loss trajectory (same program modulo recompute scheduling)."""
+    batches = _fake_batches(3, seed=7)
+    model = VGG11()
+    tx = make_optimizer()
+    losses = {}
+    for remat in (False, True):
+        state = init_state(model, tx)
+        step = make_train_step(model, tx, mesh8, "allreduce", donate=False,
+                               remat=remat)
+        for images, labels in batches:
+            state, loss = step(state, jnp.asarray(images), jnp.asarray(labels))
+        losses[remat] = float(loss)
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_optimizer_trains():
+    """Beyond-reference optimizer option: AdamW drives the step contract."""
+    model = VGG11()
+    tx = make_optimizer(learning_rate=1e-3, optimizer="adamw")
+    state = init_state(model, tx)
+    step = make_train_step(model, tx, None, "none", donate=False)
+    images, labels = _fake_batches(1, seed=9)[0]
+    x, y = jnp.asarray(images), jnp.asarray(labels)
+    first = None
+    for _ in range(6):
+        state, loss = step(state, x, y)
+        first = float(loss) if first is None else first
+    assert float(loss) < first
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make_optimizer(optimizer="lion")

@@ -62,6 +62,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    default="auto",
                    help="host augmentation backend: fused C++/OpenMP kernel "
                         "(tpudp/native) or bit-identical numpy")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize activations during backward "
+                        "(jax.checkpoint): identical gradients, lower peak "
+                        "HBM, one extra forward's FLOPs")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="split each device batch into N sequential "
                         "microbatches, accumulating gradients before the "
@@ -160,7 +164,8 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         ).start()
     trainer = Trainer(model, mesh, sync, seed=args.seed,
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode,
-                      watchdog=watchdog, grad_accum=args.grad_accum)
+                      watchdog=watchdog, grad_accum=args.grad_accum,
+                      remat=args.remat)
     print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
           f"data={data_backend}+prefetch{args.prefetch}")

@@ -207,8 +207,15 @@ def make_pp_train_step(
     data_axis: str | None = DATA_AXIS,
     pipe_axis: str = PIPE_AXIS,
     donate: bool = True,
+    remat: bool = False,
 ):
     """DP x PP train step for tpudp.models.gpt2.GPT2.
+
+    ``remat=True`` rematerializes each block during backward
+    (``jax.checkpoint`` around the per-layer apply): the scan then stashes
+    only the per-tick block inputs instead of every intermediate inside
+    every block, which is the activation term that dominates PP memory at
+    large microbatch counts.
 
     Takes a standard (single-device-layout) TrainState, re-lays params and
     momentum out into the stacked pipeline layout, shards blocks over the
@@ -255,6 +262,8 @@ def make_pp_train_step(
     pp_state = state.replace(params=pp_params, opt_state=pp_opt)
 
     block_fn = lambda p, x: Block(cfg).apply({"params": p}, x)
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
 
     def body(st, tokens, targets):
         b, t = tokens.shape

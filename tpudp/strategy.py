@@ -21,7 +21,7 @@ Mesh axis requirements (build the mesh with tpudp.mesh.make_mesh_nd):
   ============  ===========================  ==========================
   ``tp``        ``data`` x ``model``         ``rules`` (partition rules)
   ``fsdp``      ``data``                     ``min_size``
-  ``pp``        [``data`` x] ``pipe``        ``n_microbatches``
+  ``pp``        [``data`` x] ``pipe``        ``n_microbatches``, ``remat``
   ``ep``        ``data`` x ``expert``        ``aux_loss_coef``
   ``sp``        ``data`` x ``seq``           —
   ============  ===========================  ==========================
@@ -137,11 +137,12 @@ def _build_pp(model, tx, mesh, state, donate, options):
     pipe_axis = options.pop("pipe_axis", PIPE_AXIS)
     data_axis = options.pop(
         "data_axis", DATA_AXIS if DATA_AXIS in mesh.shape else None)
+    remat = options.pop("remat", False)
     _no_extra(options, "pp")
     st, step = make_pp_train_step(model, tx, mesh, state,
                                   n_microbatches=n_microbatches,
                                   data_axis=data_axis, pipe_axis=pipe_axis,
-                                  donate=donate)
+                                  donate=donate, remat=remat)
     eval_step = make_pp_eval_step(model, mesh, st,
                                   n_microbatches=n_microbatches,
                                   data_axis=data_axis, pipe_axis=pipe_axis)

@@ -78,6 +78,7 @@ def make_optimizer(
     schedule: str | None = None,
     warmup_steps: int = 0,
     total_steps: int | None = None,
+    optimizer: str = "sgd",
 ) -> optax.GradientTransformation:
     """torch.optim.SGD(lr, momentum, weight_decay) equivalent
     (reference: ``src/Part 2a/main.py:61-62``).  ``add_decayed_weights``
@@ -88,7 +89,11 @@ def make_optimizer(
     The reference trains at a constant lr; ``schedule`` adds the standard
     beyond-reference options: ``'cosine'`` (linear warmup over
     ``warmup_steps`` then cosine decay to 0 across ``total_steps``) or
-    ``'linear'`` (warmup then linear decay)."""
+    ``'linear'`` (warmup then linear decay).
+
+    ``optimizer='adamw'`` swaps in AdamW (decoupled weight decay, the
+    transformer-training default; ``momentum`` is ignored) — beyond-
+    reference, for the GPT-2/ViT families where SGD undertrains."""
     if schedule is None:
         lr = learning_rate
     elif schedule == "cosine":
@@ -106,6 +111,11 @@ def make_optimizer(
             [warmup_steps])
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
+    if optimizer == "adamw":
+        return optax.adamw(lr, weight_decay=weight_decay)
+    if optimizer != "sgd":
+        raise ValueError(
+            f"unknown optimizer {optimizer!r}; choose 'sgd' or 'adamw'")
     return optax.chain(
         optax.add_decayed_weights(weight_decay),
         optax.sgd(lr, momentum=momentum),
@@ -139,7 +149,7 @@ def init_state(
 
 def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
                       axis_name, grad_accum: int = 1,
-                      aux_loss_coef: float = 0.01):
+                      aux_loss_coef: float = 0.01, remat: bool = False):
     """fwd + loss + bwd + sync + SGD update — shared by all SPMD wrappers.
 
     ``grad_accum > 1`` splits the (per-device) batch into that many
@@ -156,16 +166,27 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
     through the DEFAULT path get router balancing, not only the EP rung.
     Dense models sow nothing — the term vanishes and the trajectory is
     untouched.  The returned/logged loss stays the pure CE term so curves
-    are comparable across rungs and with the reference."""
+    are comparable across rungs and with the reference.
 
-    def loss_fn(params, batch_stats, x, y):
+    ``remat=True`` rematerializes the forward pass during backward
+    (``jax.checkpoint``): activations are recomputed instead of stashed,
+    cutting peak HBM by ~the activation footprint at the cost of one extra
+    forward — the standard TPU memory/FLOPs trade, and semantics-preserving
+    (bit-identical gradients, tested)."""
+
+    def apply_model(params, batch_stats, x):
         variables = {"params": params}
         mutable = ["intermediates"]
         if batch_stats:
             variables["batch_stats"] = batch_stats
             mutable.append("batch_stats")
-        logits, mutated = model.apply(variables, x, train=True,
-                                      mutable=mutable)
+        return model.apply(variables, x, train=True, mutable=mutable)
+
+    if remat:
+        apply_model = jax.checkpoint(apply_model)
+
+    def loss_fn(params, batch_stats, x, y):
+        logits, mutated = apply_model(params, batch_stats, x)
         new_bs = mutated.get("batch_stats", batch_stats)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
         loss = ce
@@ -226,8 +247,13 @@ def make_train_step(
     donate: bool = True,
     grad_accum: int = 1,
     aux_loss_coef: float = 0.01,
+    remat: bool = False,
 ) -> Callable:
     """Build the jitted ``(state, images, labels) -> (state, loss)`` step.
+
+    ``remat=True`` rematerializes activations during backward
+    (``jax.checkpoint``) — identical gradients, lower peak HBM, one extra
+    forward's FLOPs; enables batch/model sizes that would otherwise OOM.
 
     ``grad_accum`` splits each device's batch into that many sequential
     microbatches, accumulating the mean gradient before the single sync +
@@ -253,7 +279,7 @@ def make_train_step(
         def train_step(state, images, labels):
             return _loss_and_updates(model, tx, state, images, labels,
                                       sync_fn, None, grad_accum,
-                                      aux_loss_coef)
+                                      aux_loss_coef, remat)
 
         return train_step
 
@@ -270,7 +296,7 @@ def make_train_step(
         def train_step(state, images, labels):
             return _loss_and_updates(model, tx, state, images, labels,
                                       sync_fn, None, grad_accum,
-                                      aux_loss_coef)
+                                      aux_loss_coef, remat)
 
         return train_step
 
@@ -280,7 +306,7 @@ def make_train_step(
     def body(state, images, labels):
         return _loss_and_updates(model, tx, state, images, labels,
                                   sync_fn, DATA_AXIS, grad_accum,
-                                  aux_loss_coef)
+                                  aux_loss_coef, remat)
 
     sharded = jax.shard_map(
         body,
@@ -559,6 +585,7 @@ class Trainer:
         log_fn: Callable[[str], None] = print,
         watchdog=None,
         grad_accum: int = 1,
+        remat: bool = False,
     ):
         self.model = model
         self.mesh = mesh
@@ -576,6 +603,7 @@ class Trainer:
             self.train_step = make_train_step(
                 model, self.tx, mesh, sync, spmd_mode=spmd_mode,
                 donate=(timing_mode != "split"), grad_accum=grad_accum,
+                remat=remat,
             )
             if timing_mode == "split":
                 self.fwd_step = make_forward_step(model, mesh)
@@ -592,6 +620,12 @@ class Trainer:
             if grad_accum != 1:
                 raise ValueError(
                     f"grad_accum is a DP-rung option (strategy={strategy!r})")
+            if remat:
+                # PP takes remat via strategy_options; TP/FSDP/EP/SP steps
+                # are memory-sharded already.
+                raise ValueError(
+                    f"remat is a DP-rung option (strategy={strategy!r}); "
+                    "for pp pass strategy_options={'remat': True}")
             if sync != "allreduce" or spmd_mode != "shard_map":
                 raise ValueError(
                     f"sync={sync!r}/spmd_mode={spmd_mode!r} are DP-rung "
