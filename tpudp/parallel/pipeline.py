@@ -20,9 +20,14 @@ stage S-1: head), and one structural ``psum`` over the pipe axis assembles
 the full gradient — no double counting, verified against the single-device
 oracle in tests/test_pipeline.py.
 
-Known non-goal (documented): this is GPipe (fill/drain bubble of
-``(S-1)/(M+S-1)``), not interleaved/looping 1F1B — the schedule slot is a
-clean extension point and the bubble shrinks with more microbatches.
+Two schedules are provided (``make_pp_train_step(schedule=...)``):
+``'gpipe'`` — the fill/drain loop above, backward derived by autodiff
+(activation residuals for all M microbatches live at the fwd/bwd
+boundary); and ``'1f1b'`` — :func:`onef1b_loss_and_grads`, a manual
+one-forward-one-backward interleave whose per-stage activation stash is
+bounded by the STAGE count (``2S-1`` microbatch inputs) independent of M,
+recomputing each stage's forward at backward time.  Both match the
+single-device oracle exactly (tests/test_pipeline.py).
 """
 
 from __future__ import annotations
@@ -144,6 +149,175 @@ def gpipe(
     return outputs
 
 
+def onef1b_loss_and_grads(
+    cfg,
+    params: dict,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    n_microbatches: int,
+    block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    axis_name: str = PIPE_AXIS,
+) -> tuple[jnp.ndarray, dict]:
+    """One-forward-one-backward pipeline schedule with O(stages) activation
+    memory, inside ``shard_map``.
+
+    The GPipe path (:func:`gpipe` + autodiff) stashes residuals for ALL
+    ``M`` microbatches before any backward runs — activation memory grows
+    with M, which defeats the point of raising M to shrink the bubble.
+    This schedule interleaves: a microbatch's backward starts as soon as
+    its forward reaches the last stage, so stage ``s`` holds at most
+    ``2(S-1-s)+1 <= 2S-1`` in-flight microbatch INPUTS — bounded by the
+    stage count, independent of M.
+
+    Mechanics (one ``lax.scan`` over ``M + 2(S-1)`` ticks; every tick does
+    at most one stage-forward and one stage-backward):
+
+      * Forward of microbatch ``m`` runs on stage ``s`` at tick ``s + m``;
+        activations travel the ICI ring via forward ``ppermute``.  The
+        stage INPUT is stashed in a ``2S-1``-slot ring buffer (slots are
+        collision-free: a slot is always consumed before its reuse tick).
+      * Backward of ``m`` runs on stage ``s`` at tick ``2(S-1) - s + m``
+        (the last stage backs up the microbatch the same tick it forwards
+        it); cotangents travel the reverse ring.
+      * The backward recomputes the stage forward from the stashed input
+        (``jax.vjp`` at backward time) instead of storing residuals —
+        1F1B-with-recompute: one extra stage-forward of FLOPs per
+        microbatch buys the O(S) memory bound.
+      * Shared params: the embedding vjp accumulates on stage 0, the
+        head/final-LN vjp on the last stage; the caller's structural psum
+        over the pipe axis assembles them exactly as in the GPipe path.
+
+    Returns ``(loss, grads)`` with the same contract as
+    ``jax.value_and_grad(loss_fn)`` in :func:`make_pp_train_step`: the mean
+    CE loss (nonzero only on the last stage, psum-assembled by the caller)
+    and a gradient tree structured like ``params``.
+    """
+    from tpudp.models.gpt2 import embed_tokens, lm_head
+
+    s_size = lax.axis_size(axis_name)
+    sidx = lax.axis_index(axis_name)
+    last = s_size - 1
+    b, t = tokens.shape
+    m_count = n_microbatches
+    mb = b // m_count
+    slots = 2 * s_size - 1
+    blocks = params["blocks"]
+    shared = {k: v for k, v in params.items() if k != "blocks"}
+
+    tok_mb = tokens.reshape(m_count, mb, t)
+    tgt_mb = targets.reshape(m_count, mb, t)
+    fwd_perm = [(j, (j + 1) % s_size) for j in range(s_size)]
+    bwd_perm = [((j + 1) % s_size, j) for j in range(s_size)]
+
+    def stage_apply(p_stack, x):
+        return lax.scan(lambda h, p: (block_fn(p, h), None), x, p_stack)[0]
+
+    def head_loss(sh, h, tgts):
+        """Sum (not mean) CE of one microbatch — normalized once at the end."""
+        logits = lm_head(cfg, sh, h)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgts).sum()
+
+    # Probe one embed to get the activation shape/dtype flowing the ring.
+    act_proto = jax.eval_shape(lambda sh: embed_tokens(cfg, sh, tok_mb[0]),
+                               shared)
+    zeros_act = jnp.zeros(act_proto.shape, act_proto.dtype)
+
+    def tick(carry, tt):
+        stash, fwd_in, bwd_in, gblocks, gshared, loss_sum = carry
+
+        # ---- forward slot: microbatch tt - sidx ------------------------
+        m_f = tt - sidx
+        f_active = (m_f >= 0) & (m_f < m_count)
+        m_f_c = jnp.clip(m_f, 0, m_count - 1)
+        toks_f = lax.dynamic_index_in_dim(tok_mb, m_f_c, 0, keepdims=False)
+        x = jnp.where(sidx == 0, embed_tokens(cfg, shared, toks_f), fwd_in)
+        slot_f = m_f_c % slots
+        prev = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(f_active, x, prev), slot_f, 0)
+        y = stage_apply(blocks, x)
+
+        # ---- backward slot: microbatch tt - (2(S-1) - sidx) ------------
+        m_b = tt - (2 * (s_size - 1) - sidx)
+        b_active = (m_b >= 0) & (m_b < m_count)
+        m_b_c = jnp.clip(m_b, 0, m_count - 1)
+        slot_b = m_b_c % slots
+        # For the last stage slot_b == slot_f this tick (written above), so
+        # the read below sees the microbatch it just forwarded.
+        x_b = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
+        toks_b = lax.dynamic_index_in_dim(tok_mb, m_b_c, 0, keepdims=False)
+        tgts_b = lax.dynamic_index_in_dim(tgt_mb, m_b_c, 0, keepdims=False)
+
+        # Last stage only: loss + its cotangent from THIS tick's forward
+        # output.  lax.cond (runtime per-device predicate, collective-free
+        # branches) so the other S-1 stages never run the (mb, t, vocab)
+        # head matmul + pullback — without it the head would execute
+        # S*(M+2S-2) times per step instead of M.
+        def _head(operands):
+            sh, h, tg = operands
+            loss_mb, head_vjp = jax.vjp(
+                lambda sh_, h_: head_loss(sh_, h_, tg), sh, h)
+            dsh, dy_h = head_vjp(jnp.ones((), loss_mb.dtype))
+            return loss_mb, dsh, dy_h
+
+        def _head_zero(operands):
+            sh, h, _tg = operands
+            return (jnp.zeros((), jnp.float32),
+                    jax.tree.map(jnp.zeros_like, sh), jnp.zeros_like(h))
+
+        loss_mb, dshared_head, dy_head = lax.cond(
+            (sidx == last) & b_active, _head, _head_zero, (shared, y, tgts_b))
+        dy = jnp.where(sidx == last, dy_head, bwd_in)
+        dy = jnp.where(b_active, dy, jnp.zeros_like(dy))
+
+        # Stage backward, recomputing the forward from the stashed input.
+        _, stage_vjp = jax.vjp(stage_apply, blocks, x_b)
+        dblocks, dx = stage_vjp(dy)
+        gblocks = jax.tree.map(lambda a, g: a + g, gblocks, dblocks)
+
+        # Stage 0 only: convert the input cotangent into embedding grads.
+        def _embed(operands):
+            sh, tk, d = operands
+            _, embed_vjp = jax.vjp(lambda sh_: embed_tokens(cfg, sh_, tk), sh)
+            (dsh,) = embed_vjp(d)
+            return dsh
+
+        def _embed_zero(operands):
+            sh, _tk, _d = operands
+            return jax.tree.map(jnp.zeros_like, sh)
+
+        dshared_embed = lax.cond(
+            (sidx == 0) & b_active, _embed, _embed_zero,
+            (shared, toks_b, dx))
+        gshared = jax.tree.map(
+            lambda a, ge, gh: a + ge + gh,
+            gshared, dshared_embed, dshared_head)
+        loss_sum = loss_sum + loss_mb
+
+        return (stash, lax.ppermute(y, axis_name, fwd_perm),
+                lax.ppermute(dx, axis_name, bwd_perm),
+                gblocks, gshared, loss_sum), None
+
+    init = (
+        jnp.zeros((slots,) + zeros_act.shape, zeros_act.dtype),
+        zeros_act,
+        zeros_act,
+        jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), blocks),
+        jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), shared),
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, _, gblocks, gshared, loss_sum), _ = lax.scan(
+        tick, init, jnp.arange(m_count + 2 * (s_size - 1)))
+
+    denom = jnp.asarray(b * t, jnp.float32)  # sum -> mean normalization
+    grads = {**{k: jax.tree.map(lambda g: g / denom, v)
+                for k, v in gshared.items()},
+             "blocks": jax.tree.map(lambda g: g / denom, gblocks)}
+    grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+    return loss_sum / denom, grads
+
+
 def make_pp_eval_step(
     model,
     mesh: Mesh,
@@ -208,8 +382,19 @@ def make_pp_train_step(
     pipe_axis: str = PIPE_AXIS,
     donate: bool = True,
     remat: bool = False,
+    schedule: str = "gpipe",
 ):
     """DP x PP train step for tpudp.models.gpt2.GPT2.
+
+    ``schedule`` selects the microbatch schedule:
+      * ``'gpipe'`` — fill/drain via :func:`gpipe` + autodiff; activation
+        residuals for all ``n_microbatches`` are live at the fwd/bwd
+        boundary (memory grows with M).
+      * ``'1f1b'`` — :func:`onef1b_loss_and_grads`; backward interleaves
+        with forward so at most ``2S-1`` microbatch inputs are live per
+        stage (memory bounded by the STAGE count), recomputing each
+        stage's forward at backward time.  Same gradients to numerical
+        tolerance (oracle-parity tested).
 
     ``remat=True`` rematerializes each block during backward
     (``jax.checkpoint`` around the per-layer apply): the scan then stashes
@@ -250,6 +435,9 @@ def make_pp_train_step(
     s = mesh.shape[pipe_axis]
     if num_layers % s != 0:
         raise ValueError(f"{num_layers} layers not divisible by {s} stages")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown schedule {schedule!r}; choose 'gpipe' or '1f1b'")
 
     def relayout(tree):
         return stack_block_params(tree, num_layers)
@@ -287,7 +475,12 @@ def make_pp_train_step(
             # garbage carries zero loss and zero gradient.
             return jnp.where(sidx == last, ce, 0.0)
 
-        loss, grads = jax.value_and_grad(loss_fn)(st.params)
+        if schedule == "1f1b":
+            loss, grads = onef1b_loss_and_grads(
+                cfg, st.params, tokens, targets, n_microbatches, block_fn,
+                pipe_axis)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(st.params)
         # Assemble: shared-param grads live on the stages that produced them
         # (stage 0: embedding lookup; last: head) -> structural psum over
         # pipe; block grads are already stage-local. Then mean over data.

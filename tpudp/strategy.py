@@ -138,11 +138,13 @@ def _build_pp(model, tx, mesh, state, donate, options):
     data_axis = options.pop(
         "data_axis", DATA_AXIS if DATA_AXIS in mesh.shape else None)
     remat = options.pop("remat", False)
+    schedule = options.pop("schedule", "gpipe")
     _no_extra(options, "pp")
     st, step = make_pp_train_step(model, tx, mesh, state,
                                   n_microbatches=n_microbatches,
                                   data_axis=data_axis, pipe_axis=pipe_axis,
-                                  donate=donate, remat=remat)
+                                  donate=donate, remat=remat,
+                                  schedule=schedule)
     eval_step = make_pp_eval_step(model, mesh, st,
                                   n_microbatches=n_microbatches,
                                   data_axis=data_axis, pipe_axis=pipe_axis)
