@@ -70,7 +70,12 @@ def child_main() -> None:
     model = VGG11(dtype=dtype)
     tx = make_optimizer()
     state = init_state(model, tx)
-    step = make_train_step(model, tx, mesh, sync="allreduce", donate=False)
+    # Donated state buffers: XLA updates params/momentum in place instead of
+    # copying the full TrainState every step (the loop always rebinds
+    # ``state`` to the step's output, so the invalidated input is never
+    # reused).  BENCH_DONATE=0 opts out for A/B comparison.
+    donate = os.environ.get("BENCH_DONATE", "1") != "0"
+    step = make_train_step(model, tx, mesh, sync="allreduce", donate=donate)
 
     rng = np.random.default_rng(0)
     images = jax.device_put(

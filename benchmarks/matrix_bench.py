@@ -11,7 +11,8 @@ Covers (BASELINE.json configs[0-4] + the GSPMD/coordinator rungs):
   gpt2_small     GPT-2-small (124M) DP, tokens/sec/chip
 
 Prints one JSON line per config (machine-readable) and a final summary
-line.  Each VGG DP config also reports the measured wall-time of its
+line.  Steps donate their state buffers (in-place param/momentum update on
+device, as real training does).  Each VGG DP config also reports the measured wall-time of its
 gradient collective so ring-vs-psum is a direct comparison.  Run on the
 TPU chip by default; MATRIX_PLATFORM=cpu (+ forced device count) for the
 simulated-mesh smoke mode.  Knobs: MATRIX_STEPS, MATRIX_WARMUP,
@@ -114,7 +115,7 @@ def main() -> None:
         tx = make_optimizer()
         state = init_state(model, tx)
         step = make_train_step(model, tx, m, sync, spmd_mode=mode,
-                               donate=False)
+                               donate=True)
         x = images if m is None else jax.device_put(images, data_sh)
         y = labels if m is None else jax.device_put(labels, data_sh)
         sec, loss = measure(step, state, (x, y), steps, warmup)
@@ -137,7 +138,7 @@ def main() -> None:
         tx = make_optimizer()
         state = init_state(model, tx,
                            input_shape=(1, image_size, image_size, 3))
-        step = make_train_step(model, tx, mesh, "allreduce", donate=False)
+        step = make_train_step(model, tx, mesh, "allreduce", donate=True)
         x = jax.device_put(
             jnp.asarray(rng.normal(size=(rn_batch, image_size, image_size, 3)),
                         jnp.float32), data_sh)
@@ -159,7 +160,7 @@ def main() -> None:
         cfg = model.config
         tx = make_optimizer(learning_rate=0.01)
         state = init_state(model, tx, input_shape=(1, seq))
-        step = make_train_step(model, tx, mesh, "allreduce", donate=False)
+        step = make_train_step(model, tx, mesh, "allreduce", donate=True)
         toks = jax.device_put(
             jnp.asarray(rng.integers(0, cfg.vocab_size, size=(g_batch, seq)),
                         jnp.int32), data_sh)

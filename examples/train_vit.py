@@ -101,7 +101,13 @@ def main() -> None:
         rng.integers(0, args.num_classes,
                      size=args.train_size).astype(np.int32),
     )
-    loader = DataLoader(ds, args.batch_size, train=True, seed=0)
+    # ImageNet normalization at ImageNet geometry (as train_resnet.py does);
+    # the loader's CIFAR-10 defaults apply only at CIFAR geometry.
+    norm = {}
+    if args.image_size != 32:
+        norm = dict(mean=np.asarray((0.485, 0.456, 0.406), np.float32),
+                    std=np.asarray((0.229, 0.224, 0.225), np.float32))
+    loader = DataLoader(ds, args.batch_size, train=True, seed=0, **norm)
     if len(loader) == 0:
         raise SystemExit(
             f"error: --train-size {args.train_size} yields zero full batches "

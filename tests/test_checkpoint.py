@@ -1,6 +1,7 @@
 """Checkpoint/resume roundtrip: restored state continues training with the
 exact same trajectory as the uninterrupted run."""
 
+import os
 import jax.numpy as jnp
 import numpy as np
 
@@ -82,3 +83,24 @@ def test_roundtrip_resume(tmp_path, mesh4):
         np.asarray(cont.params["Dense_0"]["kernel"]),
         np.asarray(restored.params["Dense_0"]["kernel"]),
     )
+
+
+def test_prune_step_dirs(tmp_path):
+    """Retention: keep the newest N step dirs; never touch orbax tmp dirs
+    or the emergency dump; numeric (not lexicographic) ordering."""
+    import pytest
+
+    from tpudp.utils.checkpoint import latest_step_dir, prune_step_dirs
+
+    for name in ("step_1", "step_2", "step_9", "step_10", "emergency",
+                 "step_11.orbax-checkpoint-tmp-7"):
+        (tmp_path / name).mkdir()
+    deleted = prune_step_dirs(tmp_path, keep=2)
+    assert sorted(os.path.basename(d) for d in deleted) == ["step_1", "step_2"]
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["emergency", "step_10", "step_11.orbax-checkpoint-tmp-7",
+                    "step_9"]
+    assert latest_step_dir(tmp_path).endswith("step_10")
+    assert prune_step_dirs(tmp_path / "missing", keep=2) == []
+    with pytest.raises(ValueError):
+        prune_step_dirs(tmp_path, keep=0)

@@ -51,6 +51,9 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="overlap checkpoint writes with the next epoch's "
                         "training (orbax async; the epoch barrier no longer "
                         "waits for filesystem IO)")
+    p.add_argument("--keep-checkpoints", type=int, default=None, metavar="N",
+                   help="retain only the newest N epoch checkpoints, "
+                        "deleting older step_* dirs after each save")
     p.add_argument("--platform", type=str, default=None,
                    help="force a JAX platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N "
@@ -102,6 +105,10 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         raise SystemExit(
             "error: --checkpoint-async requires --checkpoint-dir (nothing "
             "would be checkpointed otherwise)")
+    if args.keep_checkpoints is not None and args.keep_checkpoints < 1:
+        raise SystemExit(
+            f"error: --keep-checkpoints must be >= 1 "
+            f"(got {args.keep_checkpoints})")
     if args.platform:  # must precede the first device query
         jax.config.update("jax_platforms", args.platform)
     initialize_distributed(args.master, args.num_nodes, args.rank, PORT)
@@ -245,6 +252,15 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
             else:
                 save_checkpoint(path, trainer.state)
                 print(f"[tpudp] saved checkpoint {path}")
+            if args.keep_checkpoints and jax.process_index() == 0:
+                # By now the PREVIOUS step's write is durable (sync save, or
+                # the async writer's serialized-saves guarantee), so pruning
+                # older dirs always leaves a restorable latest checkpoint.
+                from tpudp.utils.checkpoint import prune_step_dirs
+
+                for gone in prune_step_dirs(args.checkpoint_dir,
+                                            args.keep_checkpoints):
+                    print(f"[tpudp] pruned old checkpoint {gone}")
 
     from tpudp.utils.profiler import trace
 

@@ -120,6 +120,32 @@ def emergency_dir(root: str | os.PathLike) -> str | None:
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
+def prune_step_dirs(root: str | os.PathLike, keep: int) -> list[str]:
+    """Delete all but the newest ``keep`` ``step_N`` checkpoints under
+    ``root``; returns the deleted paths.  Only exact ``step_<digits>``
+    directories are candidates — orbax tmp dirs and the emergency dump are
+    never touched.  Multi-host callers should invoke this on process 0
+    only, after the save for the newest step has committed (the sync
+    saver and AsyncCheckpointWriter's serialized saves both guarantee the
+    PREVIOUS step is durable by then, so the retained set is always
+    restorable)."""
+    import shutil
+
+    root = os.fspath(root)
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    if not os.path.isdir(root):
+        return []
+    steps = sorted((int(m.group(1)), m.group(0))
+                   for d in os.listdir(root) if (m := _STEP_DIR.match(d)))
+    deleted = []
+    for _, name in steps[:-keep]:
+        path = os.path.join(root, name)
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+    return deleted
+
+
 def latest_step_dir(root: str | os.PathLike) -> str | None:
     """Return the highest-numbered ``step_N`` subdirectory, or None.
 
