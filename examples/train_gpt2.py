@@ -38,6 +38,10 @@ def main() -> None:
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="bfloat16")
+    p.add_argument("--loss-chunk", type=int, default=None, metavar="N",
+                   help="chunked vocabulary loss: compute the tied-head CE "
+                        "over N-token chunks so the (batch*seq, vocab) "
+                        "logits tensor is never materialized (DP path only)")
     p.add_argument("--tokens-file", type=str, default=None)
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
@@ -81,12 +85,18 @@ def main() -> None:
           f"seq_parallel={args.seq_parallel} seq_len={args.seq_len} "
           f"batch={args.batch_size} dtype={args.dtype}")
 
+    if args.loss_chunk is not None and args.loss_chunk < 1:
+        raise SystemExit(
+            f"error: --loss-chunk must be >= 1 (got {args.loss_chunk})")
     if args.seq_parallel:
+        if args.loss_chunk is not None:
+            raise SystemExit("error: --loss-chunk is a DP-path option")
         step = make_seq_parallel_train_step(model, tx, mesh, donate=False)
         sharding = NamedSharding(mesh, P("data", "seq"))
     else:
         mesh1d = Mesh(np.asarray(devices[:d]), ("data",))
-        step = make_train_step(model, tx, mesh1d, "allreduce", donate=False)
+        step = make_train_step(model, tx, mesh1d, "allreduce", donate=False,
+                               loss_chunk=args.loss_chunk)
         sharding = NamedSharding(mesh1d, P("data"))
 
     if args.tokens_file:

@@ -109,6 +109,9 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         raise SystemExit(
             f"error: --keep-checkpoints must be >= 1 "
             f"(got {args.keep_checkpoints})")
+    if args.keep_checkpoints and not args.checkpoint_dir:
+        raise SystemExit(
+            "error: --keep-checkpoints requires --checkpoint-dir")
     if args.platform:  # must precede the first device query
         jax.config.update("jax_platforms", args.platform)
     initialize_distributed(args.master, args.num_nodes, args.rank, PORT)

@@ -138,12 +138,19 @@ class GPT2(nn.Module):
     """Decoder-only LM: ``(B, T) int tokens -> (B, T, vocab) float32 logits``.
 
     ``train`` is accepted for Trainer compatibility (no dropout is used, so
-    train/eval paths are identical and no RNG is needed)."""
+    train/eval paths are identical and no RNG is needed).
+
+    ``return_hidden=True`` returns the ``(B, T, d_model)`` hidden states
+    AFTER the final LayerNorm and skips the head matmul — the hook for the
+    memory-efficient chunked vocabulary loss (tpudp.ops.losses), which
+    applies the tied-embedding head chunk by chunk instead of
+    materializing the full ``(B, T, vocab)`` logits."""
 
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    def __call__(self, tokens: jnp.ndarray, train: bool = False,
+                 return_hidden: bool = False) -> jnp.ndarray:
         del train
         cfg = self.config
         b, t = tokens.shape
@@ -161,5 +168,7 @@ class GPT2(nn.Module):
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"h_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            return x.astype(cfg.dtype)
         logits = wte.attend(x.astype(cfg.dtype))  # tied embedding head
         return logits.astype(jnp.float32)

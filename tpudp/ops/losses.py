@@ -1,0 +1,118 @@
+"""Memory-efficient losses for large-vocabulary LM training.
+
+The standard LM path materializes the full ``(batch*time, vocab)`` logits
+tensor — for GPT-2-small at batch 8, seq 1024 that is ``8*1024*50257``
+fp32 ≈ 1.6 GB live through the softmax backward, usually THE activation
+peak of the whole model.  :func:`chunked_softmax_xent` computes the same
+tied-head cross entropy over token chunks under ``lax.scan`` with a
+rematerialized body, so peak logits memory is ``chunk_size * vocab``
+regardless of batch/sequence — the standard chunked-vocab-loss technique,
+enabling batch sizes the dense path OOMs on.
+
+No reference analogue (the reference is CNN-only, SURVEY.md §5); this is
+TPU-first machinery for the GPT-2 family's hot loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+def chunked_softmax_xent(
+    hidden: jnp.ndarray,
+    embedding: jnp.ndarray,
+    targets: jnp.ndarray,
+    chunk_size: int = 1024,
+) -> jnp.ndarray:
+    """Sum of softmax cross entropies of the tied-embedding head, chunked.
+
+    Args:
+      hidden: ``(..., d_model)`` final hidden states (post final-LayerNorm).
+      embedding: ``(vocab, d_model)`` tied embedding table (the LM head is
+        ``h @ embedding.T``, matching ``tpudp.models.gpt2.lm_head``).
+      targets: ``(...)`` integer labels, same leading shape as ``hidden``.
+      chunk_size: tokens per chunk; peak logits memory is
+        ``chunk_size * vocab`` (the last ragged chunk is padded and the pad
+        positions masked out).
+
+    Returns the SUM of per-token CE losses as fp32 (divide by the token
+    count for the mean).  Differentiable wrt ``hidden`` and ``embedding``;
+    each chunk's logits are rematerialized in the backward
+    (``jax.checkpoint``), so the backward peak matches the forward's.
+    """
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    d = hidden.shape[-1]
+    h = hidden.reshape(-1, d)
+    t = targets.reshape(-1)
+    n = h.shape[0]
+    chunk_size = min(chunk_size, n)
+    pad = (-n) % chunk_size
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
+    valid = jnp.arange(n + pad) < n
+    k = (n + pad) // chunk_size
+
+    @jax.checkpoint
+    def one_chunk(emb, hc, tc, vc):
+        logits = (hc @ emb.T).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        return jnp.where(vc, ce, 0.0).sum()
+
+    def body(total, xs):
+        hc, tc, vc = xs
+        return total + one_chunk(embedding, hc, tc, vc), None
+
+    total, _ = lax.scan(
+        body, jnp.zeros((), jnp.float32),
+        (h.reshape(k, chunk_size, d), t.reshape(k, chunk_size),
+         valid.reshape(k, chunk_size)))
+    return total
+
+
+def chunked_lm_metrics(
+    hidden: jnp.ndarray,
+    embedding: jnp.ndarray,
+    targets: jnp.ndarray,
+    weights: jnp.ndarray,
+    chunk_size: int = 1024,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Chunked eval twin: weighted ``(loss_sum, correct, count)`` with the
+    framework eval contract (tpudp.train.eval_metrics), never materializing
+    the full logits.  ``weights`` is per-sample ``(batch,)``, broadcast over
+    each sample's tokens exactly as the dense eval does."""
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    b = hidden.shape[0]
+    d = hidden.shape[-1]
+    per_token_w = jnp.broadcast_to(
+        weights.reshape((b,) + (1,) * (targets.ndim - 1)), targets.shape)
+    h = hidden.reshape(-1, d)
+    t = targets.reshape(-1)
+    w = per_token_w.reshape(-1).astype(jnp.float32)
+    n = h.shape[0]
+    chunk_size = min(chunk_size, n)
+    pad = (-n) % chunk_size
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+    k = h.shape[0] // chunk_size
+
+    def body(carry, xs):
+        loss_sum, correct = carry
+        hc, tc, wc = xs
+        logits = (hc @ embedding.T).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        hit = (jnp.argmax(logits, -1) == tc).astype(jnp.float32)
+        return (loss_sum + (ce * wc).sum(), correct + (hit * wc).sum()), None
+
+    (loss_sum, correct), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (h.reshape(k, chunk_size, d), t.reshape(k, chunk_size),
+         w.reshape(k, chunk_size)))
+    return loss_sum, correct, w.sum()

@@ -149,7 +149,8 @@ def init_state(
 
 def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
                       axis_name, grad_accum: int = 1,
-                      aux_loss_coef: float = 0.01, remat: bool = False):
+                      aux_loss_coef: float = 0.01, remat: bool = False,
+                      loss_chunk: int | None = None):
     """fwd + loss + bwd + sync + SGD update — shared by all SPMD wrappers.
 
     ``grad_accum > 1`` splits the (per-device) batch into that many
@@ -172,7 +173,13 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
     (``jax.checkpoint``): activations are recomputed instead of stashed,
     cutting peak HBM by ~the activation footprint at the cost of one extra
     forward — the standard TPU memory/FLOPs trade, and semantics-preserving
-    (bit-identical gradients, tested)."""
+    (bit-identical gradients, tested).
+
+    ``loss_chunk`` (LM models only — the model's ``__call__`` must accept
+    ``return_hidden``) computes the tied-head cross entropy chunk by chunk
+    (tpudp.ops.losses.chunked_softmax_xent) so the full ``(batch*time,
+    vocab)`` logits tensor — usually the LM activation peak — is never
+    materialized; same loss/grads to numerical tolerance (tested)."""
 
     def apply_model(params, batch_stats, x):
         variables = {"params": params}
@@ -180,15 +187,25 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
         if batch_stats:
             variables["batch_stats"] = batch_stats
             mutable.append("batch_stats")
+        if loss_chunk:
+            return model.apply(variables, x, train=True, mutable=mutable,
+                               return_hidden=True)
         return model.apply(variables, x, train=True, mutable=mutable)
 
     if remat:
         apply_model = jax.checkpoint(apply_model)
 
     def loss_fn(params, batch_stats, x, y):
-        logits, mutated = apply_model(params, batch_stats, x)
+        out, mutated = apply_model(params, batch_stats, x)
         new_bs = mutated.get("batch_stats", batch_stats)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        if loss_chunk:
+            from tpudp.ops.losses import chunked_softmax_xent
+
+            wte = params["wte"]["embedding"].astype(out.dtype)
+            ce = chunked_softmax_xent(out, wte, y, loss_chunk) / y.size
+        else:
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean()
         loss = ce
         if aux_loss_coef:
             from tpudp.models.moe import collect_moe_aux
@@ -248,12 +265,17 @@ def make_train_step(
     grad_accum: int = 1,
     aux_loss_coef: float = 0.01,
     remat: bool = False,
+    loss_chunk: int | None = None,
 ) -> Callable:
     """Build the jitted ``(state, images, labels) -> (state, loss)`` step.
 
     ``remat=True`` rematerializes activations during backward
     (``jax.checkpoint``) — identical gradients, lower peak HBM, one extra
     forward's FLOPs; enables batch/model sizes that would otherwise OOM.
+
+    ``loss_chunk=N`` (LM models with tied heads, e.g. GPT-2) computes the
+    vocabulary cross entropy over N-token chunks so the full logits tensor
+    is never materialized (see tpudp.ops.losses).
 
     ``grad_accum`` splits each device's batch into that many sequential
     microbatches, accumulating the mean gradient before the single sync +
@@ -279,7 +301,8 @@ def make_train_step(
         def train_step(state, images, labels):
             return _loss_and_updates(model, tx, state, images, labels,
                                       sync_fn, None, grad_accum,
-                                      aux_loss_coef, remat)
+                                      aux_loss_coef, remat,
+                                      loss_chunk)
 
         return train_step
 
@@ -296,7 +319,8 @@ def make_train_step(
         def train_step(state, images, labels):
             return _loss_and_updates(model, tx, state, images, labels,
                                       sync_fn, None, grad_accum,
-                                      aux_loss_coef, remat)
+                                      aux_loss_coef, remat,
+                                      loss_chunk)
 
         return train_step
 
@@ -306,7 +330,7 @@ def make_train_step(
     def body(state, images, labels):
         return _loss_and_updates(model, tx, state, images, labels,
                                   sync_fn, DATA_AXIS, grad_accum,
-                                  aux_loss_coef, remat)
+                                  aux_loss_coef, remat, loss_chunk)
 
     sharded = jax.shard_map(
         body,
@@ -445,16 +469,29 @@ def make_seq_parallel_train_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def eval_metrics(model: nn.Module, state, inputs, labels, weights):
+def eval_metrics(model: nn.Module, state, inputs, labels, weights,
+                 loss_chunk: int | None = None):
     """Shared weighted eval metrics: ``(loss_sum, correct, count)``.
 
     ``weights`` is per-sample ``(batch,)``; for token models the per-token
     loss/accuracy broadcast each sample's weight over its sequence, so
     ``count`` counts weighted TOKENS and the averages are per-token — the
-    natural LM analogues of the reference's per-sample metrics."""
+    natural LM analogues of the reference's per-sample metrics.
+
+    ``loss_chunk`` mirrors the train-path option for tied-head LMs: metrics
+    computed over token chunks (tpudp.ops.losses.chunked_lm_metrics), never
+    materializing the full logits — so eval fits at the same batch sizes
+    the chunked train loss enables."""
     variables = {"params": state.params}
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
+    if loss_chunk:
+        from tpudp.ops.losses import chunked_lm_metrics
+
+        hidden = model.apply(variables, inputs, train=False,
+                             return_hidden=True)
+        emb = state.params["wte"]["embedding"].astype(hidden.dtype)
+        return chunked_lm_metrics(hidden, emb, labels, weights, loss_chunk)
     logits = model.apply(variables, inputs, train=False)
     per = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
     w = jnp.broadcast_to(
@@ -493,14 +530,17 @@ def make_sp_eval_step(
     ))
 
 
-def make_eval_step(model: nn.Module, mesh: Mesh | None) -> Callable:
+def make_eval_step(model: nn.Module, mesh: Mesh | None,
+                   loss_chunk: int | None = None) -> Callable:
     """Jitted sharded eval: ``(state, images, labels, weights) ->
     (loss_sum, correct, count)`` — weight-masked so padded samples in the
     final ragged batch never count (reference evaluates the full test set
-    per rank, ``src/Part 2a/main.py:130-145``; we shard + psum instead)."""
+    per rank, ``src/Part 2a/main.py:130-145``; we shard + psum instead).
+    ``loss_chunk``: chunked tied-head metrics for LMs (see eval_metrics)."""
 
     def metrics(state, images, labels, weights):
-        return eval_metrics(model, state, images, labels, weights)
+        return eval_metrics(model, state, images, labels, weights,
+                            loss_chunk)
 
     if mesh is None:
         return jax.jit(metrics)
@@ -518,6 +558,7 @@ def make_eval_step(model: nn.Module, mesh: Mesh | None) -> Callable:
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(), P(), P()),
+        check_vma=False,  # chunked-metrics scan carries replicated inits
     )
     return jax.jit(sharded)
 
@@ -586,6 +627,7 @@ class Trainer:
         watchdog=None,
         grad_accum: int = 1,
         remat: bool = False,
+        loss_chunk: int | None = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -603,11 +645,19 @@ class Trainer:
             self.train_step = make_train_step(
                 model, self.tx, mesh, sync, spmd_mode=spmd_mode,
                 donate=(timing_mode != "split"), grad_accum=grad_accum,
-                remat=remat,
+                remat=remat, loss_chunk=loss_chunk,
             )
             if timing_mode == "split":
+                if loss_chunk:
+                    # The split-mode forward materializes dense logits —
+                    # exactly the tensor loss_chunk exists to avoid.
+                    raise ValueError(
+                        "loss_chunk is incompatible with "
+                        "timing_mode='split' (the separately-timed forward "
+                        "materializes the full logits)")
                 self.fwd_step = make_forward_step(model, mesh)
-            self.eval_step = make_eval_step(model, mesh)
+            self.eval_step = make_eval_step(model, mesh,
+                                            loss_chunk=loss_chunk)
             self._shard_for = None
             if mesh is not None:
                 data_sh = NamedSharding(mesh, P(DATA_AXIS))
@@ -626,6 +676,9 @@ class Trainer:
                 raise ValueError(
                     f"remat is a DP-rung option (strategy={strategy!r}); "
                     "for pp pass strategy_options={'remat': True}")
+            if loss_chunk:
+                raise ValueError(
+                    f"loss_chunk is a DP-rung option (strategy={strategy!r})")
             if sync != "allreduce" or spmd_mode != "shard_map":
                 raise ValueError(
                     f"sync={sync!r}/spmd_mode={spmd_mode!r} are DP-rung "

@@ -141,7 +141,11 @@ def prune_step_dirs(root: str | os.PathLike, keep: int) -> list[str]:
     deleted = []
     for _, name in steps[:-keep]:
         path = os.path.join(root, name)
-        shutil.rmtree(path, ignore_errors=True)
+        try:
+            shutil.rmtree(path)
+        except OSError as e:
+            print(f"[tpudp] WARNING: could not prune checkpoint {path}: {e}")
+            continue
         deleted.append(path)
     return deleted
 
