@@ -42,6 +42,9 @@ def main() -> None:
                    help="chunked vocabulary loss: compute the tied-head CE "
                         "over N-token chunks so the (batch*seq, vocab) "
                         "logits tensor is never materialized (DP path only)")
+    p.add_argument("--sample", type=int, default=0, metavar="N",
+                   help="after training, greedily generate N tokens from a "
+                        "corpus prompt via the KV-cached decode path")
     p.add_argument("--tokens-file", type=str, default=None)
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
@@ -88,6 +91,17 @@ def main() -> None:
     if args.loss_chunk is not None and args.loss_chunk < 1:
         raise SystemExit(
             f"error: --loss-chunk must be >= 1 (got {args.loss_chunk})")
+    if args.sample:
+        # Validate up front — failing after the training run wastes it.
+        if args.seq_parallel:
+            raise SystemExit(
+                "error: --sample needs the dense DP path (generate() does "
+                "not drive ring attention); drop --seq-parallel")
+        if args.sample + min(16, args.seq_len) > args.seq_len:
+            raise SystemExit(
+                f"error: --sample {args.sample} + prompt "
+                f"{min(16, args.seq_len)} exceeds --seq-len {args.seq_len} "
+                "(the model's position table)")
     if args.seq_parallel:
         if args.loss_chunk is not None:
             raise SystemExit("error: --loss-chunk is a DP-path option")
@@ -129,6 +143,16 @@ def main() -> None:
             print(f"step {it}: loss {(cum - prev_cum) / args.log_every:.4f} "
                   f"({tok_s:,.0f} tok/s)")
             prev_cum, t0 = cum, time.perf_counter()
+
+    if args.sample:
+        from tpudp.models.generate import generate
+
+        prompt_len = min(16, args.seq_len)
+        prompt = jnp.asarray(corpus[:prompt_len][None], jnp.int32)
+        out = generate(model, jax.device_get(state.params), prompt,
+                       args.sample)
+        print(f"[gpt2] greedy sample (prompt {prompt_len} tokens): "
+              f"{np.asarray(out[0, prompt_len:]).tolist()}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,184 @@
+"""Autoregressive generation for the GPT-2 family — KV-cached decode.
+
+The reference has no inference path at all (it is a CNN training
+assignment, SURVEY.md §0); a complete LM framework needs one.  TPU-first
+design:
+
+  * ONE jitted program: prompt prefill + ``max_new_tokens`` decode steps
+    under ``lax.scan`` — static shapes throughout (the cache is a fixed
+    ``(layers, batch, max_len, heads, head_dim)`` buffer written with
+    ``dynamic_update_slice``; attention masks by position instead of
+    growing the sequence), so XLA compiles it once and the MXU sees fixed
+    matmul shapes every step.
+  * The decode step drives the raw param tree directly (same
+    ``h_i/attn/qkv`` layout the training model creates — the raw-param
+    twin pattern of ``tpudp.parallel.pipeline``); a parity test pins it to
+    the training model's logits exactly, so train and serve can never
+    drift.
+  * Greedy (``temperature=0``) or temperature sampling with a JAX PRNG key.
+
+Dense-MLP, dense-attention configs (the GPT-2 default).  Cache memory is
+``2 * L * B * max_len * d_model`` — for generation lengths where that's
+the constraint, raise ``max_len`` only as far as needed (static shape).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpudp.models.gpt2 import GPT2Config, embed_tokens, lm_head
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # (layers, batch, max_len, heads, head_dim)
+    v: jnp.ndarray
+
+    @classmethod
+    def zeros(cls, cfg: GPT2Config, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.num_layers, batch, max_len, cfg.num_heads,
+                 cfg.d_model // cfg.num_heads)
+        return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _layer_norm(p: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Exactly the training model's LayerNorm (flax apply on the raw
+    subtree), so decode can never drift numerically from Block's."""
+    import flax.linen as nn
+
+    return nn.LayerNorm(dtype=jnp.float32).apply({"params": p}, x)
+
+
+def _dense(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
+    return x.astype(dtype) @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)
+
+
+def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
+                  k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                  pos: jnp.ndarray):
+    """One pre-LN block on ``(batch, cur, d)`` new tokens at absolute
+    positions ``pos .. pos+cur-1``, reading/writing the KV cache.
+
+    Mirrors tpudp.models.gpt2.Block exactly (the parity test referee);
+    attention spans the cache up to ``pos`` plus a causal mask within the
+    new tokens."""
+    b, cur, d = x.shape
+    h = cfg.num_heads
+    dh = d // h
+    max_len = k_cache.shape[1]
+
+    hN = _layer_norm(p["ln_1"], x)
+    qkv = _dense(p["attn"]["qkv"], hN, cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, cur, h, dh)
+    k = k.reshape(b, cur, h, dh)
+    v = v.reshape(b, cur, h, dh)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    scale = dh ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    # Key j visible to new-token query i iff j <= pos + i.
+    q_pos = pos + jnp.arange(cur)[:, None]
+    visible = jnp.arange(max_len)[None, :] <= q_pos  # (cur, max_len)
+    logits = jnp.where(visible[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(cfg.dtype))
+    x = x + _dense(p["attn"]["proj"], out.reshape(b, cur, d), cfg.dtype)
+
+    hN = _layer_norm(p["ln_2"], x)
+    m = jax.nn.gelu(_dense(p["mlp_fc"], hN, cfg.dtype))
+    x = x + _dense(p["mlp_proj"], m, cfg.dtype)
+    return x, k_cache, v_cache
+
+
+def _forward_cached(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
+                    cache: KVCache, pos) -> tuple[jnp.ndarray, KVCache]:
+    """Token ids ``(batch, cur)`` at absolute position ``pos`` ->
+    ``(batch, cur, vocab)`` fp32 logits + updated cache."""
+    # Raw-param twins from models.gpt2 (kept in lockstep with
+    # GPT2.__call__ and pinned by the pipeline + generate parity tests).
+    x = embed_tokens(cfg, params, tokens, pos + jnp.arange(tokens.shape[1]))
+    new_k, new_v = [], []
+    for i in range(cfg.num_layers):
+        x, k_i, v_i = _block_decode(cfg, params[f"h_{i}"], x,
+                                    cache.k[i], cache.v[i], pos)
+        new_k.append(k_i)
+        new_v.append(v_i)
+    logits = lm_head(cfg, params, x)
+    return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
+
+
+def generate(
+    model,
+    params: dict,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jnp.ndarray:
+    """Generate ``(batch, prompt_len + max_new_tokens)`` token ids.
+
+    ``model`` is a tpudp GPT2 (dense attention/MLP); ``prompt`` is
+    ``(batch, prompt_len)`` int32.  ``temperature=0`` is greedy argmax;
+    otherwise softmax sampling at that temperature using ``key``.
+    The whole prefill+decode loop jit-compiles as one program; total
+    length is capped at ``model.config.max_seq_len`` (the position table).
+    """
+    cfg = model.config
+    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
+        raise ValueError(
+            "generate() supports dense-attention/dense-MLP GPT-2 configs; "
+            f"got attn_impl={cfg.attn_impl!r} mlp_impl={cfg.mlp_impl!r}")
+    b, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_seq_len ({cfg.max_seq_len})")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    new_tokens = _generate_jit(cfg, params, prompt, key,
+                               max_new_tokens=max_new_tokens,
+                               temperature=float(temperature), total=total)
+    return jnp.concatenate([prompt, new_tokens], axis=1)
+
+
+# Module-level jit keyed on (cfg, shapes, statics): repeated generate()
+# calls with the same geometry reuse the compiled prefill+decode program
+# instead of recompiling per call.
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "temperature",
+                                    "total"))
+def _generate_jit(cfg, params, prompt, key, *, max_new_tokens, temperature,
+                  total):
+    b, prompt_len = prompt.shape
+    cache = KVCache.zeros(cfg, b, total)
+    logits, cache = _forward_cached(cfg, params, prompt, cache, 0)
+    last = logits[:, -1]
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, last_logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(last_logits, sub)
+        logits, cache = _forward_cached(
+            cfg, params, tok[:, None], cache, prompt_len + i)
+        return (cache, logits[:, -1], key), tok
+
+    _, toks = lax.scan(step, (cache, last, key), jnp.arange(max_new_tokens))
+    return toks.swapaxes(0, 1)  # (batch, max_new_tokens)
