@@ -107,10 +107,21 @@ def main() -> None:
         ("dp_coordinator", mesh, "coordinator", "shard_map"),
         ("dp_gspmd", mesh, "allreduce", "gspmd"),
     ]
+    def run_config(name, fn):
+        """One config crashing (OOM, transient backend fault) must not
+        cost the remaining rows — the TPU window may not reopen."""
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001
+            row = {"config": name,
+                   "error": f"{type(exc).__name__}: {exc}"[:500]}
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
     grad_tree = None
-    for name, m, sync, mode in vgg_ladder:
-        if only and name not in only:
-            continue
+
+    def run_vgg(name, m, sync, mode):
+        nonlocal grad_tree
         model = VGG11(dtype=jnp.bfloat16)
         tx = make_optimizer()
         state = init_state(model, tx)
@@ -130,8 +141,13 @@ def main() -> None:
              per_sec=vgg_batch / sec, flops=vgg_flops, extra=extra,
              devices=1 if m is None else None)
 
+    for name, m, sync, mode in vgg_ladder:
+        if only and name not in only:
+            continue
+        run_config(name, lambda: run_vgg(name, m, sync, mode))
+
     # ---- ResNet-50 at ImageNet geometry --------------------------------
-    if only is None or "resnet50" in only:
+    def run_resnet():
         rn_batch = int(os.environ.get("MATRIX_RESNET_BATCH", 256))
         image_size = int(os.environ.get("MATRIX_RESNET_IMAGE", 224))
         model = ResNet50(dtype=jnp.bfloat16)
@@ -152,8 +168,11 @@ def main() -> None:
                  resnet_fwd_flops(rn_batch, image_size=image_size)),
              extra={"global_batch": rn_batch, "image_size": image_size})
 
+    if only is None or "resnet50" in only:
+        run_config("resnet50", run_resnet)
+
     # ---- GPT-2-small ---------------------------------------------------
-    if only is None or "gpt2_small" in only:
+    def run_gpt2():
         g_batch = int(os.environ.get("MATRIX_GPT2_BATCH", 8))
         seq = int(os.environ.get("MATRIX_GPT2_SEQ", 1024))
         model = gpt2_small(dtype=jnp.bfloat16)
@@ -173,6 +192,9 @@ def main() -> None:
                  d_model=cfg.d_model, vocab_size=cfg.vocab_size,
                  mlp_ratio=cfg.mlp_ratio)),
              extra={"global_batch": g_batch, "seq_len": seq})
+
+    if only is None or "gpt2_small" in only:
+        run_config("gpt2_small", run_gpt2)
 
     print(json.dumps({"matrix": results}))
 

@@ -20,8 +20,11 @@ def _model_and_params(seed=0, **overrides):
     return model, state.params
 
 
-def test_greedy_matches_full_forward():
-    model, params = _model_and_params()
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_greedy_matches_full_forward(dtype):
+    """Parity must hold for bf16 too — the op/dtype sequence of the decode
+    attention mirrors the training path exactly."""
+    model, params = _model_and_params(dtype=dtype)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, TINY["vocab_size"], size=(2, 5)),
                          jnp.int32)

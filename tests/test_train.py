@@ -184,3 +184,43 @@ def test_adamw_optimizer_trains():
     assert float(loss) < first
     with pytest.raises(ValueError, match="unknown optimizer"):
         make_optimizer(optimizer="lion")
+
+
+def test_metrics_jsonl_export(mesh8, tmp_path):
+    """Machine-readable observability: one parseable JSON line per train
+    window, eval and epoch, alongside the reference-format prints."""
+    import json
+
+    path = tmp_path / "metrics.jsonl"
+    model = VGG11()
+    trainer = Trainer(model, mesh8, log_every=2, log_fn=lambda s: None,
+                      metrics_jsonl=str(path))
+
+    class Loader:
+        def __init__(self):
+            rng = np.random.default_rng(0)
+            self.b = [(jnp.asarray(rng.normal(size=(16, 32, 32, 3)),
+                                   jnp.float32),
+                       jnp.asarray(rng.integers(0, 10, size=16), jnp.int32),
+                       jnp.ones((16,), jnp.float32)) for _ in range(4)]
+
+        def set_epoch(self, e):
+            pass
+
+        def __iter__(self):
+            return iter(self.b)
+
+        def __len__(self):
+            return len(self.b)
+
+    loader = Loader()
+    trainer.fit(loader, test_loader=loader, epochs=1)
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    assert kinds.count("train_window") == 2  # 4 batches / log_every=2
+    assert kinds.count("eval") == 1
+    assert kinds.count("epoch") == 1
+    win = [r for r in records if r["kind"] == "train_window"]
+    assert win[0]["warmup_window"] and not win[1]["warmup_window"]
+    assert all(r["samples_per_sec"] > 0 and np.isfinite(r["loss"])
+               for r in win)

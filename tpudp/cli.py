@@ -78,6 +78,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="batches prepared ahead on a background thread "
                         "(reference DataLoader num_workers=2 analogue); "
                         "0 disables")
+    p.add_argument("--metrics-jsonl", type=str, default=None, metavar="PATH",
+                   help="append machine-readable metrics (one JSON line per "
+                        "train window / eval / epoch) to PATH, alongside the "
+                        "reference-format prints; process 0 only")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="capture an XLA/TPU profiler trace of the training "
                         "run into this directory (TensorBoard trace-viewer "
@@ -175,7 +179,7 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     trainer = Trainer(model, mesh, sync, seed=args.seed,
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode,
                       watchdog=watchdog, grad_accum=args.grad_accum,
-                      remat=args.remat)
+                      remat=args.remat, metrics_jsonl=args.metrics_jsonl)
     print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
           f"data={data_backend}+prefetch{args.prefetch}")

@@ -80,15 +80,19 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
+    # Same op/dtype sequence as ops.attention.multihead_attention's dense
+    # path (einsum in cfg.dtype, fp32 softmax) — in bf16, rounding QK^T
+    # differently would break exact argmax parity with the training model.
     scale = dh ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k_cache.astype(jnp.float32)) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
     # Key j visible to new-token query i iff j <= pos + i.
     q_pos = pos + jnp.arange(cur)[:, None]
     visible = jnp.arange(max_len)[None, :] <= q_pos  # (cur, max_len)
-    logits = jnp.where(visible[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(cfg.dtype))
+    logits = jnp.where(visible[None, None], logits,
+                       jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
     x = x + _dense(p["attn"]["proj"], out.reshape(b, cur, d), cfg.dtype)
 
     hN = _layer_norm(p["ln_2"], x)

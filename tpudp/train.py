@@ -628,6 +628,7 @@ class Trainer:
         grad_accum: int = 1,
         remat: bool = False,
         loss_chunk: int | None = None,
+        metrics_jsonl: str | None = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -640,6 +641,12 @@ class Trainer:
         self.timing_mode = timing_mode
         self.log_every = log_every
         self.log = log_fn
+        # Machine-readable observability: one JSON line per train window /
+        # eval / epoch, appended to this path (process 0 only) alongside the
+        # reference-format prints.  The reference's only observability is
+        # stdout prints (SURVEY.md §5).
+        self.metrics_jsonl = (
+            metrics_jsonl if jax.process_index() == 0 else None)
         self.fwd_step = None
         if strategy == "dp":
             self.train_step = make_train_step(
@@ -708,6 +715,14 @@ class Trainer:
             return self._put(images), self._put(labels)
         return images, labels
 
+    def _emit_metrics(self, record: dict) -> None:
+        if self.metrics_jsonl is None:
+            return
+        import json
+
+        with open(self.metrics_jsonl, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
     def train_epoch(self, loader, epoch: int = 0) -> float:
         """One epoch; returns mean loss. Prints the reference's metric lines.
 
@@ -765,6 +780,15 @@ class Trainer:
                             it, bwd_t / self.log_every))
                     self.log("Average Pass time in iter {} is {}".format(
                         it, window_time / self.log_every))
+                self._emit_metrics({
+                    "kind": "train_window", "epoch": epoch, "iter": it,
+                    "loss": losses[-1],
+                    "sec_per_iter": window_time / self.log_every,
+                    "samples_per_sec": (self.log_every
+                                        * int(np.shape(images)[0])
+                                        / window_time),
+                    "warmup_window": it == self.log_every,
+                })
                 fwd_t, bwd_t = 0.0, 0.0
                 window_start = time.perf_counter()
             beat()  # watchdog heartbeat: an iteration completed
@@ -796,6 +820,8 @@ class Trainer:
                 avg_loss, int(correct), int(count), 100.0 * accuracy
             )
         )
+        self._emit_metrics({"kind": "eval", "avg_loss": avg_loss,
+                            "accuracy": accuracy, "count": count})
         return avg_loss, accuracy
 
     def fit(self, train_loader, test_loader=None, epochs: int = 1,
@@ -824,11 +850,14 @@ class Trainer:
             start = time.perf_counter()
             self.train_epoch(train_loader, epoch)
             fetch_fence(self.state.params)  # honest epoch wall-time edge
+            epoch_s = time.perf_counter() - start
             self.log(
                 "Training time after {} epoch is {}".format(
-                    epoch + 1, time.perf_counter() - start
+                    epoch + 1, epoch_s
                 )
             )
+            self._emit_metrics({"kind": "epoch", "epoch": epoch,
+                                "seconds": epoch_s})
             if test_loader is not None:
                 self.evaluate(test_loader)
             if epoch_end_fn is not None:
