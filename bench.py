@@ -113,6 +113,26 @@ def child_main() -> None:
     # Single-chip perf criterion: analytic model FLOPs / (time * peak).
     flops_per_step = train_step_flops(vgg_fwd_flops(batch))
     step_mfu = mfu(flops_per_step, sec_per_step, device_kind, n_dev)
+    # Independent cross-check: XLA's own FLOPs count for the compiled
+    # step.  Post-fusion and PER PARTITION (the SPMD program of one
+    # device), so on an N-device mesh it is ~analytic/N; None when the
+    # backend doesn't expose cost analysis.  Sanity signal, not the MFU
+    # basis.  Daemon-thread + timeout like measure_collective below: the
+    # lower/compile round trip rides the wedge-prone relay and must never
+    # stop the headline line from printing after a completed measurement.
+    xla_box = {"flops": None}
+
+    def _xla_cost():
+        from tpudp.utils.flops import xla_cost_flops
+
+        xla_box["flops"] = xla_cost_flops(step, state, images, labels)
+
+    import threading
+
+    xt = threading.Thread(target=_xla_cost, daemon=True)
+    xt.start()
+    xt.join(timeout=float(os.environ.get("BENCH_COST_TIMEOUT", 60)))
+    xla_flops = xla_box["flops"]
 
     # North-star companion metric (BASELINE.json:2): wall-time of the DP
     # gradient all-reduce over this mesh, on a pytree shaped like the
@@ -146,6 +166,7 @@ def child_main() -> None:
         "sec_per_step": round(sec_per_step, 5),
         "mfu": round(step_mfu, 4) if step_mfu is not None else None,
         "model_flops_per_step": flops_per_step,
+        "xla_flops_per_partition": xla_flops,
         "baseline_4node_gloo_images_per_sec": BASELINE_4NODE_GLOO_IPS,
         "final_loss": round(float(loss), 4),
         "grad_allreduce_wall_time_s": (

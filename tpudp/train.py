@@ -736,9 +736,11 @@ class Trainer:
         losses = []
         prev_loss_sum = float(self.state.loss_sum)
         window_start = time.perf_counter()
+        window_samples = 0
         it = 0
         beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         for it, (images, labels, _w) in enumerate(loader, start=1):
+            window_samples += int(np.shape(images)[0])
             images, labels = self._device_batch(images, labels)
             if self.timing_mode == "split":
                 # fetch_fence, not block_until_ready: under relay transports
@@ -784,11 +786,10 @@ class Trainer:
                     "kind": "train_window", "epoch": epoch, "iter": it,
                     "loss": losses[-1],
                     "sec_per_iter": window_time / self.log_every,
-                    "samples_per_sec": (self.log_every
-                                        * int(np.shape(images)[0])
-                                        / window_time),
+                    "samples_per_sec": window_samples / window_time,
                     "warmup_window": it == self.log_every,
                 })
+                window_samples = 0
                 fwd_t, bwd_t = 0.0, 0.0
                 window_start = time.perf_counter()
             beat()  # watchdog heartbeat: an iteration completed

@@ -121,3 +121,20 @@ def gpt2_fwd_flops(batch: int, seq_len: int, *, num_layers: int = 12,
 def train_step_flops(fwd_flops: int) -> int:
     """Backward is ~2x forward (grad wrt activations + grad wrt weights)."""
     return 3 * fwd_flops
+
+
+def xla_cost_flops(jitted_fn, *args) -> float | None:
+    """XLA's own FLOPs estimate for a jitted function at these args — an
+    independent cross-check of the analytic counts above (the two differ
+    by design: XLA counts every op post-fusion, the analytic count only
+    matmul/conv MACs).  Returns None when the backend/relay doesn't expose
+    cost analysis."""
+    try:
+        compiled = jitted_fn.lower(*args).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # older jax: one per device
+            analysis = analysis[0]
+        flops = analysis.get("flops") if analysis else None
+        return float(flops) if flops and flops > 0 else None
+    except Exception:  # pragma: no cover - backend-dependent surface
+        return None
