@@ -120,14 +120,14 @@ def child_main() -> None:
     # basis.  Daemon-thread + timeout like measure_collective below: the
     # lower/compile round trip rides the wedge-prone relay and must never
     # stop the headline line from printing after a completed measurement.
+    import threading
+
     xla_box = {"flops": None}
 
     def _xla_cost():
         from tpudp.utils.flops import xla_cost_flops
 
         xla_box["flops"] = xla_cost_flops(step, state, images, labels)
-
-    import threading
 
     xt = threading.Thread(target=_xla_cost, daemon=True)
     xt.start()
@@ -146,8 +146,6 @@ def child_main() -> None:
 
         grad_shaped = jax.tree.map(jnp.zeros_like, state.params)
         coll.update(measure_collective(mesh, grad_shaped, steps=10, warmup=2))
-
-    import threading
 
     th = threading.Thread(target=_measure, daemon=True)
     th.start()

@@ -17,10 +17,14 @@ causality).
 """
 
 import json
+import os
 import sys
 import time
 
 import jax
+
+if os.environ.get("FLASH_PLATFORM"):  # cpu smoke mode (axon pins platforms)
+    jax.config.update("jax_platforms", os.environ["FLASH_PLATFORM"])
 import jax.numpy as jnp
 import numpy as np
 
@@ -50,18 +54,24 @@ def attention_grad_flops(b, t, h, dh, causal=True):
 
 def main(*ts: int) -> None:
     ts = ts or (4096, 8192, 16384)
-    b, h, dh = 4, 12, 64
+    b = int(os.environ.get("FLASH_B", 4))
+    h = int(os.environ.get("FLASH_H", 12))
+    dh = 64
     kind = jax.devices()[0].device_kind
     peak = chip_peak_flops(kind)
 
     for t in ts:
+      try:
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q, k, v = (jax.random.normal(kk, (b, t, h, dh), jnp.bfloat16)
                    for kk in ks)
 
-        def loss_flash(q, k, v):
-            return jnp.sum(
-                flash_attention(q, k, v, causal=True).astype(jnp.float32))
+        def make_loss_flash(bq, bk):
+            def loss_flash(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal=True, block_q=bq,
+                                    block_k=bk).astype(jnp.float32))
+            return loss_flash
 
         def make_loss_dense(tt):
             def loss_dense(q, k, v):
@@ -75,7 +85,35 @@ def main(*ts: int) -> None:
                         jnp.float32))
             return loss_dense
 
-        flash_ms = _time_grad(loss_flash, q, k, v) * 1e3
+        # Block-size sweep: the best (block_q, block_k) depends on the
+        # chip's VMEM/MXU balance, so the one TPU window should find it
+        # rather than trusting the 128x128 default.  FLASH_SWEEP=0 pins
+        # the default for quick runs.
+        if os.environ.get("FLASH_SWEEP", "1") != "0":
+            candidates = [(128, 128), (128, 256), (256, 128), (256, 256),
+                          (512, 512)]
+        else:
+            candidates = [(128, 128)]
+        # Clamp to t (flash_attention's own clamping rule), dedupe, then
+        # keep only divisible configs — short t degrades to one candidate
+        # instead of none.
+        candidates = sorted({(min(bq, t), min(bk, t))
+                             for bq, bk in candidates
+                             if t % min(bq, t) == 0 and t % min(bk, t) == 0})
+        flash_ms, best_blocks, last_exc = None, None, None
+        for bq, bk in candidates:
+            try:
+                ms = _time_grad(make_loss_flash(bq, bk), q, k, v) * 1e3
+            except Exception as e:  # noqa: BLE001 - e.g. VMEM overflow at 512
+                last_exc = e
+                continue
+            if flash_ms is None or ms < flash_ms:
+                flash_ms, best_blocks = ms, (bq, bk)
+        if flash_ms is None:
+            # Preserve the real failure for the unattended-run postmortem.
+            raise RuntimeError(
+                f"no flash block config ran at t={t}: "
+                f"{type(last_exc).__name__}: {last_exc}") from last_exc
 
         dense_ms = None
         dense_b = b
@@ -94,6 +132,7 @@ def main(*ts: int) -> None:
         flops = attention_grad_flops(b, t, h, dh)
         row = {
             "t": t, "b": b, "h": h, "dh": dh, "dtype": "bfloat16",
+            "block_q": best_blocks[0], "block_k": best_blocks[1],
             "flash_ms": round(flash_ms, 2),
             "dense_ms": round(dense_ms, 2) if dense_ms else None,
             "dense_batch": dense_b if dense_ms else 0,
@@ -104,6 +143,10 @@ def main(*ts: int) -> None:
             "device_kind": kind,
         }
         print(json.dumps(row), flush=True)
+      except Exception as exc:  # noqa: BLE001 - one t must not cost the rest
+        print(json.dumps({"t": t,
+                          "error": f"{type(exc).__name__}: {exc}"[:500]}),
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,80 @@
+"""Format bench_results/ artifacts into BASELINE.md-ready markdown.
+
+The TPU watcher (tools/tpu_when_ready.sh) drops raw JSON into
+bench_results/{bench.json, matrix.jsonl, flash.jsonl}; this prints the
+"Measured values (round N)" markdown table rows for BASELINE.md so
+recording results is one command even if the TPU window opens at the last
+minute:
+
+    python tools/record_bench.py [--dir bench_results]
+"""
+
+import argparse
+import json
+import os
+
+
+def _rows(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    for line in open(path):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default="bench_results")
+    args = p.parse_args()
+
+    head = next((r for r in _rows(os.path.join(args.dir, "bench.json"))
+                 if r.get("metric")), None)
+    if head:
+        if head.get("value", 0) > 0:
+            print(f"| tpudp fused DP step ({head['device_kind']}, "
+                  f"{head['dtype']}, batch {head['global_batch']}, donated) "
+                  f"| **{head['value']:,} images/sec/chip** "
+                  f"({head['sec_per_step'] * 1e3:.2f} ms/step, "
+                  f"MFU {head.get('mfu')}, "
+                  f"{head.get('vs_baseline')}x the 4-node Gloo bound) "
+                  f"| `bench.py` | |")
+            if head.get("grad_allreduce_wall_time_s") is not None:
+                print(f"| grad all-reduce wall time | "
+                      f"{head['grad_allreduce_wall_time_s'] * 1e3:.3f} ms "
+                      f"({head.get('allreduce_gbps')} GB/s on "
+                      f"{head.get('grad_bytes')} bytes) | `bench.py` | |")
+        else:
+            print(f"| bench.py | FAILED: {head.get('error')} | | |")
+
+    for r in _rows(os.path.join(args.dir, "matrix.jsonl")):
+        if "config" not in r or "matrix" in r:
+            continue
+        if "error" in r:
+            print(f"| {r['config']} | ERROR: {r['error'][:120]} | "
+                  f"`matrix_bench.py` | |")
+        else:
+            coll = r.get("grad_allreduce_wall_time_s")
+            coll_s = f", allreduce {coll * 1e3:.3f} ms" if coll else ""
+            print(f"| {r['config']} | {r['value']:,} {r['unit']} "
+                  f"(MFU {r.get('mfu')}{coll_s}) | `matrix_bench.py` | |")
+
+    for r in _rows(os.path.join(args.dir, "flash.jsonl")):
+        if "error" in r:
+            print(f"| flash t={r.get('t')} | ERROR: {r['error'][:120]} | "
+                  f"`flash_attention_bench.py` | |")
+        elif "t" in r:
+            print(f"| flash attention t={r['t']} "
+                  f"(blocks {r.get('block_q')}x{r.get('block_k')}) | "
+                  f"{r['flash_ms']} ms vs dense {r.get('dense_ms')} ms "
+                  f"(**{r.get('ratio_dense_over_flash')}x**, kernel MFU "
+                  f"{r.get('flash_mfu')}) | `flash_attention_bench.py` | |")
+
+
+if __name__ == "__main__":
+    main()

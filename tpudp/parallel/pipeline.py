@@ -28,6 +28,15 @@ one-forward-one-backward interleave whose per-stage activation stash is
 bounded by the STAGE count (``2S-1`` microbatch inputs) independent of M,
 recomputing each stage's forward at backward time.  Both match the
 single-device oracle exactly (tests/test_pipeline.py).
+
+Deliberate non-goal: Megatron-style INTERLEAVED 1F1B (virtual stages,
+round-robin chunk placement).  Its bubble win assumes ramp-phase time
+slots cost less than steady-state ones; under ``lax.scan`` every tick
+compiles to the same fixed program, so masked ramp ticks cost full price
+and the interleave would only lengthen the scan (``M + 2(SV-1)`` ticks vs
+``M + 2(S-1)``) without reducing wall time.  Harvesting the interleaved
+bubble on TPU requires a non-uniform (unrolled) schedule whose program
+size grows with M*V — the wrong trade under XLA's compile-once model.
 """
 
 from __future__ import annotations
