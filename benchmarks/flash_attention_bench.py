@@ -100,6 +100,11 @@ def main(*ts: int) -> None:
         candidates = sorted({(min(bq, t), min(bk, t))
                              for bq, bk in candidates
                              if t % min(bq, t) == 0 and t % min(bk, t) == 0})
+        if not candidates:
+            raise ValueError(
+                f"t={t} is not divisible by any candidate block size "
+                "(lengths must be multiples of 128, or < 512 for the "
+                "clamped fallback)")
         flash_ms, best_blocks, last_exc = None, None, None
         for bq, bk in candidates:
             try:

@@ -36,6 +36,8 @@ def main() -> None:
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--clip-norm", type=float, default=None,
+                   help="global-norm gradient clipping (LM stabilizer)")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="bfloat16")
     p.add_argument("--loss-chunk", type=int, default=None, metavar="N",
@@ -81,7 +83,8 @@ def main() -> None:
         seq_axis="seq" if args.seq_parallel else None,
     )
     model = GPT2(cfg)
-    tx = make_optimizer(learning_rate=args.lr, momentum=0.9, weight_decay=0.0)
+    tx = make_optimizer(learning_rate=args.lr, momentum=0.9, weight_decay=0.0,
+                        clip_norm=args.clip_norm)
     state = init_state(model, tx, input_shape=(1, min(args.seq_len, 16)))
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
     print(f"[gpt2] params={n_params/1e6:.1f}M mesh=({d}x{s}) "

@@ -66,3 +66,26 @@ def test_validation():
         mlp_impl="moe", num_experts=2, capacity_factor=4.0)
     with pytest.raises(ValueError, match="dense"):
         generate(moe_model, moe_params, prompt, 1)
+
+
+def test_top_k_and_top_p_sampling():
+    model, params = _model_and_params()
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    key = jax.random.PRNGKey(11)
+    # top_k=1 at any temperature collapses to greedy.
+    greedy = generate(model, params, prompt, 5)
+    k1 = generate(model, params, prompt, 5, temperature=2.0, top_k=1, key=key)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    # tiny top_p keeps only the argmax token -> also greedy.
+    p_tiny = generate(model, params, prompt, 5, temperature=2.0, top_p=1e-6,
+                      key=key)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))
+    # joint truncation runs and stays in range
+    out = generate(model, params, prompt, 5, temperature=1.0, top_k=10,
+                   top_p=0.9, key=key)
+    assert 0 <= np.asarray(out).min() and np.asarray(out).max() < TINY["vocab_size"]
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        generate(model, params, prompt, 2, top_k=5)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=1.0, top_p=1.5,
+                 key=key)

@@ -224,3 +224,18 @@ def test_metrics_jsonl_export(mesh8, tmp_path):
     assert win[0]["warmup_window"] and not win[1]["warmup_window"]
     assert all(r["samples_per_sec"] > 0 and np.isfinite(r["loss"])
                for r in win)
+
+
+def test_clip_norm_bounds_update():
+    """Global-norm clipping caps the effective gradient norm."""
+    import optax
+
+    tx = make_optimizer(learning_rate=1.0, momentum=0.0, weight_decay=0.0,
+                        clip_norm=1.0)
+    params = {"w": jnp.zeros((4,))}
+    grads = {"w": jnp.full((4,), 100.0)}  # norm 200
+    updates, _ = tx.update(grads, tx.init(params), params)
+    norm = float(optax.global_norm(updates))
+    np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
+    with pytest.raises(ValueError, match="clip_norm"):
+        make_optimizer(clip_norm=0.0)

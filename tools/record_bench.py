@@ -60,7 +60,8 @@ def main() -> None:
                   f"`matrix_bench.py` | |")
         else:
             coll = r.get("grad_allreduce_wall_time_s")
-            coll_s = f", allreduce {coll * 1e3:.3f} ms" if coll else ""
+            coll_s = (f", allreduce {coll * 1e3:.3f} ms"
+                      if coll is not None else "")
             print(f"| {r['config']} | {r['value']:,} {r['unit']} "
                   f"(MFU {r.get('mfu')}{coll_s}) | `matrix_bench.py` | |")
 

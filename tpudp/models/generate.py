@@ -125,13 +125,17 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     key: jax.Array | None = None,
 ) -> jnp.ndarray:
     """Generate ``(batch, prompt_len + max_new_tokens)`` token ids.
 
     ``model`` is a tpudp GPT2 (dense attention/MLP); ``prompt`` is
     ``(batch, prompt_len)`` int32.  ``temperature=0`` is greedy argmax;
-    otherwise softmax sampling at that temperature using ``key``.
+    otherwise softmax sampling at that temperature using ``key``, optionally
+    truncated to the ``top_k`` highest-probability tokens and/or the
+    smallest nucleus whose cumulative probability reaches ``top_p``.
     The whole prefill+decode loop jit-compiles as one program; total
     length is capped at ``model.config.max_seq_len`` (the position table).
     """
@@ -148,13 +152,43 @@ def generate(
             f"exceeds max_seq_len ({cfg.max_seq_len})")
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs a PRNG key")
+    if (top_k is not None or top_p is not None) and temperature == 0.0:
+        raise ValueError("top_k/top_p require temperature > 0 (greedy "
+                         "decoding ignores them)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
         key = jax.random.PRNGKey(0)
 
     new_tokens = _generate_jit(cfg, params, prompt, key,
                                max_new_tokens=max_new_tokens,
-                               temperature=float(temperature), total=total)
+                               temperature=float(temperature),
+                               top_k=top_k, top_p=top_p, total=total)
     return jnp.concatenate([prompt, new_tokens], axis=1)
+
+
+def _truncate_logits(logits, top_k, top_p):
+    """Mask logits outside the top-k set / the top-p nucleus to -inf.
+    The nucleus always includes the highest-probability token even when
+    ``top_p`` is smaller than its probability.  top_k uses lax.top_k (no
+    full vocab sort); the top-p nucleus reuses one descending sort."""
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep ranks whose PRECEDING cumulative mass is < top_p (so the
+        # first token is always kept); find the worst kept logit.
+        keep = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return logits
 
 
 # Module-level jit keyed on (cfg, shapes, statics): repeated generate()
@@ -162,9 +196,9 @@ def generate(
 # instead of recompiling per call.
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_tokens", "temperature",
-                                    "total"))
+                                    "top_k", "top_p", "total"))
 def _generate_jit(cfg, params, prompt, key, *, max_new_tokens, temperature,
-                  total):
+                  top_k, top_p, total):
     b, prompt_len = prompt.shape
     cache = KVCache.zeros(cfg, b, total)
     logits, cache = _forward_cached(cfg, params, prompt, cache, 0)
@@ -173,8 +207,8 @@ def _generate_jit(cfg, params, prompt, key, *, max_new_tokens, temperature,
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, -1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(jnp.int32)
+        logits = _truncate_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     def step(carry, i):
         cache, last_logits, key = carry

@@ -79,6 +79,7 @@ def make_optimizer(
     warmup_steps: int = 0,
     total_steps: int | None = None,
     optimizer: str = "sgd",
+    clip_norm: float | None = None,
 ) -> optax.GradientTransformation:
     """torch.optim.SGD(lr, momentum, weight_decay) equivalent
     (reference: ``src/Part 2a/main.py:61-62``).  ``add_decayed_weights``
@@ -93,7 +94,11 @@ def make_optimizer(
 
     ``optimizer='adamw'`` swaps in AdamW (decoupled weight decay, the
     transformer-training default; ``momentum`` is ignored) — beyond-
-    reference, for the GPT-2/ViT families where SGD undertrains."""
+    reference, for the GPT-2/ViT families where SGD undertrains.
+
+    ``clip_norm`` prepends global-norm gradient clipping (the standard
+    LM-training stabilizer; applies after the cross-device mean since sync
+    runs inside the step before tx.update)."""
     if schedule is None:
         lr = learning_rate
     elif schedule == "cosine":
@@ -111,12 +116,17 @@ def make_optimizer(
             [warmup_steps])
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
+    if clip_norm is not None and clip_norm <= 0:
+        raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
+    clip = ([optax.clip_by_global_norm(clip_norm)]
+            if clip_norm is not None else [])
     if optimizer == "adamw":
-        return optax.adamw(lr, weight_decay=weight_decay)
+        return optax.chain(*clip, optax.adamw(lr, weight_decay=weight_decay))
     if optimizer != "sgd":
         raise ValueError(
             f"unknown optimizer {optimizer!r}; choose 'sgd' or 'adamw'")
     return optax.chain(
+        *clip,
         optax.add_decayed_weights(weight_decay),
         optax.sgd(lr, momentum=momentum),
     )
