@@ -89,3 +89,54 @@ def test_top_k_and_top_p_sampling():
     with pytest.raises(ValueError, match="top_p"):
         generate(model, params, prompt, 2, temperature=1.0, top_p=1.5,
                  key=key)
+
+
+def test_beam_width_1_equals_greedy():
+    from tpudp.models.generate import beam_search
+
+    model, params = _model_and_params()
+    rng = np.random.default_rng(5)
+    prompt = jnp.asarray(rng.integers(0, TINY["vocab_size"], size=(2, 4)),
+                         jnp.int32)
+    greedy = generate(model, params, prompt, 6)
+    beams, scores = beam_search(model, params, prompt, 6, beam_width=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(beams))
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_beam_search_finds_optimal_sequence():
+    """With beam_width = vocab^n the search is exhaustive, so it must find
+    the true max-logprob continuation — checked against brute force."""
+    import itertools
+
+    from tpudp.models.generate import beam_search
+
+    v, n = 7, 2
+    model, params = _model_and_params(vocab_size=v, num_layers=1)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    beams, scores = beam_search(model, params, prompt, n, beam_width=v ** n)
+
+    # Brute force: total logprob of every continuation via full forwards.
+    def seq_logprob(cont):
+        seq = jnp.asarray([[1, 2, 3] + list(cont)], jnp.int32)
+        logits = model.apply({"params": params}, seq, train=False)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return sum(float(lp[0, 2 + j, cont[j]]) for j in range(n))
+
+    best_cont, best_lp = None, -np.inf
+    for cont in itertools.product(range(v), repeat=n):
+        lp = seq_logprob(cont)
+        if lp > best_lp:
+            best_cont, best_lp = cont, lp
+    assert tuple(np.asarray(beams)[0, 3:]) == best_cont
+    np.testing.assert_allclose(float(scores[0]), best_lp, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_beam_search_validation():
+    from tpudp.models.generate import beam_search
+
+    model, params = _model_and_params()
+    with pytest.raises(ValueError, match="beam_width"):
+        beam_search(model, params, jnp.zeros((1, 4), jnp.int32), 2,
+                    beam_width=0)

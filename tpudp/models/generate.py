@@ -220,3 +220,91 @@ def _generate_jit(cfg, params, prompt, key, *, max_new_tokens, temperature,
 
     _, toks = lax.scan(step, (cache, last, key), jnp.arange(max_new_tokens))
     return toks.swapaxes(0, 1)  # (batch, max_new_tokens)
+
+
+def beam_search(
+    model,
+    params: dict,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    beam_width: int = 4,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decoding over the same KV-cached decode path.
+
+    Returns ``(sequences, scores)``: the highest-scoring beam per batch
+    element as ``(batch, prompt_len + max_new_tokens)`` token ids and its
+    total log-probability ``(batch,)``.  The whole search (prefill +
+    ``max_new_tokens`` expand/select steps, including the per-step KV-cache
+    reorder by parent beam) compiles as one program.  No EOS handling —
+    beams all run to ``max_new_tokens`` (the framework's corpora are
+    untokenized streams with no terminator symbol).
+    """
+    cfg = model.config
+    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
+        raise ValueError(
+            "beam_search() supports dense-attention/dense-MLP GPT-2 "
+            f"configs; got attn_impl={cfg.attn_impl!r} "
+            f"mlp_impl={cfg.mlp_impl!r}")
+    if beam_width < 1:
+        raise ValueError(f"beam_width must be >= 1, got {beam_width}")
+    b, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_seq_len ({cfg.max_seq_len})")
+    return _beam_jit(cfg, params, prompt,
+                     max_new_tokens=max_new_tokens, beam_width=beam_width,
+                     total=total)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "beam_width",
+                                    "total"))
+def _beam_jit(cfg, params, prompt, *, max_new_tokens, beam_width, total):
+    b, prompt_len = prompt.shape
+    w = beam_width
+    bw = b * w
+
+    # Prefill ONCE at batch b (all beams share the prompt), then fan the
+    # cache and last-token logits out to beam-major (bw, ...) — beam_width
+    # byte-identical prompt forwards would cost w times the prefill FLOPs
+    # and activation memory for nothing.
+    cache = KVCache.zeros(cfg, b, total)
+    logits, cache = _forward_cached(cfg, params, prompt, cache, 0)
+    cache = KVCache(jnp.repeat(cache.k, w, axis=1),
+                    jnp.repeat(cache.v, w, axis=1))
+    last = jnp.repeat(logits[:, -1], w, axis=0)  # (bw, vocab)
+    # Only beam 0 is live initially so the first step picks w DISTINCT
+    # continuations instead of w copies of the argmax.
+    scores = jnp.tile(jnp.asarray([0.0] + [-jnp.inf] * (w - 1)), (b, 1))
+    new_tokens = jnp.zeros((b, w, max_new_tokens), jnp.int32)
+    batch_offset = (jnp.arange(b) * w)[:, None]  # (b, 1)
+
+    def step(carry, i):
+        cache, last, scores, new_tokens = carry
+        v = last.shape[-1]
+        logprobs = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+        cand = scores[:, :, None] + logprobs.reshape(b, w, v)
+        top_scores, top_idx = lax.top_k(cand.reshape(b, w * v), w)
+        parent = top_idx // v          # (b, w) parent beam per winner
+        tok = (top_idx % v).astype(jnp.int32)
+        gp = (batch_offset + parent).reshape(-1)  # global parent rows (bw,)
+        # Reorder beam-major state by parent.
+        cache = KVCache(cache.k[:, gp], cache.v[:, gp])
+        new_tokens = jnp.take_along_axis(
+            new_tokens, parent[:, :, None], axis=1)
+        new_tokens = new_tokens.at[:, :, i].set(tok)
+        logits, cache = _forward_cached(
+            cfg, params, tok.reshape(bw, 1), cache, prompt_len + i)
+        return (cache, logits[:, -1], top_scores, new_tokens), None
+
+    (cache, last, scores, new_tokens), _ = lax.scan(
+        step, (cache, last, scores, new_tokens), jnp.arange(max_new_tokens))
+
+    best = jnp.argmax(scores, axis=-1)  # (b,)
+    best_new = jnp.take_along_axis(
+        new_tokens, best[:, None, None], axis=1)[:, 0]  # (b, max_new)
+    return (jnp.concatenate([prompt, best_new], axis=1),
+            jnp.take_along_axis(scores, best[:, None], axis=-1)[:, 0])
