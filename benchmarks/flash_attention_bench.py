@@ -152,6 +152,9 @@ def main(*ts: int) -> None:
         print(json.dumps({"t": t,
                           "error": f"{type(exc).__name__}: {exc}"[:500]}),
               flush=True)
+    # Completion marker: distinguishes "all t values attempted" from a run
+    # that wedged partway (the watcher's stage-resume gates on this).
+    print(json.dumps({"flash_done": list(ts)}), flush=True)
 
 
 if __name__ == "__main__":

@@ -118,6 +118,21 @@ def _forward_cached(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
     return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
 
 
+def _validate_decode(cfg, prompt, max_new_tokens: int, fn_name: str) -> int:
+    """Shared decode-entry checks; returns the total sequence length."""
+    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
+        raise ValueError(
+            f"{fn_name} supports dense-attention/dense-MLP GPT-2 configs; "
+            f"got attn_impl={cfg.attn_impl!r} mlp_impl={cfg.mlp_impl!r}")
+    prompt_len = prompt.shape[1]
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_seq_len ({cfg.max_seq_len})")
+    return total
+
+
 def generate(
     model,
     params: dict,
@@ -140,16 +155,7 @@ def generate(
     length is capped at ``model.config.max_seq_len`` (the position table).
     """
     cfg = model.config
-    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
-        raise ValueError(
-            "generate() supports dense-attention/dense-MLP GPT-2 configs; "
-            f"got attn_impl={cfg.attn_impl!r} mlp_impl={cfg.mlp_impl!r}")
-    b, prompt_len = prompt.shape
-    total = prompt_len + max_new_tokens
-    if total > cfg.max_seq_len:
-        raise ValueError(
-            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
-            f"exceeds max_seq_len ({cfg.max_seq_len})")
+    total = _validate_decode(cfg, prompt, max_new_tokens, "generate()")
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs a PRNG key")
     if (top_k is not None or top_p is not None) and temperature == 0.0:
@@ -241,19 +247,9 @@ def beam_search(
     untokenized streams with no terminator symbol).
     """
     cfg = model.config
-    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
-        raise ValueError(
-            "beam_search() supports dense-attention/dense-MLP GPT-2 "
-            f"configs; got attn_impl={cfg.attn_impl!r} "
-            f"mlp_impl={cfg.mlp_impl!r}")
+    total = _validate_decode(cfg, prompt, max_new_tokens, "beam_search()")
     if beam_width < 1:
         raise ValueError(f"beam_width must be >= 1, got {beam_width}")
-    b, prompt_len = prompt.shape
-    total = prompt_len + max_new_tokens
-    if total > cfg.max_seq_len:
-        raise ValueError(
-            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
-            f"exceeds max_seq_len ({cfg.max_seq_len})")
     return _beam_jit(cfg, params, prompt,
                      max_new_tokens=max_new_tokens, beam_width=beam_width,
                      total=total)
