@@ -26,6 +26,25 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools.bench_gaps import MATRIX_CONFIGS  # noqa: E402 (stdlib-only import)
+
+# (name, distributed?, sync, spmd_mode) — mesh is bound at runtime.
+VGG_LADDER = (
+    ("part1_single", False, "none", "single"),
+    ("dp_psum", True, "allreduce", "shard_map"),
+    ("dp_ring", True, "ring", "shard_map"),
+    ("dp_coordinator", True, "coordinator", "shard_map"),
+    ("dp_gspmd", True, "allreduce", "gspmd"),
+)
+
+# The watcher resumes by diffing result rows against the canonical registry
+# (tools.bench_gaps); a config added on one side but not the other would
+# silently never be measured.  Checked at import time, before any jax/TPU
+# work, and raising (not assert) so `python -O` can't strip it.
+if [n for n, *_ in VGG_LADDER] + ["resnet50", "gpt2_small"] != list(
+        MATRIX_CONFIGS):
+    raise ValueError("matrix configs out of sync with tools.bench_gaps")
+
 
 def measure(step, state, args, steps, warmup):
     """Fenced sec/step for a (state, *args) -> (state, loss) step."""
@@ -100,13 +119,8 @@ def main() -> None:
     data_sh = jax.sharding.NamedSharding(mesh,
                                          jax.sharding.PartitionSpec("data"))
 
-    vgg_ladder = [
-        ("part1_single", None, "none", "single"),
-        ("dp_psum", mesh, "allreduce", "shard_map"),
-        ("dp_ring", mesh, "ring", "shard_map"),
-        ("dp_coordinator", mesh, "coordinator", "shard_map"),
-        ("dp_gspmd", mesh, "allreduce", "gspmd"),
-    ]
+    vgg_ladder = [(name, mesh if dist else None, sync, mode)
+                  for name, dist, sync, mode in VGG_LADDER]
     def run_config(name, fn):
         """One config crashing (OOM, transient backend fault) must not
         cost the remaining rows — the TPU window may not reopen."""

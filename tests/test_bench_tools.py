@@ -1,0 +1,47 @@
+"""The watcher's measurement-granularity resume logic (tools/bench_gaps.py):
+error rows don't count as measured, banked history rows do, and a complete
+set reports no gaps — the properties the TPU-window accumulation depends on."""
+
+import json
+import os
+
+from tools.bench_gaps import (FLASH_TS, MATRIX_CONFIGS, flash_missing,
+                              matrix_missing)
+
+
+def _write(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_matrix_gaps_ignore_errors_and_merge_history(tmp_path):
+    d = str(tmp_path)
+    assert matrix_missing(d) == list(MATRIX_CONFIGS)  # nothing measured yet
+    _write(os.path.join(d, "matrix.history.jsonl"), [
+        {"config": "dp_psum", "value": 90000.0, "unit": "images/sec/chip"},
+        {"config": "dp_ring", "error": "RuntimeError: relay wedged"},
+    ])
+    _write(os.path.join(d, "matrix.jsonl"), [
+        {"config": "part1_single", "value": 88000.0},
+        {"config": "resnet50", "value": 0},  # zero isn't a measurement
+    ])
+    with open(os.path.join(d, "matrix.jsonl"), "a") as f:
+        f.write("{not json at all\n")  # malformed lines must be skipped
+    missing = matrix_missing(d)
+    assert "dp_psum" not in missing          # banked row counts
+    assert "part1_single" not in missing     # current row counts
+    assert "dp_ring" in missing              # error row must be retried
+    assert "resnet50" in missing             # zero value must be retried
+    assert "gpt2_small" in missing
+
+
+def test_flash_gaps(tmp_path):
+    d = str(tmp_path)
+    assert flash_missing(d) == list(FLASH_TS)
+    _write(os.path.join(d, "flash.jsonl"), [
+        {"t": 4096, "flash_ms": 11.2, "dense_ms": 15.0},
+        {"t": 8192, "error": "XlaRuntimeError: UNAVAILABLE"},
+        {"flash_done": [4096, 8192, 16384]},
+    ])
+    assert flash_missing(d) == [8192, 16384]

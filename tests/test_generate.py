@@ -133,6 +133,28 @@ def test_beam_search_finds_optimal_sequence():
                                atol=1e-5)
 
 
+def test_beam_search_batch_independence():
+    """batch=3, width=3: each batch element's beams must equal the beams of
+    a standalone batch=1 search on that element — pins the cross-batch
+    indexing (batch_offset + parent flattening, per-step KV-cache reorder),
+    where a beam-major/batch-major mix-up would leak tokens across batch
+    elements while every all-zeros-offset test still passed."""
+    from tpudp.models.generate import beam_search
+
+    model, params = _model_and_params()
+    rng = np.random.default_rng(7)
+    prompt = jnp.asarray(rng.integers(0, TINY["vocab_size"], size=(3, 4)),
+                         jnp.int32)
+    beams, scores = beam_search(model, params, prompt, 5, beam_width=3)
+    for i in range(prompt.shape[0]):
+        solo, solo_scores = beam_search(model, params, prompt[i:i + 1], 5,
+                                        beam_width=3)
+        np.testing.assert_array_equal(np.asarray(beams[i]),
+                                      np.asarray(solo[0]))
+        np.testing.assert_allclose(float(scores[i]), float(solo_scores[0]),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_beam_search_validation():
     from tpudp.models.generate import beam_search
 

@@ -88,7 +88,7 @@ def transplant(tmodel, params, batch_stats):
     return params, bs
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture  # function-scoped: the trajectory test trains tmodel in place
 def paired():
     torch.manual_seed(0)
     torch.set_num_threads(1)

@@ -1,0 +1,5 @@
+"""Operational tooling (bench watcher helpers, result recorders).
+
+A package so the benchmarks can import the canonical measurement registry
+from tools.bench_gaps — single source for "what must be measured".
+"""

@@ -1,0 +1,92 @@
+"""Which benchmark measurements are still missing from bench_results/?
+
+The TPU relay comes and goes (BASELINE.md); the watcher
+(tools/tpu_when_ready.sh) banks partial result files between windows and
+must spend each new window ONLY on measurements that have not landed yet.
+This helper reads the current + banked (.history) result files and prints
+the missing work as arguments the benches accept:
+
+    python tools/bench_gaps.py matrix   -> comma-separated MATRIX_CONFIGS
+    python tools/bench_gaps.py flash    -> space-separated t values (argv)
+
+Empty output means the stage is complete — the watcher's ok-gates key off
+that.  Error rows do not count as measured: a config that crashed in one
+window is retried in the next.  Pure stdlib (no jax import) so the watcher
+can call it cheaply every poll.
+"""
+
+import argparse
+import json
+import os
+
+MATRIX_CONFIGS = ("part1_single", "dp_psum", "dp_ring", "dp_coordinator",
+                  "dp_gspmd", "resnet50", "gpt2_small")
+FLASH_TS = (4096, 8192, 16384)
+
+
+def history_path(path: str) -> str:
+    """Where the watcher banks a result file between relay windows."""
+    return (path[: -len(".jsonl")] + ".history.jsonl"
+            if path.endswith(".jsonl") else path)
+
+
+def rows_with_history(path):
+    """JSON rows from a result file, prefixed by its banked history twin;
+    malformed lines are skipped.  The single reader shared by the resume
+    gates and tools/record_bench.py, so they can never disagree about what
+    was measured."""
+    hist = history_path(path)
+    for p in (hist, path) if hist != path else (path,):
+        if not os.path.exists(p):
+            continue
+        for line in open(p):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+
+
+def measured(r: dict) -> bool:
+    """Does this row hold a real measurement?  The single criterion shared
+    by the resume gates and the recorder: error rows and zero/absent values
+    are NOT measurements (they must be retried / reported as failures)."""
+    if "error" in r:
+        return False
+    if "config" in r:
+        return r.get("value", 0) > 0
+    if "t" in r:
+        return bool(r.get("flash_ms"))
+    return False
+
+
+def matrix_missing(d: str) -> list[str]:
+    done = set()
+    for r in rows_with_history(os.path.join(d, "matrix.jsonl")):
+        if r.get("config") in MATRIX_CONFIGS and measured(r):
+            done.add(r["config"])
+    return [c for c in MATRIX_CONFIGS if c not in done]
+
+
+def flash_missing(d: str) -> list[int]:
+    done = set()
+    for r in rows_with_history(os.path.join(d, "flash.jsonl")):
+        if r.get("t") in FLASH_TS and measured(r):
+            done.add(r["t"])
+    return [t for t in FLASH_TS if t not in done]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("stage", choices=["matrix", "flash"])
+    p.add_argument("--dir", default="bench_results")
+    args = p.parse_args()
+    if args.stage == "matrix":
+        print(",".join(matrix_missing(args.dir)), end="")
+    else:
+        print(" ".join(str(t) for t in flash_missing(args.dir)), end="")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,21 +10,30 @@ minute:
 """
 
 import argparse
-import json
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.bench_gaps import measured, rows_with_history  # noqa: E402
 
 
 def _rows(path):
-    if not os.path.exists(path):
-        return []
-    out = []
-    for line in open(path):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                pass
+    """Current + banked rows, via the same reader the watcher's resume
+    gates use (tools.bench_gaps) — recorder and gates can't disagree.
+    Callers dedupe later-wins so the freshest measurement survives."""
+    return list(rows_with_history(path))
+
+
+def _dedupe(rows, key):
+    """Latest row per key, except a real measurement (bench_gaps.measured —
+    the resume gate's criterion) is never displaced by an error/empty row:
+    a config that succeeded in an earlier window keeps its measurement."""
+    out = {}
+    for r in rows:
+        prev = out.get(r[key])
+        if prev is None or not measured(prev) or measured(r):
+            out[r[key]] = r
     return out
 
 
@@ -52,11 +61,13 @@ def main() -> None:
         else:
             print(f"| bench.py | FAILED: {head.get('error')} | | |")
 
-    for r in _rows(os.path.join(args.dir, "matrix.jsonl")):
-        if "config" not in r or "matrix" in r:
-            continue
-        if "error" in r:
-            print(f"| {r['config']} | ERROR: {r['error'][:120]} | "
+    matrix = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "matrix.jsonl"))
+         if "config" in r and "matrix" not in r), "config")
+    for r in matrix.values():
+        if not measured(r):
+            print(f"| {r['config']} | ERROR: "
+                  f"{r.get('error', 'no real measurement')[:120]} | "
                   f"`matrix_bench.py` | |")
         else:
             coll = r.get("grad_allreduce_wall_time_s")
@@ -65,11 +76,15 @@ def main() -> None:
             print(f"| {r['config']} | {r['value']:,} {r['unit']} "
                   f"(MFU {r.get('mfu')}{coll_s}) | `matrix_bench.py` | |")
 
-    for r in _rows(os.path.join(args.dir, "flash.jsonl")):
-        if "error" in r:
-            print(f"| flash t={r.get('t')} | ERROR: {r['error'][:120]} | "
+    flash = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
+         if "t" in r), "t")
+    for r in flash.values():
+        if not measured(r):
+            print(f"| flash t={r.get('t')} | ERROR: "
+                  f"{r.get('error', 'no real measurement')[:120]} | "
                   f"`flash_attention_bench.py` | |")
-        elif "t" in r:
+        else:
             print(f"| flash attention t={r['t']} "
                   f"(blocks {r.get('block_q')}x{r.get('block_k')}) | "
                   f"{r['flash_ms']} ms vs dense {r.get('dense_ms')} ms "

@@ -38,25 +38,25 @@ except Exception:
 EOF
 }
 
-# Stage-resumable: a stage whose result file already holds a real
-# measurement is skipped, so a watcher relaunched after a mid-battery
-# relay wedge only redoes the missing stages (the window may be short).
-# "ok" means COMPLETE AND non-empty: the matrix summary line must carry at
-# least one real measurement (an all-error run should re-run next window),
-# and flash must have printed its completion marker (per-t rows alone mean
-# it wedged partway).
+# Stage-resumable at MEASUREMENT granularity: tools/bench_gaps.py reads the
+# current + banked result files and reports which matrix configs / flash t
+# values still lack a real measured row (error rows don't count).  A stage
+# is ok when nothing is missing; a retried stage re-runs ONLY the gaps, so
+# short windows accumulate coverage instead of restarting the sweep.
+# Fail CLOSED: if the helper itself errors (empty stdout, nonzero rc) the
+# stage is NOT complete — a broken gap probe must keep the watcher waiting,
+# not let it exit "done" with nothing measured.
 matrix_ok() {
-  grep '"matrix"' bench_results/matrix.jsonl 2>/dev/null | grep -q '"value"'
+  local out; out=$(python tools/bench_gaps.py matrix) || return 1
+  [ -z "$out" ]
 }
-# Complete (marker printed) AND at least one real measured row — a run whose
-# every t crashed into error rows still prints the marker and must re-run.
 flash_ok() {
-  grep -q '"flash_done"' bench_results/flash.jsonl 2>/dev/null \
-    && grep -q '"flash_ms"' bench_results/flash.jsonl
+  local out; out=$(python tools/bench_gaps.py flash) || return 1
+  [ -z "$out" ]
 }
 # A retried stage truncates its result file; bank the partial rows first so
 # a window that died mid-matrix never erases already-measured configs
-# (recorded evidence > tidy files; *.history.jsonl is the manual fallback).
+# (gap computation and tools/record_bench.py read the history too).
 bank() { [ -s "$1" ] && cat "$1" >> "${1%.jsonl}.history.jsonl"; }
 
 log "watcher started (period=${PERIOD}s)"
@@ -81,7 +81,8 @@ while true; do
       # Per-stage timeout well under the relay's typical healthy window;
       # crash isolation inside the bench keeps partial rows on a wedge.
       bank bench_results/matrix.jsonl
-      MATRIX_STEPS=30 timeout 2400 python benchmarks/matrix_bench.py \
+      MATRIX_CONFIGS="$(python tools/bench_gaps.py matrix)" \
+        MATRIX_STEPS=30 timeout 2400 python benchmarks/matrix_bench.py \
         > bench_results/matrix.jsonl 2> bench_results/matrix.err
       log "matrix_bench rc=$? -> bench_results/matrix.jsonl"
       if ! matrix_ok && ! probe; then
@@ -94,7 +95,9 @@ while true; do
       log "flash.jsonl already good; skipping flash bench"
     else
       bank bench_results/flash.jsonl
+      # shellcheck disable=SC2046 — word-split the missing t values
       timeout 2400 python benchmarks/flash_attention_bench.py \
+        $(python tools/bench_gaps.py flash) \
         > bench_results/flash.jsonl 2> bench_results/flash.err
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
     fi

@@ -68,13 +68,13 @@ def build_strategy(
 ) -> BuiltStrategy:
     """Build the full rung for ``name`` from a standard (single-device
     layout) TrainState.  See the module table for per-strategy options."""
-    if mesh is None:
-        raise ValueError(f"strategy {name!r} needs a device mesh")
     if name == "dp":
         raise ValueError(
             "'dp' is the Trainer's built-in rung (make_train_step / the sync "
             "ladder); build_strategy only packages the advanced rungs "
             f"{tuple(s for s in STRATEGIES if s != 'dp')}")
+    if mesh is None:
+        raise ValueError(f"strategy {name!r} needs a device mesh")
     if name == "tp":
         return _build_tp(model, tx, mesh, state, donate, options)
     if name == "fsdp":
