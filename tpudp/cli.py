@@ -19,7 +19,7 @@ import argparse
 import jax
 
 from tpudp.data import DataLoader, ShardedSampler, load_cifar10
-from tpudp.mesh import initialize_distributed, make_mesh
+from tpudp.mesh import DATA_AXIS, initialize_distributed, make_mesh
 from tpudp.train import Trainer
 
 GLOBAL_BATCH_SIZE = 256  # reference constant, src/Part 2a/main.py:173
@@ -65,6 +65,13 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    default="auto",
                    help="host augmentation backend: fused C++/OpenMP kernel "
                         "(tpudp/native) or bit-identical numpy")
+    p.add_argument("--sync-bn", action="store_true",
+                   help="cross-replica BatchNorm (torch SyncBatchNorm "
+                        "analogue): psum batch statistics over the data "
+                        "axis so N devices at batch B/N normalize exactly "
+                        "like one device at batch B. Default keeps the "
+                        "reference's local-stats semantics (src/Part "
+                        "2a/main.py:59-68). shard_map rungs only")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations during backward "
                         "(jax.checkpoint): identical gradients, lower peak "
@@ -164,7 +171,12 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         test_loader = Prefetcher(test_loader, depth=args.prefetch)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model = VGG11(dtype=dtype)
+    if args.sync_bn and (single_device or spmd_mode != "shard_map"):
+        raise SystemExit(
+            "error: --sync-bn needs a shard_map rung (Parts 2a/2b) — the "
+            "mesh axis is not bound in single-device or gspmd modes")
+    model = VGG11(dtype=dtype,
+                  bn_axis=DATA_AXIS if args.sync_bn else None)
     watchdog = None
     if args.step_timeout:
         from tpudp.utils.watchdog import Watchdog

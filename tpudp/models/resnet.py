@@ -21,12 +21,14 @@ class BottleneckBlock(nn.Module):
     features: int
     strides: tuple[int, int] = (1, 1)
     dtype: Any = jnp.float32
+    bn_axis: str | None = None  # mesh axis for cross-replica SyncBN
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         bn = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
-                     epsilon=1e-5, dtype=jnp.float32)
+                     epsilon=1e-5, dtype=jnp.float32,
+                     axis_name=self.bn_axis if train else None)
         residual = x
         y = conv(self.features, (1, 1))(x)
         y = bn()(y)
@@ -51,6 +53,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.float32
+    bn_axis: str | None = None  # SyncBN over this mesh axis (see models.vgg)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -58,7 +61,8 @@ class ResNet(nn.Module):
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=3,
                     use_bias=False, dtype=self.dtype, name="stem_conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32, name="stem_bn")(x)
+                         epsilon=1e-5, dtype=jnp.float32, name="stem_bn",
+                         axis_name=self.bn_axis if train else None)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, num_blocks in enumerate(self.stage_sizes):
@@ -68,19 +72,26 @@ class ResNet(nn.Module):
                     features=self.width * (2 ** stage),
                     strides=strides,
                     dtype=self.dtype,
+                    bn_axis=self.bn_axis,
                 )(x, train=train)
         x = x.mean(axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x.astype(jnp.float32)
 
 
-def ResNet50(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+def ResNet50(num_classes: int = 1000, dtype: Any = jnp.float32,
+             bn_axis: str | None = None) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  dtype=dtype, bn_axis=bn_axis)
 
 
-def ResNet101(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes, dtype=dtype)
+def ResNet101(num_classes: int = 1000, dtype: Any = jnp.float32,
+             bn_axis: str | None = None) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes,
+                  dtype=dtype, bn_axis=bn_axis)
 
 
-def ResNet152(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
-    return ResNet(stage_sizes=(3, 8, 36, 3), num_classes=num_classes, dtype=dtype)
+def ResNet152(num_classes: int = 1000, dtype: Any = jnp.float32,
+             bn_axis: str | None = None) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), num_classes=num_classes,
+                  dtype=dtype, bn_axis=bn_axis)

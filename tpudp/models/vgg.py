@@ -13,6 +13,14 @@ TPU-first choices (deliberate departures from the torch original):
     and fp32 params — MXU-friendly mixed precision.
   * BatchNorm ``momentum=0.9`` == torch's ``momentum=0.1`` (flax counts the
     keep-fraction, torch the update-fraction).
+  * ``bn_axis="data"`` turns every BatchNorm into cross-replica SyncBN
+    (``torch.nn.SyncBatchNorm`` analogue): batch statistics are psum'd over
+    that mesh axis inside the shard_map'd train step, so N devices at
+    per-device batch B/N normalize exactly like one device at batch B.
+    Default None keeps the reference's local-stats semantics
+    (``src/Part 2a/main.py:59-68`` syncs only gradients, never BN stats —
+    SURVEY.md §7 "BatchNorm under DP").  Requires an SPMD context where the
+    axis name is bound (shard_map rungs; not gspmd/single modes).
 """
 
 from __future__ import annotations
@@ -44,6 +52,7 @@ class VGG(nn.Module):
     cfg: Sequence[Any]
     num_classes: int = 10
     dtype: Any = jnp.float32
+    bn_axis: str | None = None  # mesh axis for SyncBN; None = local stats
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -64,6 +73,7 @@ class VGG(nn.Module):
                     momentum=0.9,
                     epsilon=1e-5,
                     dtype=jnp.float32,
+                    axis_name=self.bn_axis if train else None,
                 )(x)
                 x = nn.relu(x)
         # 32x32 input through five 2x2 pools -> 1x1x512; flatten == the
@@ -74,8 +84,10 @@ class VGG(nn.Module):
 
 
 def _factory(name: str):
-    def build(num_classes: int = 10, dtype: Any = jnp.float32) -> VGG:
-        return VGG(cfg=CONFIGS[name], num_classes=num_classes, dtype=dtype)
+    def build(num_classes: int = 10, dtype: Any = jnp.float32,
+              bn_axis: str | None = None) -> VGG:
+        return VGG(cfg=CONFIGS[name], num_classes=num_classes, dtype=dtype,
+                   bn_axis=bn_axis)
 
     build.__name__ = name
     build.__doc__ = f"Build a {name} (reference factory: src/Part 1/model.py:49-50)."
