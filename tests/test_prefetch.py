@@ -66,3 +66,40 @@ def test_prefetcher_early_break_stops_worker():
 def test_prefetcher_rejects_bad_depth():
     with pytest.raises(ValueError):
         Prefetcher([], depth=0)
+
+
+def test_prefetcher_place_hook_runs_on_worker_thread():
+    """Device-side prefetch: set_place runs on the prefetch thread for every
+    batch; yielded batches carry the placed result."""
+    ds = _dataset(32)
+    loader = DataLoader(ds, 8, train=False)
+    threads = []
+    pf = Prefetcher(loader, depth=2)
+    pf.set_place(lambda b: (threads.append(threading.current_thread().name),
+                            (b[0] + 1.0, b[1], b[2]))[1])
+    direct = list(loader)
+    placed = list(pf)
+    assert len(placed) == len(direct)
+    for (xi, _, _), (xj, _, _) in zip(direct, placed):
+        np.testing.assert_allclose(np.asarray(xj), np.asarray(xi) + 1.0)
+    assert threads and all(n == "tpudp-prefetch" for n in threads)
+
+
+def test_trainer_device_prefetch_matches_direct(mesh8):
+    """A Prefetcher-wrapped loader (Trainer installs its device_put as the
+    place hook) must produce the identical loss trajectory to the direct
+    loader — placement moves threads, not math."""
+    from tpudp.train import Trainer
+
+    from tpudp.models.vgg import VGG11
+
+    def run(wrap):
+        ds = _dataset(32, seed=7)
+        loader = DataLoader(ds, 16, train=True, seed=2)
+        if wrap:
+            loader = Prefetcher(loader, depth=2)
+        tr = Trainer(VGG11(), mesh8, "allreduce", log_every=1)
+        tr.train_epoch(loader, epoch=0)
+        return float(tr.state.loss_sum)
+
+    assert run(False) == run(True)

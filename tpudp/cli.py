@@ -123,6 +123,12 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     if args.keep_checkpoints and not args.checkpoint_dir:
         raise SystemExit(
             "error: --keep-checkpoints requires --checkpoint-dir")
+    if args.sync_bn and (single_device or spmd_mode != "shard_map"):
+        # Decidable from flags alone — fail before distributed init /
+        # dataset load, next to the other pure-argument checks.
+        raise SystemExit(
+            "error: --sync-bn needs a shard_map rung (Parts 2a/2b) — the "
+            "mesh axis is not bound in single-device or gspmd modes")
     if args.platform:  # must precede the first device query
         jax.config.update("jax_platforms", args.platform)
     initialize_distributed(args.master, args.num_nodes, args.rank, PORT)
@@ -171,10 +177,6 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         test_loader = Prefetcher(test_loader, depth=args.prefetch)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    if args.sync_bn and (single_device or spmd_mode != "shard_map"):
-        raise SystemExit(
-            "error: --sync-bn needs a shard_map rung (Parts 2a/2b) — the "
-            "mesh axis is not bound in single-device or gspmd modes")
     model = VGG11(dtype=dtype,
                   bn_axis=DATA_AXIS if args.sync_bn else None)
     watchdog = None

@@ -600,6 +600,23 @@ def make_forward_step(model: nn.Module, mesh: Mesh | None) -> Callable:
     ))
 
 
+def _host_local_rows(batch) -> int:
+    """Rows of this batch that live on THIS host — the basis of the
+    samples/sec metric.  A device-prefetched multi-host batch arrives as a
+    global jax.Array (shape[0] = global batch); counting its addressable
+    shards keeps the metric identical to the host-local numpy path."""
+    if isinstance(batch, jax.Array) and not batch.is_fully_addressable:
+        # Unique row spans, not a plain shard sum: on a 2-D sharding
+        # (e.g. data x seq) several local devices hold the SAME rows.
+        spans = set()
+        for s in batch.addressable_shards:
+            sl = s.index[0]
+            spans.add((sl.start or 0,
+                       batch.shape[0] if sl.stop is None else sl.stop))
+        return sum(stop - start for start, stop in spans)
+    return int(np.shape(batch)[0])
+
+
 class Trainer:
     """Epoch driver with the reference's printed metrics and cadence.
 
@@ -715,15 +732,34 @@ class Trainer:
             if jax.process_count() > 1:
                 # Multi-host: each process holds only its host-local slice of
                 # the global batch; assemble the distributed global array.
-                self._put = lambda a: jax.make_array_from_process_local_data(
-                    self._shard_for(a), np.asarray(a))
+                # Idempotent (the device-prefetch hook may have assembled it
+                # already, and np.asarray on a global array would fail).
+                def _put(a):
+                    sh = self._shard_for(a)
+                    if isinstance(a, jax.Array) and a.sharding == sh:
+                        return a
+                    return jax.make_array_from_process_local_data(
+                        sh, np.asarray(a))
+
+                self._put = _put
             else:
+                # device_put onto an identical sharding is already a no-op.
                 self._put = lambda a: jax.device_put(a, self._shard_for(a))
 
     def _device_batch(self, images, labels):
         if self._put is not None:
+            # No-op fast path for arrays the prefetch thread already placed
+            # (device_put onto an identical sharding returns the array).
             return self._put(images), self._put(labels)
         return images, labels
+
+    def _install_place_hook(self, loader) -> None:
+        """Device-side prefetch: have a capable loader (Prefetcher) run the
+        input device_put on ITS worker thread, so H2D transfers start
+        ``depth`` batches before the step that consumes them."""
+        if self._put is not None and hasattr(loader, "set_place"):
+            put = self._put
+            loader.set_place(lambda b: tuple(put(x) for x in b))
 
     def _emit_metrics(self, record: dict) -> None:
         if self.metrics_jsonl is None:
@@ -742,6 +778,7 @@ class Trainer:
         ``log_every`` steps), keeping the device pipeline full.
         """
         loader.set_epoch(epoch)
+        self._install_place_hook(loader)
         fwd_t, bwd_t = 0.0, 0.0
         losses = []
         prev_loss_sum = float(self.state.loss_sum)
@@ -750,7 +787,7 @@ class Trainer:
         it = 0
         beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         for it, (images, labels, _w) in enumerate(loader, start=1):
-            window_samples += int(np.shape(images)[0])
+            window_samples += _host_local_rows(images)
             images, labels = self._device_batch(images, labels)
             if self.timing_mode == "split":
                 # fetch_fence, not block_until_ready: under relay transports
@@ -813,6 +850,7 @@ class Trainer:
     def evaluate(self, loader) -> tuple[float, float]:
         """Full test pass; returns (avg_loss_per_sample, accuracy)."""
         # accumulate on device; fetch once at the end (async-dispatch friendly)
+        self._install_place_hook(loader)
         beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         loss_sum = correct = count = jnp.zeros((), jnp.float32)
         for images, labels, weights in loader:
