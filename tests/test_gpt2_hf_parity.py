@@ -1,0 +1,116 @@
+"""Numerical parity of the GPT-2 family vs the canonical implementation
+(HuggingFace transformers GPT2LMHeadModel, torch).
+
+Same idea as tests/test_torch_parity.py for VGG: transplant the torch
+weights into the flax model and compare outputs — pinning the architecture
+(pre-LN block structure, gelu_new tanh approximation, LayerNorm eps 1e-5,
+tied embedding head, causal masking) rather than trusting docstrings.
+HF's Conv1D stores weights (in, out), the same layout as flax Dense
+kernels, so the transplant needs no transposes.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tpudp.models.gpt2 import gpt2_small  # noqa: E402
+from tpudp.train import init_state, make_optimizer  # noqa: E402
+
+TINY = dict(vocab_size=61, max_seq_len=32, num_layers=2, num_heads=2,
+            d_model=32)
+
+
+def _hf_model():
+    cfg = transformers.GPT2Config(
+        vocab_size=TINY["vocab_size"], n_positions=TINY["max_seq_len"],
+        n_embd=TINY["d_model"], n_layer=TINY["num_layers"],
+        n_head=TINY["num_heads"], activation_function="gelu_new",
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=1e-5)
+    torch.manual_seed(0)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+def _grab(t, transpose=False):
+    a = t.detach().numpy()
+    return jnp.array(a.T if transpose else a, copy=True)
+
+
+def _transplant(hf, params):
+    """HF state_dict -> tpudp param tree (copies, never aliases)."""
+    sd = dict(hf.transformer.named_parameters())
+    params = dict(params)
+    params["wte"] = {"embedding": _grab(sd["wte.weight"])}
+    params["wpe"] = {"embedding": _grab(sd["wpe.weight"])}
+    for i in range(TINY["num_layers"]):
+        h = dict(params[f"h_{i}"])
+        p = f"h.{i}."
+        h["ln_1"] = {"scale": _grab(sd[p + "ln_1.weight"]),
+                     "bias": _grab(sd[p + "ln_1.bias"])}
+        h["ln_2"] = {"scale": _grab(sd[p + "ln_2.weight"]),
+                     "bias": _grab(sd[p + "ln_2.bias"])}
+        h["attn"] = {
+            "qkv": {"kernel": _grab(sd[p + "attn.c_attn.weight"]),
+                    "bias": _grab(sd[p + "attn.c_attn.bias"])},
+            "proj": {"kernel": _grab(sd[p + "attn.c_proj.weight"]),
+                     "bias": _grab(sd[p + "attn.c_proj.bias"])},
+        }
+        h["mlp_fc"] = {"kernel": _grab(sd[p + "mlp.c_fc.weight"]),
+                       "bias": _grab(sd[p + "mlp.c_fc.bias"])}
+        h["mlp_proj"] = {"kernel": _grab(sd[p + "mlp.c_proj.weight"]),
+                         "bias": _grab(sd[p + "mlp.c_proj.bias"])}
+        params[f"h_{i}"] = h
+    params["ln_f"] = {"scale": _grab(sd["ln_f.weight"]),
+                      "bias": _grab(sd["ln_f.bias"])}
+    return params
+
+
+@pytest.fixture(scope="module")
+def paired():
+    hf = _hf_model()
+    model = gpt2_small(**TINY)
+    state = init_state(model, make_optimizer(), input_shape=(1, 8))
+    return hf, model, _transplant(hf, state.params)
+
+
+def test_logits_parity(paired):
+    hf, model, params = paired
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, TINY["vocab_size"], size=(2, 17))
+    with torch.no_grad():
+        t_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    j_logits = np.asarray(model.apply({"params": params},
+                                      jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(j_logits, t_logits, rtol=1e-4, atol=1e-4)
+
+
+def test_loss_and_decode_parity(paired):
+    """Mean CE over shifted targets matches torch's, and the KV-cached
+    decode path produces the same last-position logits as HF's forward
+    (the decode twin is pinned to the training model elsewhere; this pins
+    the pair to the canonical implementation)."""
+    import optax
+
+    from tpudp.models.generate import KVCache, _forward_cached
+
+    hf, model, params = paired
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, TINY["vocab_size"], size=(2, 12))
+    with torch.no_grad():
+        out = hf(torch.from_numpy(tokens), labels=torch.from_numpy(tokens))
+    j_logits = model.apply({"params": params},
+                           jnp.asarray(tokens, jnp.int32))
+    j_loss = optax.softmax_cross_entropy_with_integer_labels(
+        j_logits[:, :-1], jnp.asarray(tokens[:, 1:])).mean()
+    np.testing.assert_allclose(float(j_loss), float(out.loss), rtol=1e-5)
+
+    cache = KVCache.zeros(model.config, 2, TINY["max_seq_len"])
+    d_logits, _ = _forward_cached(model.config, params,
+                                  jnp.asarray(tokens, jnp.int32), cache, 0)
+    np.testing.assert_allclose(np.asarray(d_logits[:, -1]),
+                               out.logits.numpy()[:, -1],
+                               rtol=1e-4, atol=1e-4)

@@ -45,12 +45,14 @@ class KVCache(NamedTuple):
         return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 
-def _layer_norm(p: dict, x: jnp.ndarray) -> jnp.ndarray:
+def _layer_norm(p: dict, x: jnp.ndarray, eps: float) -> jnp.ndarray:
     """Exactly the training model's LayerNorm (flax apply on the raw
-    subtree), so decode can never drift numerically from Block's."""
+    subtree, same epsilon), so decode can never drift numerically from
+    Block's."""
     import flax.linen as nn
 
-    return nn.LayerNorm(dtype=jnp.float32).apply({"params": p}, x)
+    return nn.LayerNorm(dtype=jnp.float32, epsilon=eps).apply(
+        {"params": p}, x)
 
 
 def _dense(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
@@ -71,7 +73,7 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     dh = d // h
     max_len = k_cache.shape[1]
 
-    hN = _layer_norm(p["ln_1"], x)
+    hN = _layer_norm(p["ln_1"], x, cfg.ln_eps)
     qkv = _dense(p["attn"]["qkv"], hN, cfg.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, cur, h, dh)
@@ -95,7 +97,7 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
     x = x + _dense(p["attn"]["proj"], out.reshape(b, cur, d), cfg.dtype)
 
-    hN = _layer_norm(p["ln_2"], x)
+    hN = _layer_norm(p["ln_2"], x, cfg.ln_eps)
     m = jax.nn.gelu(_dense(p["mlp_fc"], hN, cfg.dtype))
     x = x + _dense(p["mlp_proj"], m, cfg.dtype)
     return x, k_cache, v_cache

@@ -34,6 +34,10 @@ class GPT2Config:
     num_heads: int = 12
     d_model: int = 768
     mlp_ratio: int = 4
+    # GPT-2's canonical LayerNorm epsilon (HF layer_norm_epsilon).  flax's
+    # default is 1e-6; pinned here so logits match the torch/HF reference
+    # implementation exactly (tests/test_gpt2_hf_parity.py).
+    ln_eps: float = 1e-5
     dtype: Any = jnp.float32
     attn_impl: str = "dense"  # 'dense' | 'flash' | 'ring'
     seq_axis: str | None = None  # mesh axis for ring attention
@@ -92,7 +96,8 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
-        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name,
+                                       epsilon=cfg.ln_eps)
         x = x + CausalSelfAttention(cfg, name="attn")(ln("ln_1")(x))
         if cfg.mlp_impl == "moe":
             from tpudp.models.moe import MoeMlp
@@ -129,7 +134,8 @@ def embed_tokens(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
 def lm_head(cfg: GPT2Config, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Raw-param twin of the output stage of :meth:`GPT2.__call__`
     (final LayerNorm + tied-embedding head)."""
-    x = nn.LayerNorm(dtype=jnp.float32).apply({"params": params["ln_f"]}, x)
+    x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps).apply(
+        {"params": params["ln_f"]}, x)
     wte = params["wte"]["embedding"].astype(cfg.dtype)
     return (x.astype(cfg.dtype) @ wte.T).astype(jnp.float32)
 
@@ -167,7 +173,8 @@ class GPT2(nn.Module):
         x = wte(tokens) + wpe(positions)
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"h_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f",
+                         epsilon=cfg.ln_eps)(x)
         if return_hidden:
             return x.astype(cfg.dtype)
         logits = wte.attend(x.astype(cfg.dtype))  # tied embedding head
