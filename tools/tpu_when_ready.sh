@@ -59,8 +59,18 @@ flash_ok() {
 # (gap computation and tools/record_bench.py read the history too).
 bank() { [ -s "$1" ] && cat "$1" >> "${1%.jsonl}.history.jsonl"; }
 
-log "watcher started (period=${PERIOD}s)"
+# Hard deadline (seconds from launch; default 4h): the driver runs its own
+# bench.py at round end, and a second process touching the TPU wedges the
+# relay — a watcher that never got a window must stand down before then.
+DEADLINE_S="${DEADLINE_S:-14400}"
+START_TS=$(date +%s)
+
+log "watcher started (period=${PERIOD}s, deadline=${DEADLINE_S}s)"
 while true; do
+  if [ $(( $(date +%s) - START_TS )) -ge "$DEADLINE_S" ]; then
+    log "deadline reached with battery incomplete; standing down"
+    exit 1
+  fi
   if probe; then
     log "TPU healthy; running bench battery"
     if battery_ok; then
