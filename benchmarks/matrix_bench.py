@@ -41,8 +41,8 @@ VGG_LADDER = (
 # (tools.bench_gaps); a config added on one side but not the other would
 # silently never be measured.  Checked at import time, before any jax/TPU
 # work, and raising (not assert) so `python -O` can't strip it.
-if [n for n, *_ in VGG_LADDER] + ["resnet50", "gpt2_small"] != list(
-        MATRIX_CONFIGS):
+if [n for n, *_ in VGG_LADDER] + ["resnet50", "gpt2_small",
+                                  "gpt2_flash"] != list(MATRIX_CONFIGS):
     raise ValueError("matrix configs out of sync with tools.bench_gaps")
 
 
@@ -209,6 +209,39 @@ def main() -> None:
 
     if only is None or "gpt2_small" in only:
         run_config("gpt2_small", run_gpt2)
+
+    # ---- GPT-2 with the owned Pallas flash kernel, long context --------
+    def run_gpt2_flash():
+        """The flash kernel inside a real training step (not a micro-bench):
+        GPT-2-small geometry at t=2048 where the dense (t, t) score tensor
+        starts to hurt; tokens/sec/chip comparable against gpt2_small."""
+        g_batch = int(os.environ.get("MATRIX_GPT2FLASH_BATCH", 4))
+        seq = int(os.environ.get("MATRIX_GPT2FLASH_SEQ", 2048))
+        layers = int(os.environ.get("MATRIX_GPT2FLASH_LAYERS", 12))
+        d_model = int(os.environ.get("MATRIX_GPT2FLASH_DMODEL", 768))
+        model = gpt2_small(dtype=jnp.bfloat16, attn_impl="flash",
+                           max_seq_len=seq, num_layers=layers,
+                           d_model=d_model, num_heads=d_model // 64)
+        cfg = model.config
+        tx = make_optimizer(learning_rate=0.01)
+        state = init_state(model, tx, input_shape=(1, seq))
+        step = make_train_step(model, tx, mesh, "allreduce", donate=True)
+        toks = jax.device_put(
+            jnp.asarray(rng.integers(0, cfg.vocab_size, size=(g_batch, seq)),
+                        jnp.int32), data_sh)
+        tgts = jax.device_put(jnp.roll(toks, -1, axis=1), data_sh)
+        sec, loss = measure(step, state, (toks, tgts), steps, warmup)
+        emit("gpt2_flash", sec, loss, unit="tokens/sec/chip",
+             per_sec=g_batch * seq / sec,
+             flops=train_step_flops(gpt2_fwd_flops(
+                 g_batch, seq, num_layers=cfg.num_layers,
+                 d_model=cfg.d_model, vocab_size=cfg.vocab_size,
+                 mlp_ratio=cfg.mlp_ratio)),
+             extra={"global_batch": g_batch, "seq_len": seq,
+                    "attn_impl": "flash"})
+
+    if only is None or "gpt2_flash" in only:
+        run_config("gpt2_flash", run_gpt2_flash)
 
     print(json.dumps({"matrix": results}))
 

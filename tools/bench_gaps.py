@@ -20,7 +20,7 @@ import json
 import os
 
 MATRIX_CONFIGS = ("part1_single", "dp_psum", "dp_ring", "dp_coordinator",
-                  "dp_gspmd", "resnet50", "gpt2_small")
+                  "dp_gspmd", "resnet50", "gpt2_small", "gpt2_flash")
 FLASH_TS = (4096, 8192, 16384)
 
 
