@@ -1,4 +1,4 @@
-"""Train a GPT-2 LM with tpudp — data-parallel or sequence-parallel.
+"""Train a GPT-2 LM with tpudp — any parallelism rung from one script.
 
 Beyond-parity example (BASELINE.json configs[4]: "GPT-2-small (124M) LM —
 transformer grads all-reduced over a v5p pod slice").  With no egress the
@@ -12,6 +12,14 @@ binary file of uint16 token ids to train on real data.
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/train_gpt2.py --platform cpu --mesh 2x4 --seq-parallel \
       --layers 2 --d-model 64 --seq-len 64 --steps 10
+
+  # Megatron tensor parallelism (DP x TP), GPipe pipeline (DP x PP),
+  # ZeRO-3 (FSDP), or MoE expert parallelism (DP x EP) — the --mesh
+  # second axis becomes the strategy axis (model/pipe/expert):
+  ... --mesh 4x2 --strategy tp
+  ... --mesh 4x2 --strategy pp --microbatches 4
+  ... --mesh 8x1 --strategy fsdp
+  ... --mesh 4x2 --strategy ep
 """
 
 import argparse
@@ -28,6 +36,12 @@ def main() -> None:
                    help="'DxS' data x seq mesh shape (default: all devices x 1)")
     p.add_argument("--seq-parallel", action="store_true",
                    help="shard the sequence axis + ring attention")
+    p.add_argument("--strategy", default="dp",
+                   choices=["dp", "tp", "pp", "fsdp", "ep"],
+                   help="parallelism rung (tpudp.strategy); the --mesh "
+                        "second axis is the strategy axis")
+    p.add_argument("--microbatches", type=int, default=2,
+                   help="pipeline microbatches (--strategy pp)")
     p.add_argument("--layers", type=int, default=12)
     p.add_argument("--d-model", type=int, default=768)
     p.add_argument("--heads", type=int, default=None)
@@ -72,6 +86,13 @@ def main() -> None:
     mesh = Mesh(np.asarray(devices[: d * s]).reshape(d, s), ("data", "seq"))
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.seq_parallel and args.strategy != "dp":
+        raise SystemExit("error: --seq-parallel is its own rung; drop "
+                         "--strategy (or use --strategy dp)")
+    moe = {}
+    if args.strategy == "ep":
+        moe = dict(mlp_impl="moe", num_experts=max(2 * s, 2),
+                   capacity_factor=2.0, expert_axis="expert")
     cfg = GPT2Config(
         vocab_size=args.vocab,
         max_seq_len=args.seq_len,
@@ -81,6 +102,7 @@ def main() -> None:
         dtype=dtype,
         attn_impl="ring" if args.seq_parallel else "dense",
         seq_axis="seq" if args.seq_parallel else None,
+        **moe,
     )
     model = GPT2(cfg)
     tx = make_optimizer(learning_rate=args.lr, momentum=0.9, weight_decay=0.0,
@@ -105,7 +127,33 @@ def main() -> None:
                 f"error: --sample {args.sample} + prompt "
                 f"{min(16, args.seq_len)} exceeds --seq-len {args.seq_len} "
                 "(the model's position table)")
-    if args.seq_parallel:
+    if args.strategy != "dp":
+        if args.loss_chunk is not None:
+            raise SystemExit("error: --loss-chunk is a DP-path option")
+        if args.sample:
+            raise SystemExit("error: --sample needs the DP path (generate() "
+                             "drives replicated params)")
+        from tpudp.mesh import make_mesh_nd
+        from tpudp.strategy import build_strategy
+
+        axis = {"tp": "model", "pp": "pipe", "ep": "expert"}.get(args.strategy)
+        if args.strategy == "fsdp":
+            smesh = make_mesh_nd({"data": d * s}, devices=devices[: d * s])
+        else:
+            smesh = make_mesh_nd({"data": d, axis: s},
+                                 devices=devices[: d * s])
+        options = {}
+        if args.strategy == "tp":
+            from tpudp.parallel.tensor import gpt2_tp_rules
+
+            options["rules"] = gpt2_tp_rules()
+        if args.strategy == "pp":
+            options["n_microbatches"] = args.microbatches
+        built = build_strategy(args.strategy, model, tx, smesh, state,
+                               donate=False, **options)
+        state, step = built.state, built.train_step
+        sharding = built.shard_for(np.zeros((args.batch_size, args.seq_len)))
+    elif args.seq_parallel:
         if args.loss_chunk is not None:
             raise SystemExit("error: --loss-chunk is a DP-path option")
         step = make_seq_parallel_train_step(model, tx, mesh, donate=False)
