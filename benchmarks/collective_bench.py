@@ -1,0 +1,87 @@
+"""Pure gradient-collective comparison: the sync ladder head-to-head.
+
+The north-star asks for the ring-vs-psum comparison with measured
+collective wall-times (BASELINE.json:2).  A single real chip cannot show
+it — on a 1-device mesh every collective compiles to a no-op — so this
+bench runs each sync strategy's bare collective on the VGG-11 gradient
+tree over whatever mesh exists: the simulated N-device CPU mesh
+(COLLECTIVE_PLATFORM=cpu + xla_force_host_platform_device_count, an
+*algorithmic* comparison over shared memory), or a real multi-chip slice
+when one is attached (ICI numbers).  Results are labeled with the mesh so
+the two are never conflated.
+
+One JSON line per strategy: wall-time per mean-all-reduce of the 36.9 MB
+fp32 VGG-11 grad tree (fetch-fenced, warmup excluded).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STRATEGIES = ("allreduce", "ring", "coordinator", "allreduce_bf16")
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("COLLECTIVE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["COLLECTIVE_PLATFORM"])
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudp.mesh import make_mesh
+    from tpudp.models.vgg import VGG11
+    from tpudp.parallel.sync import get_sync
+    from tpudp.train import init_state, make_optimizer
+    from tpudp.utils.profiler import fetch_fence
+
+    steps = int(os.environ.get("COLLECTIVE_STEPS", 20))
+    warmup = int(os.environ.get("COLLECTIVE_WARMUP", 3))
+    only = os.environ.get("COLLECTIVE_STRATEGIES")
+    strategies = tuple(only.split(",")) if only else STRATEGIES
+
+    mesh = make_mesh()
+    n = mesh.size
+    kind = jax.devices()[0].device_kind
+    state = init_state(VGG11(), make_optimizer())
+    grads = jax.tree.map(jnp.zeros_like, state.params)
+    nbytes = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(grads))
+    rep = NamedSharding(mesh, P())
+    grads = jax.device_put(grads, rep)
+
+    for name in strategies:
+        sync = get_sync(name)
+
+        def body(tree):
+            return sync(tree, "data")
+
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P(),),
+                                   out_specs=P(), check_vma=False))
+        out = fn(grads)
+        fetch_fence(out)  # compile + warm
+        for _ in range(warmup):
+            out = fn(grads)
+        fetch_fence(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(out)
+        fetch_fence(out)
+        dt = (time.perf_counter() - t0) / steps
+        # ring all-reduce lower bound: 2(n-1)/n of the payload per device
+        wire = 2 * (n - 1) / n * nbytes if n > 1 else 0
+        print(json.dumps({
+            "strategy": name,
+            "wall_time_s": round(dt, 6),
+            "bytes": nbytes,
+            "gbps": round(wire / dt / 1e9, 3) if dt > 0 else 0.0,
+            "devices": n,
+            "device_kind": kind,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
