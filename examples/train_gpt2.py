@@ -18,7 +18,7 @@ binary file of uint16 token ids to train on real data.
   # second axis becomes the strategy axis (model/pipe/expert):
   ... --mesh 4x2 --strategy tp
   ... --mesh 4x2 --strategy pp --microbatches 4
-  ... --mesh 8x1 --strategy fsdp
+  ... --mesh 8x1 --strategy fsdp     # or zero1
   ... --mesh 4x2 --strategy ep
 """
 
@@ -37,7 +37,7 @@ def main() -> None:
     p.add_argument("--seq-parallel", action="store_true",
                    help="shard the sequence axis + ring attention")
     p.add_argument("--strategy", default="dp",
-                   choices=["dp", "tp", "pp", "fsdp", "ep"],
+                   choices=["dp", "tp", "pp", "fsdp", "zero1", "ep"],
                    help="parallelism rung (tpudp.strategy); the --mesh "
                         "second axis is the strategy axis")
     p.add_argument("--microbatches", type=int, default=2,
@@ -137,7 +137,7 @@ def main() -> None:
         from tpudp.strategy import build_strategy
 
         axis = {"tp": "model", "pp": "pipe", "ep": "expert"}.get(args.strategy)
-        if args.strategy == "fsdp":
+        if args.strategy in ("fsdp", "zero1"):
             smesh = make_mesh_nd({"data": d * s}, devices=devices[: d * s])
         else:
             smesh = make_mesh_nd({"data": d, axis: s},

@@ -97,6 +97,11 @@ def test_trainer_fsdp_rung(tmp_path):
     _drive("fsdp", mesh, DENSE, {"min_size": 128}, tmp_path)
 
 
+def test_trainer_zero1_rung(tmp_path):
+    mesh = make_mesh(8)
+    _drive("zero1", mesh, DENSE, {"min_size": 128}, tmp_path)
+
+
 def test_trainer_pp_rung(tmp_path):
     mesh = make_mesh_nd({"data": 2, "pipe": 2}, devices=jax.devices()[:4])
     _drive("pp", mesh, DENSE, {"n_microbatches": 2}, tmp_path)

@@ -21,6 +21,7 @@ Mesh axis requirements (build the mesh with tpudp.mesh.make_mesh_nd):
   ============  ===========================  ==========================
   ``tp``        ``data`` x ``model``         ``rules`` (partition rules)
   ``fsdp``      ``data``                     ``min_size``
+  ``zero1``     ``data``                     ``min_size``
   ``pp``        [``data`` x] ``pipe``        ``n_microbatches``, ``remat``
   ``ep``        ``data`` x ``expert``        ``aux_loss_coef``
   ``sp``        ``data`` x ``seq``           —
@@ -37,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudp.mesh import DATA_AXIS
 
-STRATEGIES = ("dp", "tp", "fsdp", "pp", "ep", "sp")
+STRATEGIES = ("dp", "tp", "fsdp", "zero1", "pp", "ep", "sp")
 
 
 class BuiltStrategy(NamedTuple):
@@ -79,6 +80,8 @@ def build_strategy(
         return _build_tp(model, tx, mesh, state, donate, options)
     if name == "fsdp":
         return _build_fsdp(model, tx, mesh, state, donate, options)
+    if name == "zero1":
+        return _build_zero1(model, tx, mesh, state, donate, options)
     if name == "pp":
         return _build_pp(model, tx, mesh, state, donate, options)
     if name == "ep":
@@ -117,21 +120,40 @@ def _build_tp(model, tx, mesh, state, donate, options):
                          _leading_axis_sharder(mesh, P(data_axis)))
 
 
-def _build_fsdp(model, tx, mesh, state, donate, options):
-    from tpudp.parallel.tensor import fsdp_shardings
-    from tpudp.train import make_fsdp_train_step, resolve_state_shardings
+def _build_data_sharded(name, make_step, shardings_fn,
+                        model, tx, mesh, state, donate, options):
+    """Shared builder for the 1-D data-axis GSPMD rungs (fsdp, zero1):
+    identical option surface, step-maker + shardings function vary."""
+    from tpudp.train import resolve_state_shardings
 
     data_axis = options.pop("data_axis", DATA_AXIS)
     min_size = options.pop("min_size", 1024)
-    _no_extra(options, "fsdp")
-    st, step = make_fsdp_train_step(model, tx, mesh, state,
-                                    data_axis=data_axis, min_size=min_size,
-                                    donate=donate)
+    _no_extra(options, name)
+    st, step = make_step(model, tx, mesh, state,
+                         data_axis=data_axis, min_size=min_size,
+                         donate=donate)
     st_sh = resolve_state_shardings(
-        state, mesh, partial(fsdp_shardings, axis=data_axis,
+        state, mesh, partial(shardings_fn, axis=data_axis,
                              min_size=min_size))
     return BuiltStrategy(st, step, _gspmd_eval(model, mesh, st_sh, data_axis),
                          _leading_axis_sharder(mesh, P(data_axis)))
+
+
+def _build_fsdp(model, tx, mesh, state, donate, options):
+    from tpudp.parallel.tensor import fsdp_shardings
+    from tpudp.train import make_fsdp_train_step
+
+    return _build_data_sharded("fsdp", make_fsdp_train_step, fsdp_shardings,
+                               model, tx, mesh, state, donate, options)
+
+
+def _build_zero1(model, tx, mesh, state, donate, options):
+    from tpudp.parallel.tensor import zero1_shardings
+    from tpudp.train import make_zero1_train_step
+
+    return _build_data_sharded("zero1", make_zero1_train_step,
+                               zero1_shardings, model, tx, mesh, state,
+                               donate, options)
 
 
 def _build_pp(model, tx, mesh, state, donate, options):

@@ -438,6 +438,30 @@ def make_fsdp_train_step(
         data_axis=data_axis, donate=donate)
 
 
+def make_zero1_train_step(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state: TrainState,
+    *,
+    data_axis: str = DATA_AXIS,
+    min_size: int = 1024,
+    donate: bool = True,
+) -> tuple[TrainState, Callable]:
+    """ZeRO-1 / weight-update-sharding rung (arXiv:2004.13336): parameters
+    replicated (plain-DP forward/backward, no weight gathers), optimizer
+    state sharded over the data axis — XLA reduce-scatters gradients into
+    the sharded momentum update and all-gathers the parameter delta.
+    Identical trajectory to DP with optimizer memory ÷ N; the middle rung
+    between DP and FSDP.  Same contract as :func:`make_tp_train_step`."""
+    from tpudp.parallel.tensor import zero1_shardings
+
+    return make_tp_train_step(
+        model, tx, mesh, state,
+        partial(zero1_shardings, axis=data_axis, min_size=min_size),
+        data_axis=data_axis, donate=donate)
+
+
 def make_seq_parallel_train_step(
     model: nn.Module,
     tx: optax.GradientTransformation,
