@@ -230,7 +230,25 @@ def main() -> None:
         errors.append(f"rc={proc.returncode}: "
                       + (tail[-1] if tail else "no output"))
 
-    # Every attempt failed — the headline line must still parse.
+    # Every attempt failed — the headline line must still parse.  If a
+    # previous run captured a real measurement (the TPU watcher records
+    # verbatim headline lines in bench_results/bench.json), attach it,
+    # clearly labeled: the relay window comes and goes (BASELINE.md), and
+    # a wedge at collection time should not erase evidence already banked.
+    last_good = None
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_results", "bench.json")
+        for line in open(path):
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("metric") == METRIC and row.get("value", 0) > 0:
+                row["measured_at_unix"] = int(os.path.getmtime(path))
+                last_good = row
+    except OSError:
+        pass
     print(json.dumps({
         "metric": METRIC,
         "value": 0.0,
@@ -238,6 +256,7 @@ def main() -> None:
         "vs_baseline": 0.0,
         "error": f"all {tries} attempts failed",
         "attempt_errors": [e[:500] for e in errors],
+        "last_known_good": last_good,
     }))
     sys.exit(0)
 
