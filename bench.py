@@ -237,17 +237,18 @@ def main() -> None:
     # a wedge at collection time should not erase evidence already banked.
     last_good = None
     try:
+        from tools.bench_gaps import rows_with_history
+
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "bench_results", "bench.json")
-        for line in open(path):
-            try:
-                row = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if row.get("metric") == METRIC and row.get("value", 0) > 0:
-                row["measured_at_unix"] = int(os.path.getmtime(path))
+        # bench rows key on "metric" (bench_gaps.measured covers the
+        # matrix/flash row shapes); same no-error + value>0 criterion.
+        for row in rows_with_history(path):
+            if (row.get("metric") == METRIC and "error" not in row
+                    and isinstance(row.get("value"), (int, float))
+                    and row["value"] > 0):
                 last_good = row
-    except OSError:
+    except Exception:  # noqa: BLE001 — the headline line must still print
         pass
     print(json.dumps({
         "metric": METRIC,

@@ -1,9 +1,10 @@
 """Part 2b — collective all-reduce gradient sync (reference: src/Part 2b/main.py:116-119).
 
 lax.psum over the mesh, divided by world size. Pass --ring to use the
-hand-rolled lax.ppermute ring all-reduce instead (north-star config), or
+hand-rolled lax.ppermute ring all-reduce instead (north-star config),
 --bf16-grads to compress the gradient collective to bfloat16 on the wire
-(half the bytes; beyond-reference).
+(half the bytes), or --int8-grads for int8 on the wire via the ring
+(quarter the bytes; lossy — see tpudp/parallel/sync.py).  Beyond-reference.
 """
 import os
 import sys
@@ -13,10 +14,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 from tpudp.cli import run_part
 
 if __name__ == "__main__":
-    ring = "--ring" in sys.argv
-    bf16 = "--bf16-grads" in sys.argv
-    argv = [a for a in sys.argv[1:] if a not in ("--ring", "--bf16-grads")]
-    if ring and bf16:
-        raise SystemExit("error: --ring and --bf16-grads are exclusive")
-    sync = "ring" if ring else ("allreduce_bf16" if bf16 else "allreduce")
+    flags = {f: f in sys.argv
+             for f in ("--ring", "--bf16-grads", "--int8-grads")}
+    argv = [a for a in sys.argv[1:] if a not in flags]
+    if sum(flags.values()) > 1:
+        raise SystemExit("error: --ring / --bf16-grads / --int8-grads are "
+                         "mutually exclusive")
+    sync = ("ring" if flags["--ring"]
+            else "allreduce_bf16" if flags["--bf16-grads"]
+            else "allreduce_int8" if flags["--int8-grads"]
+            else "allreduce")
     run_part(sync, "Part 2b: DP with all-reduce grad sync", argv=argv)

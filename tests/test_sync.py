@@ -142,3 +142,47 @@ def test_unknown_strategy_raises():
 
     with pytest.raises(ValueError):
         get_sync("nccl")
+
+
+def test_allreduce_int8_approximates_mean(mesh8):
+    """int8-wire ring rung: mean within the N*scale/2 quantization bound,
+    dtype restored, zeros stay zero."""
+    n = mesh8.size
+    rng = np.random.default_rng(5)
+    tree = {"w": rng.normal(size=(n, 7, 13)).astype(np.float32),
+            "z": np.zeros((n, 5), np.float32)}
+    expected = jax.tree.map(lambda x: x.mean(axis=0), tree)
+    sharded_in = jax.device_put(tree, NamedSharding(mesh8, P(DATA_AXIS)))
+    out = _run_sync(mesh8, "allreduce_int8", sharded_in)
+    assert np.asarray(out["w"]).dtype == np.float32
+    # quantization bound: shared scale = max|g|/127 over the FLAT buffer
+    # (both leaves); each device contributes <= scale/2 error to the mean
+    # (the /N is pre-folded), so the mean error <= N * scale / 2.
+    flat_max = max(float(np.abs(v).max()) for v in tree.values())
+    bound = n * flat_max / 127.0 / 2.0 + 1e-6
+    np.testing.assert_allclose(
+        np.asarray(out["w"]).reshape(expected["w"].shape), expected["w"],
+        atol=bound)
+    np.testing.assert_array_equal(
+        np.asarray(out["z"]).reshape(expected["z"].shape), 0.0)
+
+
+def test_allreduce_int8_trains_like_fp32(mesh8):
+    """End to end: the int8 rung trains (looser than bf16 — 8-bit wire)."""
+    from tpudp.models.vgg import VGG11
+    from tpudp.train import init_state, make_optimizer, make_train_step
+
+    model = VGG11()
+    tx = make_optimizer(learning_rate=0.01)
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=16), jnp.int32)
+    losses = {}
+    for name in ("allreduce", "allreduce_int8"):
+        state = init_state(model, tx)
+        step = make_train_step(model, tx, mesh8, name, donate=False)
+        for _ in range(3):
+            state, loss = step(state, x, y)
+        losses[name] = float(loss)
+    assert np.isfinite(losses["allreduce_int8"])
+    assert abs(losses["allreduce_int8"] - losses["allreduce"]) < 0.5
