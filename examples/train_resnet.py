@@ -75,7 +75,9 @@ def main() -> None:
     p.add_argument("--train-size", type=int, default=2048,
                    help="synthetic train-set size")
     p.add_argument("--lr", type=float, default=0.1)
-    p.add_argument("--sync", choices=["allreduce", "ring", "coordinator"],
+    p.add_argument("--sync", choices=["allreduce", "allreduce_bf16",
+                                  "allreduce_int8", "ring",
+                                  "coordinator"],
                    default="allreduce")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
