@@ -1,0 +1,134 @@
+"""Error-feedback gradient compression as optax transforms.
+
+The stateless ``allreduce_int8`` sync rung (tpudp.parallel.sync) drops up
+to half a quantization step of every device's gradient each step — a bias
+that does not vanish over training.  Error feedback (the standard fix,
+kept as state by e.g. torch-DDP's PowerSGD hook) carries each device's
+local quantization residual into the next step, so the *time-averaged*
+applied update equals the true mean gradient and the bias stays bounded
+instead of accumulating.
+
+TPU-native twist: the compressor is an **optax transform**, not a sync
+function.  Optax update fns run inside the shard_map'd train step where
+the mesh axis is bound, so the collective (the int8-wire ppermute ring)
+lives in the optimizer chain.  The residuals are genuinely PER-DEVICE
+data, so the state is stored honestly as a stacked ``(N, *shape)`` tree
+(an :class:`Int8EfState`) sharded ``P(data)`` over the mesh — never
+mislabeled as replicated — and ``make_train_step`` recognizes the state
+type and threads the matching shard_map specs
+(:func:`state_partition_specs`).  Checkpointing then saves every device's
+residual, and restore puts each back where it belongs.
+
+Place the transform FIRST in the chain (it turns per-device gradients
+into the compressed cross-device mean; weight decay and momentum then see
+identical values on every device) and build the train step with
+``sync="none"`` so nothing double-reduces.
+
+Wire cost per step: 1 byte/element per ring hop plus one fp32 scalar
+pmax.  Resolution: the shared grid must keep every partial ring sum
+within int8, so effective precision is ``8 - log2(N)`` bits of the flat
+buffer's max-abs — the error feedback is what makes that affordable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpudp.mesh import DATA_AXIS, axis_is_bound
+from tpudp.parallel.ring import flatten_tree, ring_all_reduce
+
+
+class Int8EfState(NamedTuple):
+    """Per-device EF residuals: every leaf is ``(num_devices, *param_shape)``
+    fp32, sharded ``P(axis)`` on the leading dim (device i owns row i)."""
+
+    error: Any
+
+
+def int8_ef_allreduce(
+    axis_name: str = DATA_AXIS,
+    num_devices: int | None = None,
+) -> optax.GradientTransformation:
+    """int8-wire ring all-reduce with error feedback, as an optax transform.
+
+    update: ``corrected_i = g_i / N + error_i`` (per device), quantized on a
+    shared grid coarse enough that ring partial sums stay int8
+    (``scale = pmax(max|corrected|) * N / 127``), ring-summed exactly in
+    int8, dequantized to the compressed mean; the new ``error_i`` is the
+    local residual ``corrected_i - q_i * scale``.
+
+    ``num_devices`` (the mesh's ``axis_name`` size) is required at init
+    time to allocate the stacked per-device state.  The update must run
+    inside a shard_map with ``axis_name`` bound and the state sharded via
+    :func:`state_partition_specs` (``make_train_step`` does both).
+    """
+
+    def init_fn(params):
+        if num_devices is None:
+            raise ValueError(
+                "int8_ef_allreduce needs num_devices (the mesh axis size) "
+                "at construction to allocate the per-device error state — "
+                "pass make_optimizer(compress_devices=mesh.shape['data'])")
+        return Int8EfState(error=jax.tree.map(
+            lambda p: jnp.zeros((num_devices,) + p.shape, jnp.float32),
+            params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        if not axis_is_bound(axis_name):
+            raise ValueError(
+                f"int8_ef_allreduce needs mesh axis {axis_name!r} bound — "
+                "use a shard_map DP step (sync='none'), not gspmd/single")
+        n = lax.axis_size(axis_name)
+        # Inside shard_map each device sees its (1, *shape) row of the
+        # stacked state; squeeze for the math, restore on the way out.
+        e_local = jax.tree.map(lambda e: e[0], state.error)
+        corrected = jax.tree.map(
+            lambda g, e: g.astype(jnp.float32) / n + e, updates, e_local)
+        flat, unflatten = flatten_tree(corrected)
+        # Shared grid with partial-ring-sum headroom: |q_i| <= 127/N.
+        scale = lax.pmax(jnp.maximum(jnp.max(jnp.abs(flat)), 1e-30),
+                         axis_name) * n / 127.0
+        q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
+        total = ring_all_reduce(q, axis_name)  # int8 wire, exact adds
+        mean = unflatten(total.astype(jnp.float32) * scale, cast=False)
+        err = unflatten(flat - q.astype(jnp.float32) * scale, cast=False)
+        mean = jax.tree.map(lambda m, g: m.astype(g.dtype), mean, updates)
+        return mean, Int8EfState(error=jax.tree.map(
+            lambda e: e[None], err))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def state_partition_specs(state, data_axis: str = DATA_AXIS):
+    """shard_map PartitionSpecs for a TrainState(-like) pytree: ``P()``
+    (replicated) everywhere EXCEPT :class:`Int8EfState` subtrees, whose
+    stacked per-device leaves shard their leading dim over ``data_axis``.
+    The single source ``make_train_step`` uses so per-device optimizer
+    state is never mislabeled as replicated."""
+    return jax.tree.map(
+        lambda node: (jax.tree.map(lambda _: P(data_axis), node)
+                      if isinstance(node, Int8EfState)
+                      else P()),
+        state, is_leaf=lambda node: isinstance(node, Int8EfState))
+
+
+def has_per_device_state(state) -> bool:
+    """Does this (Train)state contain stacked per-device optimizer state?"""
+    found = False
+
+    def visit(node):
+        nonlocal found
+        if isinstance(node, Int8EfState):
+            found = True
+        return node
+
+    jax.tree.map(visit, state,
+                 is_leaf=lambda node: isinstance(node, Int8EfState))
+    return found

@@ -76,17 +76,35 @@ def ring_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     return flat_out.reshape(shape)
 
 
-def ring_all_reduce_mean(tree, axis_name: str):
-    """Mean-reduce a gradient pytree over the ring as ONE flat buffer."""
-    n = lax.axis_size(axis_name)
+def flatten_tree(tree, dtype=None):
+    """Pack a pytree into ONE flat vector; returns ``(flat, unflatten)``.
+
+    ``unflatten(vec)`` slices ``vec`` back into the original
+    shapes/structure, casting each leaf to its original dtype.  The shared
+    packing used by the ring collective, the int8 sync rung, and the
+    error-feedback compressor — one place for the slice bookkeeping."""
     leaves, treedef = jax.tree.flatten(tree)
     sizes = [leaf.size for leaf in leaves]
     shapes = [leaf.shape for leaf in leaves]
-    flat = jnp.concatenate([leaf.reshape(-1) for leaf in leaves])
-    summed = ring_all_reduce(flat, axis_name)
-    mean = summed / n
-    out, offset = [], 0
-    for size, shape in zip(sizes, shapes):
-        out.append(lax.dynamic_slice_in_dim(mean, offset, size).reshape(shape))
-        offset += size
-    return jax.tree.unflatten(treedef, out)
+    dtypes = [leaf.dtype for leaf in leaves]
+    flat = jnp.concatenate([
+        leaf.reshape(-1) if dtype is None else leaf.reshape(-1).astype(dtype)
+        for leaf in leaves])
+
+    def unflatten(vec, cast: bool = True):
+        out, offset = [], 0
+        for size, shape, dt in zip(sizes, shapes, dtypes):
+            leaf = lax.dynamic_slice_in_dim(vec, offset, size).reshape(shape)
+            out.append(leaf.astype(dt) if cast else leaf)
+            offset += size
+        return jax.tree.unflatten(treedef, out)
+
+    return flat, unflatten
+
+
+def ring_all_reduce_mean(tree, axis_name: str):
+    """Mean-reduce a gradient pytree over the ring as ONE flat buffer."""
+    n = lax.axis_size(axis_name)
+    flat, unflatten = flatten_tree(tree)
+    mean = ring_all_reduce(flat, axis_name) / n
+    return unflatten(mean, cast=False)

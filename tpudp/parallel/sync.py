@@ -119,28 +119,18 @@ def sync_allreduce_int8(grads, axis_name):
     """
     import jax.numpy as jnp
 
-    from tpudp.parallel.ring import ring_all_reduce
+    from tpudp.parallel.ring import flatten_tree, ring_all_reduce
 
     n = lax.axis_size(axis_name)
     if n == 1:
         return grads
-    leaves, treedef = jax.tree.flatten(grads)
-    sizes = [leaf.size for leaf in leaves]
-    shapes = [leaf.shape for leaf in leaves]
-    dtypes = [leaf.dtype for leaf in leaves]
-    flat = jnp.concatenate(
-        [leaf.reshape(-1).astype(jnp.float32) for leaf in leaves])
+    flat, unflatten = flatten_tree(grads, dtype=jnp.float32)
     scale = lax.pmax(jnp.maximum(jnp.max(jnp.abs(flat)), 1e-30),
                      axis_name) / 127.0
     q = jnp.clip(jnp.round(flat / (scale * n)), -127, 127).astype(jnp.int8)
     total = ring_all_reduce(q, axis_name)  # int8 on the wire, exact adds
     mean = total.astype(jnp.float32) * scale  # the /N is folded into q
-    out, offset = [], 0
-    for size, shape, dt in zip(sizes, shapes, dtypes):
-        out.append(lax.dynamic_slice_in_dim(mean, offset, size)
-                   .reshape(shape).astype(dt))
-        offset += size
-    return jax.tree.unflatten(treedef, out)
+    return unflatten(mean)
 
 
 # 'auto' shares the allreduce math; the difference is scheduling, which XLA

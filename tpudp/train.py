@@ -80,6 +80,9 @@ def make_optimizer(
     total_steps: int | None = None,
     optimizer: str = "sgd",
     clip_norm: float | None = None,
+    compress: str | None = None,
+    compress_axis: str = DATA_AXIS,
+    compress_devices: int | None = None,
 ) -> optax.GradientTransformation:
     """torch.optim.SGD(lr, momentum, weight_decay) equivalent
     (reference: ``src/Part 2a/main.py:61-62``).  ``add_decayed_weights``
@@ -98,7 +101,14 @@ def make_optimizer(
 
     ``clip_norm`` prepends global-norm gradient clipping (the standard
     LM-training stabilizer; applies after the cross-device mean since sync
-    runs inside the step before tx.update)."""
+    runs inside the step before tx.update).
+
+    ``compress='int8_ef'`` prepends the error-feedback int8-wire ring
+    all-reduce (tpudp.parallel.compress) — pair with a shard_map step
+    built with ``sync='none'`` and ``state_specs=state_partition_specs(
+    state)``.  ``compress_devices`` (required with compress) is the mesh
+    data-axis size: the per-device residuals live in ``opt_state`` as a
+    stacked ``(N, ...)`` tree sharded over the mesh."""
     if schedule is None:
         lr = learning_rate
     elif schedule == "cosine":
@@ -118,15 +128,28 @@ def make_optimizer(
         raise ValueError(f"unknown schedule {schedule!r}")
     if clip_norm is not None and clip_norm <= 0:
         raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
-    clip = ([optax.clip_by_global_norm(clip_norm)]
-            if clip_norm is not None else [])
+    head = []
+    if compress is not None:
+        # Error-feedback compressed all-reduce (tpudp.parallel.compress):
+        # FIRST in the chain — it turns per-device grads into the
+        # compressed cross-device mean; everything downstream (clip, wd,
+        # momentum) then sees identical values on all devices.  Build the
+        # step with sync='none' so nothing double-reduces.
+        if compress != "int8_ef":
+            raise ValueError(
+                f"unknown compress {compress!r}; choose 'int8_ef'")
+        from tpudp.parallel.compress import int8_ef_allreduce
+
+        head.append(int8_ef_allreduce(compress_axis, compress_devices))
+    if clip_norm is not None:
+        head.append(optax.clip_by_global_norm(clip_norm))
     if optimizer == "adamw":
-        return optax.chain(*clip, optax.adamw(lr, weight_decay=weight_decay))
+        return optax.chain(*head, optax.adamw(lr, weight_decay=weight_decay))
     if optimizer != "sgd":
         raise ValueError(
             f"unknown optimizer {optimizer!r}; choose 'sgd' or 'adamw'")
     return optax.chain(
-        *clip,
+        *head,
         optax.add_decayed_weights(weight_decay),
         optax.sgd(lr, momentum=momentum),
     )
@@ -276,8 +299,14 @@ def make_train_step(
     aux_loss_coef: float = 0.01,
     remat: bool = False,
     loss_chunk: int | None = None,
+    state_specs=None,
 ) -> Callable:
     """Build the jitted ``(state, images, labels) -> (state, loss)`` step.
+
+    ``state_specs`` (shard_map mode): a PartitionSpec pytree for the state
+    when parts of it are genuinely per-device — e.g. the error-feedback
+    compressor's stacked residuals (tpudp.parallel.compress.
+    state_partition_specs builds it).  Default: fully replicated ``P()``.
 
     ``remat=True`` rematerializes activations during backward
     (``jax.checkpoint``) — identical gradients, lower peak HBM, one extra
@@ -342,11 +371,12 @@ def make_train_step(
                                   sync_fn, DATA_AXIS, grad_accum,
                                   aux_loss_coef, remat, loss_chunk)
 
+    st_spec = P() if state_specs is None else state_specs
     sharded = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P()),
+        in_specs=(st_spec, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(st_spec, P()),
         check_vma=False,  # ring's ppermute output is replicated by construction, not by type
     )
     return jax.jit(sharded, donate_argnums=donate_args)
