@@ -710,13 +710,29 @@ class Trainer:
         remat: bool = False,
         loss_chunk: int | None = None,
         metrics_jsonl: str | None = None,
+        compress: str | None = None,
     ):
         self.model = model
         self.mesh = mesh
         self.sync = sync
         self.strategy = strategy
         self.watchdog = watchdog  # tpudp.utils.watchdog.Watchdog or None
-        self.tx = make_optimizer(learning_rate, momentum, weight_decay)
+        if compress is not None:
+            # EF-compressed gradient collective lives in the optimizer
+            # chain (tpudp.parallel.compress); the explicit sync must be
+            # 'none' or the gradients would reduce twice.
+            if strategy != "dp" or spmd_mode != "shard_map" or mesh is None:
+                raise ValueError(
+                    "compress needs the shard_map DP rung with a mesh "
+                    f"(strategy={strategy!r}, spmd_mode={spmd_mode!r})")
+            if sync != "none":
+                raise ValueError(
+                    f"compress={compress!r} replaces the sync collective; "
+                    "pass sync='none' (got sync={!r})".format(sync))
+        self.tx = make_optimizer(
+            learning_rate, momentum, weight_decay, compress=compress,
+            compress_devices=(mesh.shape[DATA_AXIS]
+                              if compress is not None else None))
         self.state = init_state(model, self.tx, input_shape=input_shape,
                                 seed=seed)
         self.timing_mode = timing_mode
@@ -730,10 +746,15 @@ class Trainer:
             metrics_jsonl if jax.process_index() == 0 else None)
         self.fwd_step = None
         if strategy == "dp":
+            state_specs = None
+            if compress is not None:
+                from tpudp.parallel.compress import state_partition_specs
+
+                state_specs = state_partition_specs(self.state)
             self.train_step = make_train_step(
                 model, self.tx, mesh, sync, spmd_mode=spmd_mode,
                 donate=(timing_mode != "split"), grad_accum=grad_accum,
-                remat=remat, loss_chunk=loss_chunk,
+                remat=remat, loss_chunk=loss_chunk, state_specs=state_specs,
             )
             if timing_mode == "split":
                 if loss_chunk:
