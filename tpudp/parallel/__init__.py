@@ -2,3 +2,6 @@
 
 from tpudp.parallel.sync import SYNC_STRATEGIES, get_sync  # noqa: F401
 from tpudp.parallel.ring import ring_all_reduce_mean, ring_all_reduce  # noqa: F401
+from tpudp.parallel.compress import (Int8EfState,  # noqa: F401
+                                     int8_ef_allreduce,
+                                     state_partition_specs)
