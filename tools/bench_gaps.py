@@ -25,9 +25,18 @@ FLASH_TS = (4096, 8192, 16384)
 
 
 def history_path(path: str) -> str:
-    """Where the watcher banks a result file between relay windows."""
-    return (path[: -len(".jsonl")] + ".history.jsonl"
-            if path.endswith(".jsonl") else path)
+    """Where a result file is banked between relay windows.
+
+    ``.jsonl`` files are banked by the watcher before a retried stage
+    truncates them; ``bench.json`` is banked by bench.py itself the moment
+    a headline line is captured (the watcher launches bench.py with
+    ``> bench.json``, truncating BEFORE the process starts, so banking
+    from the watcher would be too late — round-2 advisor finding)."""
+    if path.endswith(".jsonl"):
+        return path[: -len(".jsonl")] + ".history.jsonl"
+    if path.endswith(".json"):
+        return path[: -len(".json")] + ".history.jsonl"
+    return path
 
 
 def rows_with_history(path):
@@ -58,6 +67,8 @@ def measured(r: dict) -> bool:
         return r.get("value", 0) > 0
     if "t" in r:
         return bool(r.get("flash_ms"))
+    if "metric" in r:  # bench.py headline rows
+        return r.get("value", 0) > 0
     return False
 
 

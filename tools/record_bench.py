@@ -42,9 +42,16 @@ def main() -> None:
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
 
-    head = next((r for r in _rows(os.path.join(args.dir, "bench.json"))
-                 if r.get("metric")), None)
+    # Newest measured headline row wins (history yields oldest-first and
+    # now includes bench.history.jsonl, so next() would pick the OLDEST;
+    # _dedupe's later-measured-wins semantics pick the freshest real one).
+    heads = _dedupe((r for r in _rows(os.path.join(args.dir, "bench.json"))
+                     if r.get("metric")), "metric")
+    head = next(iter(heads.values()), None)
     if head:
+        if head.get("source") == "last_known_good":
+            print(f"| (headline row is a banked last-known-good re-emission "
+                  f"from {head.get('measured_at_utc')}) | | | |")
         if head.get("value", 0) > 0:
             print(f"| tpudp fused DP step ({head['device_kind']}, "
                   f"{head['dtype']}, batch {head['global_batch']}, donated) "

@@ -1,0 +1,25 @@
+"""Is the TPU behind the axon relay actually reachable right now?
+
+Device discovery + one tiny MXU op FETCHED to host (the only real barrier
+under the relay — BASELINE.md timing-honesty note).  Exit 0 = healthy.
+The single probe shared by bench.py and tools/tpu_when_ready.sh so they
+can never disagree about "healthy"; run under an external timeout (the
+whole point is that a wedged relay HANGS rather than erroring):
+
+    timeout 90 python tools/tpu_probe.py
+"""
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    d = jax.devices()
+    assert d and d[0].platform != "cpu", f"no accelerator: {d}"
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    np.asarray(jnp.sum(x @ x))
+
+
+if __name__ == "__main__":
+    main()

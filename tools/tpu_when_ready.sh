@@ -12,27 +12,31 @@ PERIOD="${PERIOD:-180}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-90}"
 log() { echo "[$(date +%H:%M:%S)] $*" >> bench_results/watch.log; }
 
+# The single probe shared with bench.py (tools/tpu_probe.py) so the
+# watcher and the bench can never disagree about "healthy".
 probe() {
-  timeout "$PROBE_TIMEOUT" python - <<'EOF' >/dev/null 2>&1
-import jax, jax.numpy as jnp, numpy as np
-d = jax.devices()
-assert d and d[0].platform != "cpu"
-x = jnp.ones((256, 256), jnp.bfloat16)
-np.asarray(jnp.sum(x @ x))
-EOF
+  timeout "$PROBE_TIMEOUT" python tools/tpu_probe.py >/dev/null 2>&1
 }
 
-# The battery "succeeded" only if bench.py produced a real measurement
-# (a headline line with a non-zero value); a relay that wedges between the
-# probe and the bench yields empty/error output and the watcher must keep
-# waiting, not exit with empty result files.
+# The battery "succeeded" only if bench.py produced a FRESH real
+# measurement (a headline line with a non-zero value that is not a
+# re-emitted last_known_good fallback); a relay that wedges between the
+# probe and the bench yields empty/error/stale output and the watcher must
+# keep waiting, not exit with empty result files.
 battery_ok() {
-  python - <<'EOF'
-import json, sys
+  START_ISO="$START_ISO" python - <<'EOF'
+import json, os, sys
 try:
     lines = open("bench_results/bench.json").read().strip().splitlines()
     head = next(json.loads(l) for l in lines if l.startswith("{"))
-    sys.exit(0 if head.get("value", 0) > 0 else 1)
+    # Fresh = measured AFTER this watcher started: a committed prior-round
+    # bench.json (or a banked re-emission) must not satisfy the gate, or
+    # the watcher would skip measuring the CURRENT round's code.  ISO-8601
+    # UTC strings compare correctly as strings.
+    ok = (head.get("value", 0) > 0
+          and head.get("source") != "last_known_good"
+          and head.get("measured_at_utc", "") >= os.environ["START_ISO"])
+    sys.exit(0 if ok else 1)
 except Exception:
     sys.exit(1)
 EOF
@@ -64,6 +68,7 @@ bank() { [ -s "$1" ] && cat "$1" >> "${1%.jsonl}.history.jsonl"; }
 # relay — a watcher that never got a window must stand down before then.
 DEADLINE_S="${DEADLINE_S:-14400}"
 START_TS=$(date +%s)
+START_ISO=$(date -u +%Y-%m-%dT%H:%M:%SZ)
 
 log "watcher started (period=${PERIOD}s, deadline=${DEADLINE_S}s)"
 while true; do
@@ -76,7 +81,11 @@ while true; do
     if battery_ok; then
       log "bench.json already good; skipping bench.py"
     else
-      BENCH_TRIES=2 BENCH_TIMEOUT=900 timeout 2100 python bench.py \
+      # BENCH_STRICT: under the watcher only a FRESH measurement counts —
+      # a banked re-emission would satisfy battery_ok and mask the gap.
+      # BENCH_PROBE=0: the watcher just probed.
+      BENCH_STRICT=1 BENCH_PROBE=0 BENCH_TRIES=2 BENCH_TIMEOUT=600 \
+        timeout 1500 python bench.py \
         > bench_results/bench.json 2> bench_results/bench.err
       log "bench.py rc=$? -> bench_results/bench.json"
       if ! battery_ok; then
