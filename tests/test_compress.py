@@ -62,8 +62,8 @@ def test_error_feedback_bounds_accumulated_bias(mesh8):
         acc += np.asarray(upd["w"]).reshape(31)
 
     # One-step quantization bound (scale fixed point <= ~2x the ideal
-    # max|corrected| * n/127 grid), NOT growing with T.
-    bound = n * float(np.abs(g_host).max()) * 2.0 / 127.0
+    # max|corrected| / (127//n) grid), NOT growing with T.
+    bound = float(np.abs(g_host).max()) * 2.0 / (127 // n)
     np.testing.assert_allclose(acc, T * true_mean, atol=bound)
     # the state really holds DIFFERENT residuals per device (the thing a
     # replicated-marked buffer would silently collapse)
@@ -85,11 +85,29 @@ def test_error_state_is_the_local_residual(mesh8):
                      tx.init({"w": jnp.zeros((16,), jnp.float32)})))
     upd, st1 = _stepper(mesh8, tx)(g, st)
     # Step-1 residuals are bounded by half the shared grid: corrected =
-    # g/n (zero initial error), so scale = max|g|/n * n/127 = max|g|/127
-    # and |residual| <= scale/2 = max|g|/254.
-    bound = float(np.abs(g_host).max()) / 254.0 + 1e-7
+    # g/n (zero initial error), so scale = (max|g|/n) / (127//n) and
+    # |residual| <= scale/2 = max|g| / (2*n*(127//n)).
+    bound = float(np.abs(g_host).max()) / (2.0 * n * (127 // n)) + 1e-7
     assert float(np.abs(np.asarray(st1.error["w"])).max()) <= bound
     assert float(np.abs(np.asarray(st1.error["w"])).max()) > 0.0
+
+
+def test_ef_no_wraparound_on_identical_grads(mesh8):
+    """Regression (round-2 advisor): N identical max-magnitude gradients
+    must not wrap the int8 ring sum — here the corruption would be
+    PERMANENT, because the EF residual is computed against the device's
+    own q and cannot see (let alone repair) a wrapped total."""
+    n = mesh8.size
+    tx = int8_ef_allreduce(num_devices=n)
+    g = {"w": _sharded(mesh8, jnp.ones((n, 17), jnp.float32), P(DATA_AXIS))}
+    st = jax.device_put(
+        tx.init({"w": jnp.zeros((17,), jnp.float32)}),
+        jax.tree.map(lambda _: NamedSharding(mesh8, P(DATA_AXIS)),
+                     tx.init({"w": jnp.zeros((17,), jnp.float32)})))
+    upd, _ = _stepper(mesh8, tx)(g, st)
+    w = np.asarray(upd["w"]).reshape(17)
+    assert np.all(w > 0), f"sign flip: min={w.min()}"
+    np.testing.assert_allclose(w, 1.0, rtol=1e-6)
 
 
 def test_trains_through_make_optimizer(mesh8):

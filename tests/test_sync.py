@@ -155,16 +155,37 @@ def test_allreduce_int8_approximates_mean(mesh8):
     sharded_in = jax.device_put(tree, NamedSharding(mesh8, P(DATA_AXIS)))
     out = _run_sync(mesh8, "allreduce_int8", sharded_in)
     assert np.asarray(out["w"]).dtype == np.float32
-    # quantization bound: shared scale = max|g|/127 over the FLAT buffer
-    # (both leaves); each device contributes <= scale/2 error to the mean
-    # (the /N is pre-folded), so the mean error <= N * scale / 2.
+    # quantization bound: shared grid scale = max|g|/((127//N)*N) over the
+    # FLAT buffer (both leaves); each device contributes <= scale*N/2 error
+    # in flat units, so the mean error <= N * scale / 2 = max|g|/(2*(127//N)).
     flat_max = max(float(np.abs(v).max()) for v in tree.values())
-    bound = n * flat_max / 127.0 / 2.0 + 1e-6
+    bound = flat_max / (2.0 * (127 // n)) + 1e-6
     np.testing.assert_allclose(
         np.asarray(out["w"]).reshape(expected["w"].shape), expected["w"],
         atol=bound)
     np.testing.assert_array_equal(
         np.asarray(out["z"]).reshape(expected["z"].shape), 0.0)
+
+
+@pytest.mark.parametrize("nsub", [2, 8])
+def test_allreduce_int8_no_wraparound_on_identical_grads(nsub):
+    """Regression (round-2 advisor): N identical max-magnitude gradients
+    must not wrap int8.  With round-then-clip-at-127, each device
+    quantizes round(127/N) (64 at N=2); N of those sum to 128, which wraps
+    to -128 and SIGN-FLIPS the largest gradient element (measured mean
+    -1.008 for grads of 1.0).  The grid is now clipped to +/-(127//N), so
+    the worst-case ring sum N*(127//N) <= 127 is exactly representable and
+    the mean of all-ones gradients comes back exactly 1.0."""
+    from tpudp.mesh import make_mesh
+
+    mesh = make_mesh(nsub)
+    n = mesh.size
+    tree = {"w": np.ones((n, 33), np.float32)}
+    sharded_in = jax.device_put(tree, NamedSharding(mesh, P(DATA_AXIS)))
+    out = _run_sync(mesh, "allreduce_int8", sharded_in)
+    w = np.asarray(out["w"]).reshape(33)
+    assert np.all(w > 0), f"sign flip: min={w.min()}"
+    np.testing.assert_allclose(w, 1.0, rtol=1e-6)
 
 
 def test_allreduce_int8_trains_like_fp32(mesh8):

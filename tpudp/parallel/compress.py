@@ -26,8 +26,9 @@ identical values on every device) and build the train step with
 
 Wire cost per step: 1 byte/element per ring hop plus one fp32 scalar
 pmax.  Resolution: the shared grid must keep every partial ring sum
-within int8, so effective precision is ``8 - log2(N)`` bits of the flat
-buffer's max-abs — the error feedback is what makes that affordable.
+within int8 (quantized values clipped to ``+/-(127 // N)``), so effective
+precision is ``log2(127 // N)`` bits of the flat buffer's max-abs — the
+error feedback is what makes that affordable.
 """
 
 from __future__ import annotations
@@ -41,7 +42,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tpudp.mesh import DATA_AXIS, axis_is_bound
-from tpudp.parallel.ring import flatten_tree, ring_all_reduce
+from tpudp.parallel.ring import (flatten_tree, int8_headroom_quantize,
+                                 ring_all_reduce)
 
 
 class Int8EfState(NamedTuple):
@@ -59,9 +61,10 @@ def int8_ef_allreduce(
 
     update: ``corrected_i = g_i / N + error_i`` (per device), quantized on a
     shared grid coarse enough that ring partial sums stay int8
-    (``scale = pmax(max|corrected|) * N / 127``), ring-summed exactly in
-    int8, dequantized to the compressed mean; the new ``error_i`` is the
-    local residual ``corrected_i - q_i * scale``.
+    (``scale = pmax(max|corrected|) / (127 // N)``, values clipped to
+    ``+/-(127 // N)``), ring-summed exactly in int8, dequantized to the
+    compressed mean; the new ``error_i`` is the local residual
+    ``corrected_i - q_i * scale``.
 
     ``num_devices`` (the mesh's ``axis_name`` size) is required at init
     time to allocate the stacked per-device state.  The update must run
@@ -92,10 +95,11 @@ def int8_ef_allreduce(
         corrected = jax.tree.map(
             lambda g, e: g.astype(jnp.float32) / n + e, updates, e_local)
         flat, unflatten = flatten_tree(corrected)
-        # Shared grid with partial-ring-sum headroom: |q_i| <= 127/N.
-        scale = lax.pmax(jnp.maximum(jnp.max(jnp.abs(flat)), 1e-30),
-                         axis_name) * n / 127.0
-        q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
+        # Shared +/-(127 // N) headroom grid (int8_headroom_quantize): a
+        # wrapped ring total here could not even be repaired by the error
+        # feedback, which only sees the device's own q.  The EF residual
+        # absorbs the rounding AND any clipping.
+        q, scale = int8_headroom_quantize(flat, axis_name)
         total = ring_all_reduce(q, axis_name)  # int8 wire, exact adds
         mean = unflatten(total.astype(jnp.float32) * scale, cast=False)
         err = unflatten(flat - q.astype(jnp.float32) * scale, cast=False)

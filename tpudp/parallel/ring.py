@@ -102,6 +102,40 @@ def flatten_tree(tree, dtype=None):
     return flat, unflatten
 
 
+def int8_headroom_quantize(flat, axis_name: str):
+    """Quantize a flat fp32 buffer onto an int8 grid safe to ring-sum.
+
+    The single source of the wraparound invariant shared by the stateless
+    int8 sync rung and the error-feedback compressor: quantized values are
+    clipped to ``+/-(127 // N)``, so the worst-case ring partial sum — N
+    devices all at the clip bound with the same sign — is
+    ``N * (127 // N) <= 127``, strictly inside int8.  Clipping at the
+    QUANTIZED level is what provides the guarantee: with plain round, N
+    near-identical max-magnitude values each rounding 127/N up (e.g.
+    round(63.5) = 64 at N=2) sum to 128 and wrap to -128, sign-flipping
+    the largest element (round-2 advisor finding).
+
+    Returns ``(q, unit)``: ``q`` int8 with ``|q| <= 127 // N``, and
+    ``unit`` (one grid tick in ``flat``'s units, an fp32 scalar shared by
+    every device via ``pmax``) such that ``q * unit ~= flat`` and a ring
+    TOTAL dequantizes as ``total * unit``.  Effective precision is
+    ``log2(127 // N)`` bits of the buffer's max-abs.
+    """
+    n = lax.axis_size(axis_name)
+    qmax = 127 // n
+    if qmax < 1:
+        # 127 // n == 0 would make unit a divide-by-zero: every gradient
+        # silently NaN.  Fail loudly at trace time (n is static).
+        raise ValueError(
+            f"int8 ring compression supports at most 127 devices along the "
+            f"reduce axis (got {n}): the +/-(127 // N) headroom grid is "
+            f"empty — use allreduce_bf16 or shard the axis")
+    maxabs = lax.pmax(jnp.maximum(jnp.max(jnp.abs(flat)), 1e-30), axis_name)
+    unit = maxabs / qmax
+    q = jnp.clip(jnp.round(flat / unit), -qmax, qmax).astype(jnp.int8)
+    return q, unit
+
+
 def ring_all_reduce_mean(tree, axis_name: str):
     """Mean-reduce a gradient pytree over the ring as ONE flat buffer."""
     n = lax.axis_size(axis_name)
