@@ -21,7 +21,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-STRATEGIES = ("allreduce", "ring", "coordinator", "allreduce_bf16")
+STRATEGIES = ("allreduce", "ring", "ring_uni", "allreduce_hd",
+              "allreduce_a2a", "coordinator", "allreduce_bf16")
 
 
 def main() -> None:

@@ -26,7 +26,9 @@ def _run_sync(mesh, name, tree):
     return jax.jit(sharded)(tree)
 
 
-@pytest.mark.parametrize("name", ["coordinator", "allreduce", "ring", "auto"])
+@pytest.mark.parametrize("name", ["coordinator", "allreduce", "ring",
+                                  "ring_uni", "allreduce_hd",
+                                  "allreduce_a2a", "auto"])
 def test_strategies_produce_mean(mesh8, name):
     n = mesh8.size
     rng = np.random.default_rng(0)
@@ -85,19 +87,48 @@ def test_allreduce_bf16_trains_like_fp32(mesh8):
     assert abs(losses["allreduce"] - losses["allreduce_bf16"]) < 0.05
 
 
-def test_ring_equals_psum(mesh8):
-    n = mesh8.size
+@pytest.mark.parametrize("bidir", [True, False])
+@pytest.mark.parametrize("nsub", [2, 8])
+def test_ring_equals_psum(nsub, bidir):
+    from tpudp.mesh import make_mesh
+
+    mesh = make_mesh(nsub)
+    n = mesh.size
     rng = np.random.default_rng(1)
     x = rng.normal(size=(n, 1031)).astype(np.float32)  # prime size: pad path
 
     def body(xs):
-        return ring_all_reduce(xs, DATA_AXIS), jax.lax.psum(xs, DATA_AXIS)
+        return (ring_all_reduce(xs, DATA_AXIS, bidirectional=bidir),
+                jax.lax.psum(xs, DATA_AXIS))
 
     ring_out, psum_out = jax.jit(
-        jax.shard_map(body, mesh=mesh8, in_specs=P(DATA_AXIS),
+        jax.shard_map(body, mesh=mesh, in_specs=P(DATA_AXIS),
                       out_specs=P(DATA_AXIS), check_vma=False)
     )(x)
     np.testing.assert_allclose(np.asarray(ring_out), np.asarray(psum_out),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("nsub", [2, 4, 8])
+def test_hd_equals_psum(nsub):
+    """Halving-doubling matches psum on power-of-two meshes, pad path
+    included (prime payload size)."""
+    from tpudp.mesh import make_mesh
+    from tpudp.parallel.ring import hd_all_reduce
+
+    mesh = make_mesh(nsub)
+    n = mesh.size
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(n, 1031)).astype(np.float32)
+
+    def body(xs):
+        return hd_all_reduce(xs, DATA_AXIS), jax.lax.psum(xs, DATA_AXIS)
+
+    hd_out, psum_out = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P(DATA_AXIS),
+                      out_specs=P(DATA_AXIS), check_vma=False)
+    )(x)
+    np.testing.assert_allclose(np.asarray(hd_out), np.asarray(psum_out),
                                rtol=1e-5, atol=1e-5)
 
 

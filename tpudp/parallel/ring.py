@@ -16,6 +16,13 @@ TPU-first design notes:
   * Static Python loop over ring steps: N is known at trace time, so XLA sees
     a straight-line schedule of ppermutes it can pipeline; chunk indices are
     traced values derived from ``lax.axis_index``.
+  * Bidirectional by default: the buffer is split into two counter-rotating
+    halves, one riding the clockwise ring and one the counter-clockwise
+    ring.  The two directions' ppermutes are data-independent and
+    interleaved in the trace, so XLA can run them concurrently — on a TPU
+    torus each ICI link carries traffic in both directions at once, so
+    per-step payload (and ideally wall time) halves; even over host shared
+    memory the independent halves give the scheduler overlap to exploit.
 """
 
 from __future__ import annotations
@@ -25,16 +32,158 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _ring_perm(n: int) -> list[tuple[int, int]]:
-    return [(i, (i + 1) % n) for i in range(n)]
+def _ring_perm(n: int, sign: int = 1) -> list[tuple[int, int]]:
+    """Neighbor map for the ring: ``sign=+1`` clockwise (i -> i+1),
+    ``sign=-1`` counter-clockwise (i -> i-1)."""
+    return [(i, (i + sign) % n) for i in range(n)]
 
 
-def ring_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+def ring_all_reduce(x: jnp.ndarray, axis_name: str, *,
+                    bidirectional: bool = True) -> jnp.ndarray:
     """Sum ``x`` over ``axis_name`` with an explicit ppermute ring.
 
     Must be called inside ``shard_map``/``pmap``.  Works for any shape; the
-    flat buffer is zero-padded to a multiple of the axis size (the
-    "non-divisible tensor sizes" hard part from SURVEY.md §7).
+    flat buffer is zero-padded to a multiple of ``directions * axis size``
+    (the "non-divisible tensor sizes" hard part from SURVEY.md §7).
+
+    ``bidirectional=True`` (default) splits the buffer into two
+    counter-rotating halves — still 2(N-1) ring steps, but each step moves
+    two independent half-size messages the compiler can overlap (both ICI
+    directions of a TPU torus).  ``False`` is the single-direction
+    textbook schedule, kept for comparison benchmarks.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    shape, dtype = x.shape, x.dtype
+    ndir = 2 if bidirectional else 1
+    flat = x.reshape(-1)
+    pad = (-flat.size) % (ndir * n)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
+    # parts[d] is direction d's (n, chunk) buffer; chunk c = parts[d][c].
+    parts = list(flat.reshape(ndir, n, -1))
+    i = lax.axis_index(axis_name)
+    # Direction d sends to neighbor (i + sign_d); the reduce-scatter /
+    # all-gather index walk mirrors with the sign.
+    signs = (1, -1)[:ndir]
+    perms = [_ring_perm(n, s) for s in signs]
+
+    # Reduce-scatter: after step s, the chunk received from the upstream
+    # neighbor has been partially reduced by s+1 devices.  After N-1 steps
+    # device i owns direction d's fully-reduced chunk (i + sign_d) mod N.
+    # The two directions' ppermutes are interleaved per step and share no
+    # data — XLA is free to issue them concurrently.
+    for s in range(n - 1):
+        for d, (sign, perm) in enumerate(zip(signs, perms)):
+            send_idx = (i - sign * s) % n
+            sent = jnp.take(parts[d], send_idx, axis=0)
+            recv = lax.ppermute(sent, axis_name, perm)
+            recv_idx = (i - sign * (s + 1)) % n
+            parts[d] = parts[d].at[recv_idx].add(recv)
+    owns = [jnp.take(parts[d], (i + sign) % n, axis=0)
+            for d, sign in enumerate(signs)]
+
+    # All-gather: circulate the reduced chunks around each ring.
+    outs = [jnp.zeros_like(parts[d]).at[(i + sign) % n].set(owns[d])
+            for d, sign in enumerate(signs)]
+    curs = list(owns)
+    for s in range(n - 1):
+        for d, (sign, perm) in enumerate(zip(signs, perms)):
+            curs[d] = lax.ppermute(curs[d], axis_name, perm)
+            arrived_idx = (i - sign * s) % n  # upstream owned (i-sign)+sign
+            outs[d] = outs[d].at[arrived_idx].set(curs[d])
+
+    flat_out = jnp.stack(outs).reshape(-1)
+    if pad:
+        flat_out = flat_out[: flat.size - pad]
+    return flat_out.reshape(shape)
+
+
+def hd_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Sum ``x`` over ``axis_name`` by recursive halving + doubling
+    (Rabenseifner): reduce-scatter via log2(N) pairwise exchanges that
+    halve the live payload each step, then all-gather by the mirror
+    doubling walk.
+
+    Same per-device wire bytes as the ring — ``2*(1-1/N)*payload``, the
+    bandwidth-optimal bound — but ``2*log2(N)`` serial steps instead of
+    ``2*(N-1)``: the schedule of choice when per-step latency/dispatch
+    dominates (small payloads, or the simulated CPU mesh where every hop
+    is a full cross-"device" barrier).  The trade: partners are at
+    hypercube distances N/2, N/4, ... — neighbor hops on a hypercube but
+    multi-hop routes on a TPU torus, where the bidirectional ring's
+    neighbor-only traffic is the better fit for large payloads.
+
+    Requires a power-of-two axis size (falls back to the bidirectional
+    ring otherwise).
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        import warnings
+
+        warnings.warn(
+            f"hd_all_reduce needs a power-of-two axis size (got {n}); "
+            "falling back to the bidirectional ring — timings labeled "
+            "'hd' on this mesh measure the ring schedule",
+            stacklevel=2)
+        return ring_all_reduce(x, axis_name)
+    levels = n.bit_length() - 1
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
+    i = lax.axis_index(axis_name)
+
+    # Reduce-scatter, halving: at level k the live buffer (chunks whose
+    # top-k index bits match i's) splits in two; keep the half whose next
+    # bit matches i's, swap the other with the partner at distance
+    # n >> (k+1), and add.  After all levels device i holds chunk i fully
+    # reduced.  Chunk order is the natural binary order, so every half is
+    # contiguous and no gather/scatter indexing is needed.
+    live = flat
+    for k in range(levels):
+        d = n >> (k + 1)
+        perm = [(j, j ^ d) for j in range(n)]
+        halves = live.reshape(2, -1)
+        mybit = (i >> (levels - 1 - k)) & 1
+        keep = jnp.take(halves, mybit, axis=0)
+        send = jnp.take(halves, 1 - mybit, axis=0)
+        recv = lax.ppermute(send, axis_name, perm)
+        live = keep + recv
+
+    # All-gather, doubling: mirror walk; my half sits at position mybit,
+    # the partner's at the other — both[h ^ mybit] is the half with top
+    # bit h.
+    for k in reversed(range(levels)):
+        d = n >> (k + 1)
+        perm = [(j, j ^ d) for j in range(n)]
+        recv = lax.ppermute(live, axis_name, perm)
+        mybit = (i >> (levels - 1 - k)) & 1
+        both = jnp.stack([live, recv])
+        live = jnp.take(both, jnp.array([0, 1]) ^ mybit,
+                        axis=0).reshape(-1)
+
+    if pad:
+        live = live[: flat.size - pad]
+    return live.reshape(shape)
+
+
+def a2a_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Sum ``x`` over ``axis_name`` as reduce-scatter + all-gather, with
+    the reduce-scatter built from ``all_to_all`` + a local sum.
+
+    The third manual schedule: the REDUCTION is still hand-written (each
+    device sums the N chunk-rows it receives), but the byte movement rides
+    two of XLA's primitive collectives instead of 2(N-1) ppermute rounds —
+    per-device wire bytes are the same bandwidth-optimal ``2*(1-1/N)*p``
+    as the ring, in two dispatches.  Where the per-hop path is the
+    bottleneck (the simulated CPU mesh; latency-bound small payloads) this
+    is the fastest manual flavor; the ring keeps the advantage of
+    neighbor-only traffic on a torus.
     """
     n = lax.axis_size(axis_name)
     if n == 1:
@@ -44,33 +193,12 @@ def ring_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     pad = (-flat.size) % n
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
-    chunks = flat.reshape(n, -1)  # chunk c = chunks[c]
-    i = lax.axis_index(axis_name)
-    perm = _ring_perm(n)
-
-    # Reduce-scatter: after step s, the chunk received from the left neighbor
-    # has been partially reduced by s+1 devices.  After N-1 steps device i
-    # owns the fully-reduced chunk (i+1) mod N.
-    acc = chunks
-    for s in range(n - 1):
-        send_idx = (i - s) % n
-        sent = jnp.take(acc, send_idx, axis=0)
-        recv = lax.ppermute(sent, axis_name, perm)
-        recv_idx = (i - s - 1) % n
-        acc = acc.at[recv_idx].add(recv)
-    own_idx = (i + 1) % n
-    own = jnp.take(acc, own_idx, axis=0)
-
-    # All-gather: circulate the reduced chunks around the ring.
-    out = jnp.zeros_like(chunks)
-    out = out.at[own_idx].set(own)
-    cur = own
-    for s in range(n - 1):
-        cur = lax.ppermute(cur, axis_name, perm)
-        arrived_idx = (i - s) % n  # left neighbor owned (i-1)+1 = i, then i-1, ...
-        out = out.at[arrived_idx].set(cur)
-
-    flat_out = out.reshape(-1)
+    chunks = flat.reshape(n, -1)
+    # all_to_all: device i ends up with row j = device j's chunk i.
+    rows = lax.all_to_all(chunks, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    own = jnp.sum(rows, axis=0)  # the manual reduction
+    flat_out = lax.all_gather(own, axis_name, tiled=True)
     if pad:
         flat_out = flat_out[: flat.size - pad]
     return flat_out.reshape(shape)
@@ -136,9 +264,19 @@ def int8_headroom_quantize(flat, axis_name: str):
     return q, unit
 
 
-def ring_all_reduce_mean(tree, axis_name: str):
-    """Mean-reduce a gradient pytree over the ring as ONE flat buffer."""
+def all_reduce_mean_tree(tree, axis_name: str, reduce_fn):
+    """Mean-reduce a gradient pytree as ONE flat buffer through any of the
+    manual sum-collectives above — the single flatten -> reduce -> /N ->
+    unflatten path shared by every manual sync rung."""
     n = lax.axis_size(axis_name)
     flat, unflatten = flatten_tree(tree)
-    mean = ring_all_reduce(flat, axis_name) / n
-    return unflatten(mean, cast=False)
+    return unflatten(reduce_fn(flat, axis_name) / n, cast=False)
+
+
+def ring_all_reduce_mean(tree, axis_name: str, *,
+                         bidirectional: bool = True):
+    """Mean-reduce a gradient pytree over the ring as ONE flat buffer."""
+    def reduce_fn(flat, ax):
+        return ring_all_reduce(flat, ax, bidirectional=bidirectional)
+
+    return all_reduce_mean_tree(tree, axis_name, reduce_fn)

@@ -16,8 +16,14 @@ on every device — the observable contract of every rung of the ladder.
               the mean once), without the rank-0 serialization bottleneck.
   allreduce   Part 2b — built-in collective: psum then divide by world size
               (src/Part 2b/main.py:116-119: all_reduce(SUM); grad /= size).
-  ring        north-star extra — hand-rolled ring all-reduce from ppermute
-              (see tpudp.parallel.ring).
+  ring        north-star extra — hand-rolled ring all-reduce from ppermute,
+              bidirectional by default (see tpudp.parallel.ring);
+              ring_uni selects the single-direction textbook schedule.
+  allreduce_hd / allreduce_a2a  beyond-reference manual flavors —
+              Rabenseifner halving-doubling (2*log2 N pairwise exchanges)
+              and all_to_all+local-sum reduce-scatter (2 dispatches); same
+              bandwidth-optimal wire bytes, different latency profiles
+              (measured head-to-head in BASELINE.md).
   allreduce_bf16  beyond-reference extra — gradients cross the wire as
               bfloat16 (half the collective bytes), restored after the mean.
   allreduce_int8  beyond-reference extra — int8 on the wire via the
@@ -40,7 +46,8 @@ from typing import Callable
 import jax
 from jax import lax
 
-from tpudp.parallel.ring import ring_all_reduce_mean
+from tpudp.parallel.ring import (a2a_all_reduce, all_reduce_mean_tree,
+                                 hd_all_reduce, ring_all_reduce_mean)
 
 SyncFn = Callable[[object, str], object]
 
@@ -68,8 +75,33 @@ def sync_allreduce(grads, axis_name):
 
 
 def sync_ring(grads, axis_name: str):
-    """North-star: hand-rolled ppermute ring all-reduce over one flat buffer."""
+    """North-star: hand-rolled ppermute ring all-reduce over one flat
+    buffer — bidirectional (two counter-rotating halves, both ICI
+    directions of the torus in flight at once)."""
     return ring_all_reduce_mean(grads, axis_name)
+
+
+def sync_ring_uni(grads, axis_name: str):
+    """Single-direction textbook ring — the comparison baseline for the
+    bidirectional default, kept selectable for benchmarks
+    (benchmarks/collective_bench.py)."""
+    return ring_all_reduce_mean(grads, axis_name, bidirectional=False)
+
+
+def sync_allreduce_hd(grads, axis_name):
+    """Manual collective, latency-optimal flavor: recursive
+    halving-doubling (Rabenseifner) — same bandwidth-optimal wire bytes
+    as the ring in 2*log2(N) steps instead of 2*(N-1).  See
+    tpudp.parallel.ring.hd_all_reduce for the schedule trade-offs."""
+    return all_reduce_mean_tree(grads, axis_name, hd_all_reduce)
+
+
+def sync_allreduce_a2a(grads, axis_name):
+    """Manual collective, collective-fusion flavor: reduce-scatter from
+    ``all_to_all`` + local sum, then all-gather — two dispatches moving
+    the same bandwidth-optimal bytes as the ring.  See
+    tpudp.parallel.ring.a2a_all_reduce."""
+    return all_reduce_mean_tree(grads, axis_name, a2a_all_reduce)
 
 
 def sync_allreduce_bf16(grads, axis_name):
@@ -150,6 +182,9 @@ SYNC_STRATEGIES: dict[str, SyncFn] = {
     "allreduce_bf16": sync_allreduce_bf16,
     "allreduce_int8": sync_allreduce_int8,
     "ring": sync_ring,
+    "ring_uni": sync_ring_uni,
+    "allreduce_hd": sync_allreduce_hd,
+    "allreduce_a2a": sync_allreduce_a2a,
     "auto": sync_auto,
 }
 
