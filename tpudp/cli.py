@@ -245,12 +245,15 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
                 multihost_utils.sync_global_devices("tpudp_emergency_restore")
             if not args.eval_only and jax.process_index() == 0:
+                from tpudp.utils.checkpoint import clear_emergency_sentinel
+
                 used = emerg + ".restored"
                 if os.path.isdir(used):
                     import shutil
 
                     shutil.rmtree(used)
                 os.rename(emerg, used)
+                clear_emergency_sentinel(args.checkpoint_dir)
             if not args.eval_only:
                 print(f"[tpudp] resumed mid-epoch state from emergency dump "
                       f"{emerg} (re-running epoch {start_epoch})")
@@ -264,8 +267,19 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                 import threading
 
                 def _save() -> None:
+                    from tpudp.utils.checkpoint import (
+                        clear_emergency_sentinel, write_emergency_sentinel)
+
+                    # Invalidate any previous dump FIRST: if this save is
+                    # abandoned mid-write, a stale sentinel must not make
+                    # the half-written directory look restorable.
+                    clear_emergency_sentinel(args.checkpoint_dir)
                     path = os.path.join(args.checkpoint_dir, "emergency")
                     save_checkpoint(path, trainer.state)
+                    # Commit record: written only after orbax finalized.
+                    write_emergency_sentinel(
+                        args.checkpoint_dir,
+                        step=int(trainer.state.step))
                     print(f"[tpudp] emergency checkpoint saved to {path}",
                           flush=True)
 
