@@ -8,6 +8,8 @@ the missing work as arguments the benches accept:
 
     python tools/bench_gaps.py matrix   -> comma-separated MATRIX_CONFIGS
     python tools/bench_gaps.py flash    -> space-separated t values (argv)
+    python tools/bench_gaps.py epoch    -> "epoch" if the epoch-throughput
+                                           row is still missing
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -88,13 +90,21 @@ def flash_missing(d: str) -> list[int]:
     return [t for t in FLASH_TS if t not in done]
 
 
+def epoch_missing(d: str) -> bool:
+    return not any(
+        r.get("metric") == "vgg11_epoch_images_per_sec" and measured(r)
+        for r in rows_with_history(os.path.join(d, "epoch.json")))
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("stage", choices=["matrix", "flash"])
+    p.add_argument("stage", choices=["matrix", "flash", "epoch"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
         print(",".join(matrix_missing(args.dir)), end="")
+    elif args.stage == "epoch":
+        print("epoch" if epoch_missing(args.dir) else "", end="")
     else:
         print(" ".join(str(t) for t in flash_missing(args.dir)), end="")
 

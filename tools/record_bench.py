@@ -68,6 +68,21 @@ def main() -> None:
         else:
             print(f"| bench.py | FAILED: {head.get('error')} | | |")
 
+    ep = _dedupe((r for r in _rows(os.path.join(args.dir, "epoch.json"))
+                  if r.get("metric")), "metric")
+    ep_row = next(iter(ep.values()), None)
+    if ep_row:
+        if measured(ep_row):
+            gap = ep_row.get("input_pipeline_gap_pct")
+            gap_s = (f", {gap}% below the resident-batch bench"
+                     if gap is not None else "")
+            print(f"| epoch training images/sec (input pipeline in loop) "
+                  f"| **{ep_row['value']:,} images/sec** "
+                  f"(epoch {ep_row.get('epoch_seconds')}s{gap_s}) "
+                  f"| `epoch_bench.py` | |")
+        else:
+            print(f"| epoch_bench.py | FAILED: {ep_row.get('error')} | | |")
+
     matrix = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "matrix.jsonl"))
          if "config" in r and "matrix" not in r), "config")

@@ -58,10 +58,17 @@ flash_ok() {
   local out; out=$(python tools/bench_gaps.py flash) || return 1
   [ -z "$out" ]
 }
+epoch_ok() {
+  local out; out=$(python tools/bench_gaps.py epoch) || return 1
+  [ -z "$out" ]
+}
 # A retried stage truncates its result file; bank the partial rows first so
 # a window that died mid-matrix never erases already-measured configs
 # (gap computation and tools/record_bench.py read the history too).
-bank() { [ -s "$1" ] && cat "$1" >> "${1%.jsonl}.history.jsonl"; }
+bank() {
+  local b="${1%.jsonl}"; b="${b%.json}"
+  [ -s "$1" ] && cat "$1" >> "${b}.history.jsonl"
+}
 
 # Hard deadline (seconds from launch; default 4h): the driver runs its own
 # bench.py at round end, and a second process touching the TPU wedges the
@@ -120,10 +127,18 @@ while true; do
         > bench_results/flash.jsonl 2> bench_results/flash.err
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
     fi
+    if epoch_ok; then
+      log "epoch.json already good; skipping epoch bench"
+    else
+      bank bench_results/epoch.json
+      timeout 1500 python benchmarks/epoch_bench.py \
+        > bench_results/epoch.json 2> bench_results/epoch.err
+      log "epoch_bench rc=$? -> bench_results/epoch.json"
+    fi
     # Exit only when every stage holds a complete result; otherwise keep
     # waiting for the next window (a stage that died on a healthy relay —
     # e.g. per-stage timeout — must not end the watch with gaps).
-    if battery_ok && matrix_ok && flash_ok; then
+    if battery_ok && matrix_ok && flash_ok && epoch_ok; then
       log "battery done"
       exit 0
     fi
