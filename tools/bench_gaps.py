@@ -10,6 +10,8 @@ the missing work as arguments the benches accept:
     python tools/bench_gaps.py flash    -> space-separated t values (argv)
     python tools/bench_gaps.py epoch    -> "epoch" if the epoch-throughput
                                            row is still missing
+    python tools/bench_gaps.py mfu      -> "mfu" if the MFU-attribution
+                                           sweep is still missing
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -71,6 +73,8 @@ def measured(r: dict) -> bool:
         return bool(r.get("flash_ms"))
     if "metric" in r:  # bench.py headline rows
         return r.get("value", 0) > 0
+    if "variant" in r:  # mfu_attribution.py rows
+        return r.get("sec_per_step", 0) > 0
     return False
 
 
@@ -96,15 +100,33 @@ def epoch_missing(d: str) -> bool:
         for r in rows_with_history(os.path.join(d, "epoch.json")))
 
 
+def mfu_missing(d: str) -> bool:
+    """The attribution sweep counts once every ablation variant has a real
+    TPU measurement (a CPU-smoke row must not satisfy the gate).  Gating
+    only on the FIRST emitted row would let a window that died mid-sweep
+    mark the stage complete with the attribution missing.  bf16_params may
+    legitimately fail (the bench emits an error row and continues), so for
+    it an attempt of any outcome suffices."""
+    rows = list(rows_with_history(os.path.join(d, "mfu.jsonl")))
+    have = {r["variant"] for r in rows
+            if r.get("variant") and measured(r)
+            and "TPU" in str(r.get("device_kind", ""))}
+    attempted = {r.get("variant") for r in rows if r.get("variant")}
+    need = {"full", "fwd_bwd", "fwd_only", "no_bn"}
+    return not (need <= have and "bf16_params" in attempted)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("stage", choices=["matrix", "flash", "epoch"])
+    p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
         print(",".join(matrix_missing(args.dir)), end="")
     elif args.stage == "epoch":
         print("epoch" if epoch_missing(args.dir) else "", end="")
+    elif args.stage == "mfu":
+        print("mfu" if mfu_missing(args.dir) else "", end="")
     else:
         print(" ".join(str(t) for t in flash_missing(args.dir)), end="")
 

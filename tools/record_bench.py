@@ -98,6 +98,33 @@ def main() -> None:
             print(f"| {r['config']} | {r['value']:,} {r['unit']} "
                   f"(MFU {r.get('mfu')}{coll_s}) | `matrix_bench.py` | |")
 
+    mfu_rows = _dedupe((r for r in _rows(os.path.join(args.dir,
+                                                      "mfu.jsonl"))
+                        if r.get("variant")), "variant")
+    full = mfu_rows.get("full")
+    if full and measured(full):
+        shares = []
+        for name, key in (("optimizer", "optimizer_share_of_full"),
+                          ("BatchNorm", "bn_share_of_full")):
+            v = next((r.get(key) for r in mfu_rows.values()
+                      if r.get(key) is not None), None)
+            if v is not None:
+                shares.append(f"{name} {v * 100:.1f}%")
+        fwd = mfu_rows.get("fwd_only")
+        if fwd and fwd.get("share_of_full") is not None:
+            shares.append(f"forward {fwd['share_of_full'] * 100:.1f}%")
+        bf = mfu_rows.get("bf16_params")
+        if bf and measured(bf) and bf.get("speedup_vs_full") is not None:
+            shares.append(f"bf16-params {bf['speedup_vs_full']}x")
+        trace = next((r for r in _rows(os.path.join(args.dir, "mfu.jsonl"))
+                      if r.get("kind") == "trace_ops"), None)
+        trace_s = (f"; trace MXU-named share "
+                   f"{trace['mxu_named_share']}" if trace
+                   and trace.get("mxu_named_share") is not None else "")
+        print(f"| MFU attribution (full step {full.get('mfu')}) | "
+              f"{', '.join(shares) or 'shares pending'}{trace_s} | "
+              f"`mfu_attribution.py` | |")
+
     flash = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
          if "t" in r), "t")

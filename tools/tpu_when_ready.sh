@@ -62,6 +62,10 @@ epoch_ok() {
   local out; out=$(python tools/bench_gaps.py epoch) || return 1
   [ -z "$out" ]
 }
+mfu_ok() {
+  local out; out=$(python tools/bench_gaps.py mfu) || return 1
+  [ -z "$out" ]
+}
 # A retried stage truncates its result file; bank the partial rows first so
 # a window that died mid-matrix never erases already-measured configs
 # (gap computation and tools/record_bench.py read the history too).
@@ -135,10 +139,18 @@ while true; do
         > bench_results/epoch.json 2> bench_results/epoch.err
       log "epoch_bench rc=$? -> bench_results/epoch.json"
     fi
+    if mfu_ok; then
+      log "mfu.jsonl already good; skipping mfu attribution"
+    else
+      bank bench_results/mfu.jsonl
+      timeout 1500 python benchmarks/mfu_attribution.py \
+        > bench_results/mfu.jsonl 2> bench_results/mfu.err
+      log "mfu_attribution rc=$? -> bench_results/mfu.jsonl"
+    fi
     # Exit only when every stage holds a complete result; otherwise keep
     # waiting for the next window (a stage that died on a healthy relay —
     # e.g. per-stage timeout — must not end the watch with gaps).
-    if battery_ok && matrix_ok && flash_ok && epoch_ok; then
+    if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok; then
       log "battery done"
       exit 0
     fi
