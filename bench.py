@@ -299,15 +299,18 @@ def main() -> None:
     tries = int(os.environ.get("BENCH_TRIES", 2))
     timeout = float(os.environ.get("BENCH_TIMEOUT", 300))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
-    banked = (None if os.environ.get("BENCH_STRICT") == "1"
+    # Single-sourced smoke-mode flag: smoke runs have no relay to probe
+    # and are symmetric about evidence — they neither bank their own lines
+    # nor consume banked TPU ones (a smoke run re-emitting a real TPU
+    # number as its headline would be confusing and wrong).
+    smoke = bool(os.environ.get("BENCH_PLATFORM"))
+    banked = (None if smoke or os.environ.get("BENCH_STRICT") == "1"
               else _banked_good())
 
     # Fast pre-probe: a wedged relay short-circuits to the banked line in
     # under 2 minutes instead of burning the full attempt budget (round-2
     # postmortem: the driver's timeout fired while attempts were sleeping).
-    # Skipped in CPU smoke mode (BENCH_PLATFORM), where there is no relay.
-    if (not os.environ.get("BENCH_PLATFORM")
-            and os.environ.get("BENCH_PROBE", "1") != "0"
+    if (not smoke and os.environ.get("BENCH_PROBE", "1") != "0"
             and not _probe_ok(probe_timeout)):
         if banked is not None:
             _emit_banked(banked, f"TPU probe failed or hung past "
@@ -355,7 +358,7 @@ def main() -> None:
             except json.JSONDecodeError:
                 pass
             # CPU smoke-mode lines are not evidence — never bank them.
-            if not os.environ.get("BENCH_PLATFORM"):
+            if not smoke:
                 _bank(line)
             print(line)
             return
