@@ -378,8 +378,10 @@ def main() -> None:
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
         "error": f"all {tries} attempts failed and no banked measurement "
-                 "exists (a banked one would have been re-emitted as "
-                 "source=last_known_good)",
+                 + ("was consulted (smoke mode never consumes banked "
+                    "evidence)" if smoke else
+                    "exists (a banked one would have been re-emitted as "
+                    "source=last_known_good)"),
         "attempt_errors": [e[:500] for e in errors],
     }))
     sys.exit(0)

@@ -75,9 +75,14 @@ def main() -> None:
     p.add_argument("--train-size", type=int, default=2048,
                    help="synthetic train-set size")
     p.add_argument("--lr", type=float, default=0.1)
-    p.add_argument("--sync", choices=["allreduce", "allreduce_bf16",
-                                  "allreduce_int8", "ring",
-                                  "coordinator"],
+    # choices derived from the ladder so new rungs (ring_uni, hd, a2a, ...)
+    # are selectable without touching every example; 'none' is excluded —
+    # in a multi-device DP example it would silently train divergent
+    # replicas.
+    from tpudp.parallel.sync import SYNC_STRATEGIES
+
+    p.add_argument("--sync",
+                   choices=sorted(set(SYNC_STRATEGIES) - {"none"}),
                    default="allreduce")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")

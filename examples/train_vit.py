@@ -44,9 +44,12 @@ def main() -> None:
                    help="synthetic train-set size")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--optimizer", choices=["adamw", "sgd"], default="adamw")
-    p.add_argument("--sync", choices=["allreduce", "allreduce_bf16",
-                                  "allreduce_int8", "ring",
-                                      "coordinator"], default="allreduce")
+    # ladder-derived choices; 'none' excluded (divergent replicas under DP)
+    from tpudp.parallel.sync import SYNC_STRATEGIES
+
+    p.add_argument("--sync",
+                   choices=sorted(set(SYNC_STRATEGIES) - {"none"}),
+                   default="allreduce")
     p.add_argument("--attn", choices=["dense", "flash"], default="dense")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
