@@ -75,14 +75,11 @@ def main() -> None:
     p.add_argument("--train-size", type=int, default=2048,
                    help="synthetic train-set size")
     p.add_argument("--lr", type=float, default=0.1)
-    # choices derived from the ladder so new rungs (ring_uni, hd, a2a, ...)
-    # are selectable without touching every example; 'none' is excluded —
-    # in a multi-device DP example it would silently train divergent
-    # replicas.
-    from tpudp.parallel.sync import SYNC_STRATEGIES
+    # ladder-derived so new rungs are selectable without touching every
+    # example (see EXAMPLE_SYNC_CHOICES for the 'none' exclusion rationale)
+    from tpudp.parallel.sync import EXAMPLE_SYNC_CHOICES
 
-    p.add_argument("--sync",
-                   choices=sorted(set(SYNC_STRATEGIES) - {"none"}),
+    p.add_argument("--sync", choices=EXAMPLE_SYNC_CHOICES,
                    default="allreduce")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")

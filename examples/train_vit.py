@@ -44,11 +44,10 @@ def main() -> None:
                    help="synthetic train-set size")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--optimizer", choices=["adamw", "sgd"], default="adamw")
-    # ladder-derived choices; 'none' excluded (divergent replicas under DP)
-    from tpudp.parallel.sync import SYNC_STRATEGIES
+    # ladder-derived choices (see EXAMPLE_SYNC_CHOICES for rationale)
+    from tpudp.parallel.sync import EXAMPLE_SYNC_CHOICES
 
-    p.add_argument("--sync",
-                   choices=sorted(set(SYNC_STRATEGIES) - {"none"}),
+    p.add_argument("--sync", choices=EXAMPLE_SYNC_CHOICES,
                    default="allreduce")
     p.add_argument("--attn", choices=["dense", "flash"], default="dense")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],

@@ -189,6 +189,12 @@ SYNC_STRATEGIES: dict[str, SyncFn] = {
 }
 
 
+# What the example CLIs offer as --sync choices: the full ladder minus
+# 'none', which under multi-device DP silently trains divergent replicas.
+# One definition so every example stays in lockstep.
+EXAMPLE_SYNC_CHOICES = tuple(sorted(set(SYNC_STRATEGIES) - {"none"}))
+
+
 def get_sync(name: str) -> SyncFn:
     try:
         return SYNC_STRATEGIES[name]
