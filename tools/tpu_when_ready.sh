@@ -13,9 +13,13 @@ PROBE_TIMEOUT="${PROBE_TIMEOUT:-90}"
 log() { echo "[$(date +%H:%M:%S)] $*" >> bench_results/watch.log; }
 
 # The single probe shared with bench.py (tools/tpu_probe.py) so the
-# watcher and the bench can never disagree about "healthy".
+# watcher and the bench can never disagree about "healthy".  Like every
+# stage, capped by the remaining deadline window and SIGKILLed if SIGTERM
+# is ignored (a wedged device call in a C extension won't die politely).
 probe() {
-  timeout "$PROBE_TIMEOUT" python tools/tpu_probe.py >/dev/null 2>&1
+  ensure_window
+  timeout -k 30 "$(stage_t "$PROBE_TIMEOUT")" \
+    python tools/tpu_probe.py >/dev/null 2>&1
 }
 
 # The battery "succeeded" only if bench.py produced a FRESH real
@@ -81,6 +85,31 @@ DEADLINE_S="${DEADLINE_S:-14400}"
 START_TS=$(date +%s)
 START_ISO=$(date -u +%Y-%m-%dT%H:%M:%SZ)
 
+# Seconds left before the deadline (never negative).  Every stage's
+# timeout is capped by this, so NO stage can still be touching the TPU
+# after the deadline — the driver's own end-of-round bench.py must never
+# find a second process on the relay (two clients wedge it).
+remaining() {
+  local r=$(( DEADLINE_S - ($(date +%s) - START_TS) ))
+  [ "$r" -gt 0 ] && echo "$r" || echo 0
+}
+# Cap a stage budget by the remaining window: stage_t <cap>.  Never 0 —
+# GNU `timeout 0` means NO timeout, the exact opposite of the intent.
+stage_t() {
+  local r; r=$(remaining)
+  [ "$r" -lt 1 ] && r=1
+  [ "$r" -lt "$1" ] && echo "$r" || echo "$1"
+}
+# Hard gate before anything touches the TPU: an expired window must stand
+# down, not launch a 1s-capped stage (five of those would still overlap
+# the driver's end-of-round bench).
+ensure_window() {
+  if [ "$(remaining)" -le 0 ]; then
+    log "deadline reached mid-battery; standing down"
+    exit 1
+  fi
+}
+
 log "watcher started (period=${PERIOD}s, deadline=${DEADLINE_S}s)"
 while true; do
   if [ $(( $(date +%s) - START_TS )) -ge "$DEADLINE_S" ]; then
@@ -95,8 +124,9 @@ while true; do
       # BENCH_STRICT: under the watcher only a FRESH measurement counts —
       # a banked re-emission would satisfy battery_ok and mask the gap.
       # BENCH_PROBE=0: the watcher just probed.
+      ensure_window
       BENCH_STRICT=1 BENCH_PROBE=0 BENCH_TRIES=2 BENCH_TIMEOUT=600 \
-        timeout 1500 python bench.py \
+        timeout -k 30 "$(stage_t 1500)" python bench.py \
         > bench_results/bench.json 2> bench_results/bench.err
       log "bench.py rc=$? -> bench_results/bench.json"
       if ! battery_ok; then
@@ -111,8 +141,10 @@ while true; do
       # Per-stage timeout well under the relay's typical healthy window;
       # crash isolation inside the bench keeps partial rows on a wedge.
       bank bench_results/matrix.jsonl
+      ensure_window
       MATRIX_CONFIGS="$(python tools/bench_gaps.py matrix)" \
-        MATRIX_STEPS=30 timeout 2400 python benchmarks/matrix_bench.py \
+        MATRIX_STEPS=30 timeout -k 30 "$(stage_t 2400)" \
+        python benchmarks/matrix_bench.py \
         > bench_results/matrix.jsonl 2> bench_results/matrix.err
       log "matrix_bench rc=$? -> bench_results/matrix.jsonl"
       if ! matrix_ok && ! probe; then
@@ -126,7 +158,8 @@ while true; do
     else
       bank bench_results/flash.jsonl
       # shellcheck disable=SC2046 — word-split the missing t values
-      timeout 2400 python benchmarks/flash_attention_bench.py \
+      ensure_window
+      timeout -k 30 "$(stage_t 2400)" python benchmarks/flash_attention_bench.py \
         $(python tools/bench_gaps.py flash) \
         > bench_results/flash.jsonl 2> bench_results/flash.err
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
@@ -135,7 +168,8 @@ while true; do
       log "epoch.json already good; skipping epoch bench"
     else
       bank bench_results/epoch.json
-      timeout 1500 python benchmarks/epoch_bench.py \
+      ensure_window
+      timeout -k 30 "$(stage_t 1500)" python benchmarks/epoch_bench.py \
         > bench_results/epoch.json 2> bench_results/epoch.err
       log "epoch_bench rc=$? -> bench_results/epoch.json"
     fi
@@ -143,7 +177,8 @@ while true; do
       log "mfu.jsonl already good; skipping mfu attribution"
     else
       bank bench_results/mfu.jsonl
-      timeout 1500 python benchmarks/mfu_attribution.py \
+      ensure_window
+      timeout -k 30 "$(stage_t 1500)" python benchmarks/mfu_attribution.py \
         > bench_results/mfu.jsonl 2> bench_results/mfu.err
       log "mfu_attribution rc=$? -> bench_results/mfu.jsonl"
     fi
