@@ -18,7 +18,7 @@ log() { echo "[$(date +%H:%M:%S)] $*" >> bench_results/watch.log; }
 # is ignored (a wedged device call in a C extension won't die politely).
 probe() {
   ensure_window
-  timeout -k 30 "$(stage_t "$PROBE_TIMEOUT")" \
+  timeout -k "$GRACE" "$(stage_t "$PROBE_TIMEOUT")" \
     python tools/tpu_probe.py >/dev/null 2>&1
 }
 
@@ -93,18 +93,24 @@ remaining() {
   local r=$(( DEADLINE_S - ($(date +%s) - START_TS) ))
   [ "$r" -gt 0 ] && echo "$r" || echo 0
 }
-# Cap a stage budget by the remaining window: stage_t <cap>.  Never 0 —
-# GNU `timeout 0` means NO timeout, the exact opposite of the intent.
+# SIGKILL grace budgeted INTO the deadline: timeout's SIGTERM must land
+# at least GRACE before DEADLINE_S so that even a SIGTERM-ignoring wedged
+# process is SIGKILLed before the deadline, not 30s after it.
+GRACE=30
+# Cap a stage budget by the remaining window minus the kill grace:
+# stage_t <cap>.  Never 0 — GNU `timeout 0` means NO timeout, the exact
+# opposite of the intent.
 stage_t() {
-  local r; r=$(remaining)
+  local r; r=$(( $(remaining) - GRACE ))
   [ "$r" -lt 1 ] && r=1
   [ "$r" -lt "$1" ] && echo "$r" || echo "$1"
 }
-# Hard gate before anything touches the TPU: an expired window must stand
-# down, not launch a 1s-capped stage (five of those would still overlap
-# the driver's end-of-round bench).
+# Hard gate before anything touches the TPU: an expired (or nearly
+# expired — less than the kill grace left) window must stand down, not
+# launch a 1s-capped stage (five of those would still overlap the
+# driver's end-of-round bench).
 ensure_window() {
-  if [ "$(remaining)" -le 0 ]; then
+  if [ "$(remaining)" -le "$GRACE" ]; then
     log "deadline reached mid-battery; standing down"
     exit 1
   fi
@@ -112,7 +118,7 @@ ensure_window() {
 
 log "watcher started (period=${PERIOD}s, deadline=${DEADLINE_S}s)"
 while true; do
-  if [ $(( $(date +%s) - START_TS )) -ge "$DEADLINE_S" ]; then
+  if [ "$(remaining)" -le 0 ]; then
     log "deadline reached with battery incomplete; standing down"
     exit 1
   fi
@@ -126,7 +132,7 @@ while true; do
       # BENCH_PROBE=0: the watcher just probed.
       ensure_window
       BENCH_STRICT=1 BENCH_PROBE=0 BENCH_TRIES=2 BENCH_TIMEOUT=600 \
-        timeout -k 30 "$(stage_t 1500)" python bench.py \
+        timeout -k "$GRACE" "$(stage_t 1500)" python bench.py \
         > bench_results/bench.json 2> bench_results/bench.err
       log "bench.py rc=$? -> bench_results/bench.json"
       if ! battery_ok; then
@@ -143,7 +149,7 @@ while true; do
       bank bench_results/matrix.jsonl
       ensure_window
       MATRIX_CONFIGS="$(python tools/bench_gaps.py matrix)" \
-        MATRIX_STEPS=30 timeout -k 30 "$(stage_t 2400)" \
+        MATRIX_STEPS=30 timeout -k "$GRACE" "$(stage_t 2400)" \
         python benchmarks/matrix_bench.py \
         > bench_results/matrix.jsonl 2> bench_results/matrix.err
       log "matrix_bench rc=$? -> bench_results/matrix.jsonl"
@@ -157,9 +163,9 @@ while true; do
       log "flash.jsonl already good; skipping flash bench"
     else
       bank bench_results/flash.jsonl
-      # shellcheck disable=SC2046 — word-split the missing t values
       ensure_window
-      timeout -k 30 "$(stage_t 2400)" python benchmarks/flash_attention_bench.py \
+      # shellcheck disable=SC2046 — word-split the missing t values
+      timeout -k "$GRACE" "$(stage_t 2400)" python benchmarks/flash_attention_bench.py \
         $(python tools/bench_gaps.py flash) \
         > bench_results/flash.jsonl 2> bench_results/flash.err
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
@@ -169,7 +175,7 @@ while true; do
     else
       bank bench_results/epoch.json
       ensure_window
-      timeout -k 30 "$(stage_t 1500)" python benchmarks/epoch_bench.py \
+      timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/epoch_bench.py \
         > bench_results/epoch.json 2> bench_results/epoch.err
       log "epoch_bench rc=$? -> bench_results/epoch.json"
     fi
@@ -178,7 +184,7 @@ while true; do
     else
       bank bench_results/mfu.jsonl
       ensure_window
-      timeout -k 30 "$(stage_t 1500)" python benchmarks/mfu_attribution.py \
+      timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/mfu_attribution.py \
         > bench_results/mfu.jsonl 2> bench_results/mfu.err
       log "mfu_attribution rc=$? -> bench_results/mfu.jsonl"
     fi
