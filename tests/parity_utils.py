@@ -1,0 +1,35 @@
+"""Shared torch->flax weight-transplant helpers for the parity tests.
+
+One copy of the layout mapping (conv OIHW->HWIO, linear (out,in)->(in,out))
+and of the zero-copy protection: on CPU ``jnp.asarray(t.numpy())`` can
+alias torch's weight storage, and torch's in-place SGD updates would then
+silently rewrite the "initial" flax params — every tensor is COPIED.
+"""
+
+import jax.numpy as jnp
+
+
+def grab(t, perm=None):
+    a = t.detach().numpy()
+    return jnp.array(a.transpose(perm) if perm else a, copy=True)
+
+
+def conv_params(c):
+    """torch Conv2d (O,I,H,W) -> flax Conv {kernel: (H,W,I,O)[, bias]}."""
+    p = {"kernel": grab(c.weight, (2, 3, 1, 0))}
+    if c.bias is not None:
+        p["bias"] = grab(c.bias)
+    return p
+
+
+def linear_params(m):
+    """torch Linear (out,in) -> flax Dense {kernel: (in,out), bias}."""
+    return {"kernel": grab(m.weight, (1, 0)), "bias": grab(m.bias)}
+
+
+def bn_params(b):
+    return {"scale": grab(b.weight), "bias": grab(b.bias)}
+
+
+def bn_stats(b):
+    return {"mean": grab(b.running_mean), "var": grab(b.running_var)}

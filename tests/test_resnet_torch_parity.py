@@ -1,0 +1,171 @@
+"""ResNet numerical parity vs a reference-style torch stack.
+
+tests/test_torch_parity.py pins the VGG family to torch; this does the
+same for the ResNet family (`tpudp/models/resnet.py`, BASELINE.json
+configs[3]): build the IDENTICAL bottleneck architecture in torch
+(torchvision conventions: v1.5 stride placement on the 3x3, 1x1-conv+BN
+downsample, zero-init last BN scale — matching our flax module's
+deliberate choices), transplant the torch weights, and assert forward
+logits + a short SGD training trajectory agree.
+
+A small config (stage_sizes=(1,1), width 16, 32x32 inputs) keeps the
+1-core CPU runtime sane while exercising every distinct code path of the
+family: stem conv+BN+maxpool, identity blocks, projection blocks with
+stride, global average pool, classifier.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tpudp.models.resnet import ResNet  # noqa: E402
+from tpudp.train import init_state, make_optimizer, make_train_step  # noqa: E402
+
+STAGES, WIDTH, CLASSES = (1, 1), 16, 10
+BATCH, STEPS, LR, MOM, WD = 8, 3, 0.01, 0.9, 1e-4
+
+
+class TorchBottleneck(torch.nn.Module):
+    def __init__(self, cin, features, stride):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(cin, features, 1, bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(features)
+        self.conv2 = torch.nn.Conv2d(features, features, 3, stride=stride,
+                                     padding=1, bias=False)
+        self.bn2 = torch.nn.BatchNorm2d(features)
+        self.conv3 = torch.nn.Conv2d(features, 4 * features, 1, bias=False)
+        self.bn3 = torch.nn.BatchNorm2d(features * 4)
+        # zero-init residual (matches the flax module's scale_init=zeros)
+        torch.nn.init.zeros_(self.bn3.weight)
+        self.down = None
+        if stride != 1 or cin != 4 * features:
+            self.down = torch.nn.Sequential(
+                torch.nn.Conv2d(cin, 4 * features, 1, stride=stride,
+                                bias=False),
+                torch.nn.BatchNorm2d(4 * features))
+
+    def forward(self, x):
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = torch.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        r = x if self.down is None else self.down(x)
+        return torch.relu(r + y)
+
+
+class TorchResNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.stem = torch.nn.Conv2d(3, WIDTH, 7, stride=2, padding=3,
+                                    bias=False)
+        self.stem_bn = torch.nn.BatchNorm2d(WIDTH)
+        self.pool = torch.nn.MaxPool2d(3, stride=2, padding=1)
+        blocks, cin = [], WIDTH
+        for stage, num in enumerate(STAGES):
+            for block in range(num):
+                stride = 2 if stage > 0 and block == 0 else 1
+                feats = WIDTH * (2 ** stage)
+                blocks.append(TorchBottleneck(cin, feats, stride))
+                cin = feats * 4
+        self.blocks = torch.nn.ModuleList(blocks)
+        self.fc = torch.nn.Linear(cin, CLASSES)
+
+    def forward(self, x):
+        x = self.pool(torch.relu(self.stem_bn(self.stem(x))))
+        for b in self.blocks:
+            x = b(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def transplant(tmodel, params, batch_stats):
+    from parity_utils import bn_params, bn_stats, conv_params, linear_params
+
+    params = dict(params)
+    bs = dict(batch_stats)
+    params["stem_conv"] = conv_params(tmodel.stem)
+    params["stem_bn"] = bn_params(tmodel.stem_bn)
+    bs["stem_bn"] = bn_stats(tmodel.stem_bn)
+    for i, tb in enumerate(tmodel.blocks):
+        name = f"BottleneckBlock_{i}"
+        p = {"Conv_0": conv_params(tb.conv1),
+             "BatchNorm_0": bn_params(tb.bn1),
+             "Conv_1": conv_params(tb.conv2),
+             "BatchNorm_1": bn_params(tb.bn2),
+             "Conv_2": conv_params(tb.conv3),
+             "BatchNorm_2": bn_params(tb.bn3)}
+        s = {"BatchNorm_0": bn_stats(tb.bn1),
+             "BatchNorm_1": bn_stats(tb.bn2),
+             "BatchNorm_2": bn_stats(tb.bn3)}
+        if tb.down is not None:
+            p["proj_conv"] = conv_params(tb.down[0])
+            p["proj_bn"] = bn_params(tb.down[1])
+            s["proj_bn"] = bn_stats(tb.down[1])
+        # Both trees must cover the flax structure exactly — a flax-side
+        # rename would otherwise leave stale params/running-stats behind.
+        assert set(p) == set(params[name]), (
+            f"{name}: transplant keys {sorted(p)} != "
+            f"flax keys {sorted(params[name])}")
+        assert set(s) == set(batch_stats[name]), (
+            f"{name}: transplant stat keys {sorted(s)} != "
+            f"flax stat keys {sorted(batch_stats[name])}")
+        params[name], bs[name] = p, s
+    params["Dense_0"] = linear_params(tmodel.fc)
+    return params, bs
+
+
+@pytest.fixture
+def paired():
+    torch.manual_seed(0)
+    torch.set_num_threads(1)
+    tmodel = TorchResNet()
+    model = ResNet(stage_sizes=STAGES, width=WIDTH, num_classes=CLASSES)
+    tx = make_optimizer(LR, MOM, WD)
+    state = init_state(model, tx, input_shape=(1, 32, 32, 3))
+    params, bs = transplant(tmodel, state.params, state.batch_stats)
+    return tmodel, model, tx, state.replace(params=params, batch_stats=bs)
+
+
+def test_resnet_forward_parity(paired):
+    tmodel, model, _, state = paired
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(BATCH, 32, 32, 3)).astype(np.float32)
+    tmodel.eval()
+    with torch.no_grad():
+        t_logits = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    j_logits = np.asarray(model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(x), train=False))
+    np.testing.assert_allclose(j_logits, t_logits, rtol=1e-3, atol=1e-3)
+
+
+def test_resnet_training_trajectory_parity(paired):
+    tmodel, model, tx, state = paired
+    rng = np.random.default_rng(1)
+    xs = rng.normal(size=(STEPS, BATCH, 32, 32, 3)).astype(np.float32)
+    ys = rng.integers(0, CLASSES, size=(STEPS, BATCH))
+
+    tmodel.train()
+    opt = torch.optim.SGD(tmodel.parameters(), lr=LR, momentum=MOM,
+                          weight_decay=WD)
+    crit = torch.nn.CrossEntropyLoss()
+    t_losses = []
+    for x, y in zip(xs, ys):
+        opt.zero_grad()
+        loss = crit(tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))),
+                    torch.from_numpy(y))
+        loss.backward()
+        opt.step()
+        t_losses.append(float(loss.detach()))
+
+    step = make_train_step(model, tx, None, "none", spmd_mode="single",
+                           donate=False)
+    j_losses = []
+    for x, y in zip(xs, ys):
+        state, loss = step(state, jnp.asarray(x),
+                           jnp.asarray(y, dtype=jnp.int32))
+        j_losses.append(float(loss))
+
+    np.testing.assert_allclose(j_losses, t_losses, rtol=5e-3, atol=5e-3)

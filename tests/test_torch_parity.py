@@ -65,13 +65,11 @@ def transplant(tmodel, params, batch_stats):
     CHW flatten order equals our HWC order and the classifier needs no
     permutation.
 
-    Every tensor is COPIED: on CPU ``jnp.asarray(t.numpy())`` can be
-    zero-copy, aliasing torch's weight storage — the in-place torch SGD
-    updates would then silently rewrite the "initial" flax params."""
-
-    def grab(t, perm=None):
-        a = t.detach().numpy()
-        return jnp.array(a.transpose(perm) if perm else a, copy=True)
+    Every tensor is COPIED via the shared parity helper (parity_utils):
+    on CPU ``jnp.asarray(t.numpy())`` can be zero-copy, aliasing torch's
+    weight storage — the in-place torch SGD updates would then silently
+    rewrite the "initial" flax params."""
+    from parity_utils import grab
 
     params = dict(params)
     bs = {k: dict(v) for k, v in batch_stats.items()}

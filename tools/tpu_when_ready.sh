@@ -118,7 +118,9 @@ ensure_window() {
 
 log "watcher started (period=${PERIOD}s, deadline=${DEADLINE_S}s)"
 while true; do
-  if [ "$(remaining)" -le 0 ]; then
+  # Same GRACE threshold as ensure_window, so a near-deadline wakeup
+  # stands down HERE (truthful log) instead of inside probe()'s gate.
+  if [ "$(remaining)" -le "$GRACE" ]; then
     log "deadline reached with battery incomplete; standing down"
     exit 1
   fi
