@@ -5,8 +5,9 @@ set reports no gaps — the properties the TPU-window accumulation depends on.""
 import json
 import os
 
-from tools.bench_gaps import (FLASH_TS, MATRIX_CONFIGS, flash_missing,
-                              matrix_missing)
+from tools.bench_gaps import (FLASH_TS, MATRIX_CONFIGS, epoch_missing,
+                              flash_missing, history_path, matrix_missing,
+                              mfu_missing)
 
 
 def _write(path, rows):
@@ -45,3 +46,44 @@ def test_flash_gaps(tmp_path):
         {"flash_done": [4096, 8192, 16384]},
     ])
     assert flash_missing(d) == [8192, 16384]
+
+
+def test_history_path_maps_json_too():
+    """bench.json is banked by bench.py itself (round-2 advisor finding:
+    the watcher's > redirect truncates before the process starts)."""
+    assert history_path("x/bench.json") == "x/bench.history.jsonl"
+    assert history_path("x/matrix.jsonl") == "x/matrix.history.jsonl"
+    assert history_path("x/other.txt") == "x/other.txt"
+
+
+def test_epoch_gap(tmp_path):
+    d = str(tmp_path)
+    assert epoch_missing(d)
+    _write(os.path.join(d, "epoch.json"), [
+        {"metric": "vgg11_epoch_images_per_sec", "value": 0.0,
+         "error": "trainer hung"}])
+    assert epoch_missing(d)  # error row must be retried
+    _write(os.path.join(d, "epoch.history.jsonl"), [
+        {"metric": "vgg11_epoch_images_per_sec", "value": 88000.0}])
+    assert not epoch_missing(d)  # banked history row counts
+
+
+def test_mfu_gap_requires_all_variants_on_tpu(tmp_path):
+    """A window dying after the FIRST row must not mark the sweep done;
+    CPU-smoke rows never satisfy the gate; bf16_params counts attempted
+    even as an error row (the bench tolerates its failure)."""
+    d = str(tmp_path)
+    assert mfu_missing(d)
+    rows = [{"variant": v, "sec_per_step": 0.003,
+             "device_kind": "TPU v5 lite"}
+            for v in ("full", "fwd_bwd", "fwd_only")]
+    _write(os.path.join(d, "mfu.jsonl"), rows)
+    assert mfu_missing(d)  # no_bn + bf16_params still missing
+    rows.append({"variant": "no_bn", "sec_per_step": 0.003,
+                 "device_kind": "cpu"})  # smoke row: must not count
+    _write(os.path.join(d, "mfu.jsonl"), rows)
+    assert mfu_missing(d)
+    rows[-1]["device_kind"] = "TPU v5 lite"
+    rows.append({"variant": "bf16_params", "error": "donation clash"})
+    _write(os.path.join(d, "mfu.jsonl"), rows)
+    assert not mfu_missing(d)  # all measured + bf16 attempted
