@@ -84,6 +84,11 @@ def test_mfu_gap_requires_all_variants_on_tpu(tmp_path):
     _write(os.path.join(d, "mfu.jsonl"), rows)
     assert mfu_missing(d)
     rows[-1]["device_kind"] = "TPU v5 lite"
+    # a CPU-smoke bf16_params row must not count as the attempt either
+    rows.append({"variant": "bf16_params", "sec_per_step": 0.1,
+                 "device_kind": "cpu"})
+    _write(os.path.join(d, "mfu.jsonl"), rows)
+    assert mfu_missing(d)
     rows.append({"variant": "bf16_params", "error": "donation clash"})
     _write(os.path.join(d, "mfu.jsonl"), rows)
-    assert not mfu_missing(d)  # all measured + bf16 attempted
+    assert not mfu_missing(d)  # all measured + bf16 attempted (error row)

@@ -88,6 +88,55 @@ def test_logits_parity(paired):
     np.testing.assert_allclose(j_logits, t_logits, rtol=1e-4, atol=1e-4)
 
 
+def test_training_trajectory_parity():
+    """Per-step SGD training losses match the canonical stack (fresh
+    models — the module-scoped fixture must not be trained in place).
+    Both sides see identical (x, y) = (tokens[:, :-1], tokens[:, 1:]) so
+    the losses are the same shifted-CE objective; reference hyper-param
+    ORDERING (decay folded before momentum) is pinned by make_optimizer
+    and verified here against torch SGD on a second model family."""
+    from tpudp.train import make_train_step
+
+    LR, MOM, WD, STEPS = 0.01, 0.9, 1e-4, 4
+    hf = _hf_model()
+    hf.train()
+    model = gpt2_small(**TINY)
+    tx = make_optimizer(LR, MOM, WD)
+    state = init_state(model, tx, input_shape=(1, 8))
+    state = state.replace(params=_transplant(hf, state.params))
+
+    rng = np.random.default_rng(2)
+    toks = rng.integers(0, TINY["vocab_size"], size=(STEPS, 4, 17))
+    xs, ys = toks[:, :, :-1], toks[:, :, 1:]
+
+    opt = torch.optim.SGD(hf.parameters(), lr=LR, momentum=MOM,
+                          weight_decay=WD)
+    t_losses = []
+    for x, y in zip(xs, ys):
+        opt.zero_grad()
+        logits = hf(torch.from_numpy(x)).logits
+        loss = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, TINY["vocab_size"]),
+            torch.from_numpy(y).reshape(-1))
+        loss.backward()
+        opt.step()
+        t_losses.append(float(loss.detach()))
+
+    step = make_train_step(model, tx, None, "none", spmd_mode="single",
+                           donate=False)
+    j_losses = []
+    for x, y in zip(xs, ys):
+        state, loss = step(state, jnp.asarray(x, jnp.int32),
+                           jnp.asarray(y, jnp.int32))
+        j_losses.append(float(loss))
+
+    np.testing.assert_allclose(j_losses, t_losses, rtol=2e-3, atol=2e-3)
+    # weights agree after training too (embedding table = tied head)
+    t_wte = hf.transformer.wte.weight.detach().numpy()
+    np.testing.assert_allclose(np.asarray(state.params["wte"]["embedding"]),
+                               t_wte, rtol=2e-3, atol=2e-3)
+
+
 def test_loss_and_decode_parity(paired):
     """Mean CE over shifted targets matches torch's, and the KV-cached
     decode path produces the same last-position logits as HF's forward

@@ -111,7 +111,14 @@ def mfu_missing(d: str) -> bool:
     have = {r["variant"] for r in rows
             if r.get("variant") and measured(r)
             and "TPU" in str(r.get("device_kind", ""))}
-    attempted = {r.get("variant") for r in rows if r.get("variant")}
+    # "Attempted" also excludes smoke rows: a measured row carrying a
+    # non-TPU device_kind must not satisfy the gate; error rows carry no
+    # device_kind (the watcher only ever runs this stage on the TPU) and
+    # count as attempts.
+    attempted = {r["variant"] for r in rows
+                 if r.get("variant")
+                 and ("device_kind" not in r
+                      or "TPU" in str(r.get("device_kind", "")))}
     need = {"full", "fwd_bwd", "fwd_only", "no_bn"}
     return not (need <= have and "bf16_params" in attempted)
 
