@@ -9,6 +9,7 @@ host-local shard through ShardedSampler+DataLoader, and drives the Trainer
 eval metrics, and parameters to a JSON file for trajectory comparison.
 
 Usage: python multihost_worker.py RANK NPROC PORT LOCAL_DEVICES OUT_JSON
+       [SYNC]
 """
 
 import json
@@ -22,6 +23,7 @@ def main() -> None:
     port = int(sys.argv[3])
     local_devices = int(sys.argv[4])
     out_path = sys.argv[5]
+    sync = sys.argv[6] if len(sys.argv) > 6 else "allreduce"
 
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={local_devices}")
@@ -65,7 +67,7 @@ def main() -> None:
         sampler=ShardedSampler(len(ds.images), nproc, rank, shuffle=False),
         train=False, backend="numpy")
 
-    trainer = Trainer(TinyNet(), mesh, "allreduce", learning_rate=0.01,
+    trainer = Trainer(TinyNet(), mesh, sync, learning_rate=0.01,
                       log_every=2, log_fn=lambda s: None, seed=0)
     loss = trainer.train_epoch(loader, 0)
     eval_loss, eval_acc = trainer.evaluate(loader)

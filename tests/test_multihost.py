@@ -32,12 +32,13 @@ def _clean_env() -> dict:
     return env
 
 
-def _run_workers(nproc: int, local_devices: int, out: str):
+def _run_workers(nproc: int, local_devices: int, out: str,
+                 sync: str = "allreduce"):
     port = _free_port()
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(rank), str(nproc), str(port),
-             str(local_devices), out],
+             str(local_devices), out, sync],
             env=_clean_env(), stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         for rank in range(nproc)
@@ -57,11 +58,16 @@ def _run_workers(nproc: int, local_devices: int, out: str):
 
 
 @pytest.mark.slow
-def test_two_process_matches_single_process(tmp_path):
-    # 2 hosts x 2 local devices and 1 host x 4 local devices build the same
-    # 4-device global mesh over the same global batch.
-    multi = _run_workers(2, 2, str(tmp_path / "multi.json"))
-    single = _run_workers(1, 4, str(tmp_path / "single.json"))
+@pytest.mark.parametrize("sync", ["allreduce", "ring"])
+def test_two_process_matches_single_process(tmp_path, sync):
+    """2 hosts x 2 local devices and 1 host x 4 local devices build the
+    same 4-device global mesh over the same global batch — trajectories
+    must match to fp tolerance (same mesh size, same schedule, so the
+    reduction order is identical on both sides).  The ``ring`` case sends
+    the manual ppermute hops CROSSING a real OS-process boundary — the
+    reference's Gloo point-to-point analogue, not just psum."""
+    multi = _run_workers(2, 2, str(tmp_path / "multi.json"), sync=sync)
+    single = _run_workers(1, 4, str(tmp_path / "single.json"), sync=sync)
 
     assert np.isfinite(multi["loss"])
     np.testing.assert_allclose(multi["loss"], single["loss"],
