@@ -70,6 +70,13 @@ def main() -> None:
     trainer = Trainer(TinyNet(), mesh, sync, learning_rate=0.01,
                       log_every=2, log_fn=lambda s: None, seed=0)
     loss = trainer.train_epoch(loader, 0)
+    # DP desync detector, exercised ACROSS the real process boundary:
+    # intra-process shard comparison + cross-process fingerprints.
+    from tpudp.utils.consistency import (verify_across_processes,
+                                         verify_replicas)
+
+    consistency_checked = verify_replicas({"params": trainer.state.params})
+    verify_across_processes({"params": trainer.state.params})
     eval_loss, eval_acc = trainer.evaluate(loader)
 
     if rank == 0:
@@ -77,7 +84,8 @@ def main() -> None:
                   for p in jax.tree.leaves(trainer.state.params)]
         with open(out_path, "w") as f:
             json.dump({"loss": loss, "eval_loss": eval_loss,
-                       "eval_acc": eval_acc, "params": params}, f)
+                       "eval_acc": eval_acc, "params": params,
+                       "consistency_checked": consistency_checked}, f)
 
     if nproc > 1:
         jax.distributed.shutdown()

@@ -1,0 +1,77 @@
+"""Replica-consistency verification (tpudp.utils.consistency) — the DP
+desync detector, torch DDP's parameter-verification analogue.
+
+The silent hazard it exists for: shard_map out_specs=P() *claims* an
+output is replicated, and with check_vma=False nothing verifies it — a
+step that skips the gradient sync keeps training with divergent replicas
+and finite losses.  The detector compares actual shard bytes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudp.utils.consistency import (ReplicaDivergenceError, fingerprint,
+                                     verify_replicas)
+
+
+def _replicated_from(per_device_values, mesh):
+    """Build an array CLAIMING replication while each device holds its own
+    (possibly different) buffer — the exact silent-desync state."""
+    sharding = NamedSharding(mesh, P())
+    return jax.make_array_from_single_device_arrays(
+        per_device_values[0].shape, sharding,
+        [jax.device_put(v, d)
+         for v, d in zip(per_device_values, mesh.devices.flat)])
+
+
+def test_consistent_replicas_pass(mesh8):
+    n = mesh8.size
+    tree = {"w": _replicated_from([jnp.ones((4, 3))] * n, mesh8),
+            "scalar": 1.5,  # non-array leaves are skipped
+            "b": _replicated_from([jnp.arange(5.0)] * n, mesh8)}
+    assert verify_replicas(tree) == 2
+
+
+def test_divergent_replicas_detected(mesh8):
+    n = mesh8.size
+    vals = [jnp.ones((4, 3))] * (n - 1) + [jnp.ones((4, 3)) * 1.001]
+    tree = {"Conv_0": {"kernel": _replicated_from(vals, mesh8)}}
+    with pytest.raises(ReplicaDivergenceError, match="Conv_0.*kernel"):
+        verify_replicas(tree)
+    # a loose atol tolerates the drift; bit-identity (default) does not
+    assert verify_replicas(tree, atol=0.01) == 1
+
+
+def test_trainer_detects_sync_none_desync(mesh8):
+    """End to end: DP training with sync='none' (each replica applies only
+    its LOCAL gradient — divergent by construction) must trip the
+    post-epoch check, while the allreduce rung passes it."""
+    from tpudp.data.cifar10 import Dataset
+    from tpudp.data.loader import DataLoader
+    from tpudp.models.vgg import VGG11
+    from tpudp.train import Trainer
+
+    rng = np.random.default_rng(0)
+    ds = Dataset(rng.integers(0, 256, size=(16, 32, 32, 3)).astype(np.uint8),
+                 rng.integers(0, 10, size=16).astype(np.int32))
+
+    def run(sync):
+        tr = Trainer(VGG11(), mesh8, sync, learning_rate=0.1,
+                     log_every=1, log_fn=lambda s: None,
+                     verify_replicas=True)
+        tr.fit(DataLoader(ds, 16, train=True, seed=1), epochs=1)
+
+    run("allreduce")  # consistent: check passes silently
+    with pytest.raises(ReplicaDivergenceError):
+        run("none")
+
+
+def test_fingerprint_differs_on_divergence(mesh8):
+    n = mesh8.size
+    same = {"w": _replicated_from([jnp.ones((8,))] * n, mesh8)}
+    other = {"w": _replicated_from([jnp.ones((8,)) * 2] * n, mesh8)}
+    assert not np.array_equal(fingerprint(same), fingerprint(other))
+    assert np.array_equal(fingerprint(same), fingerprint(same))

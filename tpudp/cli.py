@@ -90,6 +90,12 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="batches prepared ahead on a background thread "
                         "(reference DataLoader num_workers=2 analogue); "
                         "0 disables")
+    p.add_argument("--verify-replicas", action="store_true",
+                   help="after each epoch, assert every replicated "
+                        "param/BN-stat shard is bit-identical across "
+                        "devices (torch DDP's parameter-verification "
+                        "analogue; catches silent DP desync — "
+                        "tpudp/utils/consistency.py)")
     p.add_argument("--metrics-jsonl", type=str, default=None, metavar="PATH",
                    help="append machine-readable metrics (one JSON line per "
                         "train window / eval / epoch) to PATH, alongside the "
@@ -202,7 +208,8 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     trainer = Trainer(model, mesh, sync, seed=args.seed,
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode,
                       watchdog=watchdog, grad_accum=args.grad_accum,
-                      remat=args.remat, metrics_jsonl=args.metrics_jsonl)
+                      remat=args.remat, metrics_jsonl=args.metrics_jsonl,
+                      verify_replicas=args.verify_replicas)
     print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
           f"data={data_backend}+prefetch{args.prefetch}")

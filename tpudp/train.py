@@ -719,12 +719,17 @@ class Trainer:
         loss_chunk: int | None = None,
         metrics_jsonl: str | None = None,
         compress: str | None = None,
+        verify_replicas: bool = False,
     ):
         self.model = model
         self.mesh = mesh
         self.sync = sync
         self.strategy = strategy
         self.watchdog = watchdog  # tpudp.utils.watchdog.Watchdog or None
+        # Post-epoch DP desync detector (tpudp.utils.consistency): torch
+        # DDP's _verify_params_across_processes analogue, opt-in because
+        # it fetches every replicated shard to the host.
+        self.verify_replicas = verify_replicas
         if compress is not None:
             # EF-compressed gradient collective lives in the optimizer
             # chain (tpudp.parallel.compress); the explicit sync must be
@@ -991,6 +996,26 @@ class Trainer:
             )
             self._emit_metrics({"kind": "epoch", "epoch": epoch,
                                 "seconds": epoch_s})
+            if self.verify_replicas:
+                from tpudp.utils.consistency import (verify_across_processes,
+                                                     verify_replicas)
+
+                beat = (self.watchdog.beat if self.watchdog is not None
+                        else None)
+                tree = {"params": self.state.params,
+                        "batch_stats": self.state.batch_stats}
+                n = verify_replicas(tree, beat=beat)
+                verify_across_processes(tree)
+                if beat is not None:
+                    beat()
+                if n == 0 and jax.process_count() == 1:
+                    self.log("[tpudp] replica consistency: nothing to "
+                             "check (no leaf has >1 replica on this mesh)")
+                else:
+                    self.log(f"[tpudp] replica consistency OK "
+                             f"({n} replicated leaves bit-identical"
+                             + (", cross-process fingerprints equal)"
+                                if jax.process_count() > 1 else ")"))
             if test_loader is not None:
                 self.evaluate(test_loader)
             if epoch_end_fn is not None:
