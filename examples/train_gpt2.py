@@ -52,6 +52,11 @@ def main() -> None:
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--clip-norm", type=float, default=None,
                    help="global-norm gradient clipping (LM stabilizer)")
+    p.add_argument("--skip-nonfinite", type=int, default=None, metavar="N",
+                   help="skip optimizer updates whose gradients contain "
+                        "NaN/Inf (transient bf16 overflow resilience); "
+                        "after N consecutive bad steps the NaN propagates "
+                        "so persistent instability fails loudly")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="bfloat16")
     p.add_argument("--loss-chunk", type=int, default=None, metavar="N",
@@ -105,8 +110,16 @@ def main() -> None:
         **moe,
     )
     model = GPT2(cfg)
+    if args.skip_nonfinite is not None and args.strategy not in ("dp",
+                                                                 "zero1"):
+        # The skip decision needs cross-device-synchronized gradients at
+        # tx.update (see make_optimizer docstring); tp/pp/fsdp/ep update
+        # on shard-local grads and would silently desync.
+        raise SystemExit("error: --skip-nonfinite supports the dp/zero1 "
+                         f"strategies only (got {args.strategy!r})")
     tx = make_optimizer(learning_rate=args.lr, momentum=0.9, weight_decay=0.0,
-                        clip_norm=args.clip_norm)
+                        clip_norm=args.clip_norm,
+                        skip_nonfinite=args.skip_nonfinite)
     state = init_state(model, tx, input_shape=(1, min(args.seq_len, 16)))
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
     print(f"[gpt2] params={n_params/1e6:.1f}M mesh=({d}x{s}) "
@@ -188,7 +201,12 @@ def main() -> None:
             from tpudp.utils.profiler import fetch_fence
 
             fetch_fence(state.params)  # honest timing edge (BASELINE.md)
-            cum = float(state.loss_sum)
+            from tpudp.utils.watchdog import check_finite
+
+            # Loud failure on divergence — with --skip-nonfinite this is
+            # what fires once the consecutive-skip budget is exhausted and
+            # the NaN finally propagates.
+            cum = check_finite(float(state.loss_sum), step=it)
             dt = time.perf_counter() - t0
             tok_s = args.log_every * args.batch_size * args.seq_len / dt
             print(f"step {it}: loss {(cum - prev_cum) / args.log_every:.4f} "

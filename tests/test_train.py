@@ -37,6 +37,40 @@ def _run_steps(mesh, sync, batches, spmd_mode="shard_map", seed=0):
     return losses, state
 
 
+def test_skip_nonfinite_protects_params():
+    """make_optimizer(skip_nonfinite=N): a NaN/Inf gradient step is
+    SKIPPED (params + momentum untouched — torch GradScaler's inf-skip
+    analogue); finite steps before/after apply normally; after N
+    consecutive bad steps the update applies anyway so the NaN propagates
+    to the watchdog's check_finite instead of looping silently."""
+    import optax
+
+    tx = make_optimizer(0.1, 0.9, 0.0, skip_nonfinite=2)
+    params = {"w": jnp.ones((4,))}
+    st = tx.init(params)
+    good = {"w": jnp.full((4,), 0.5)}
+    bad = {"w": jnp.array([1.0, jnp.nan, 1.0, 1.0])}
+
+    upd, st = tx.update(good, st, params)
+    params = optax.apply_updates(params, upd)
+    after_good = np.asarray(params["w"]).copy()
+
+    upd, st = tx.update(bad, st, params)
+    params = optax.apply_updates(params, upd)
+    np.testing.assert_array_equal(np.asarray(params["w"]), after_good)
+
+    upd, st = tx.update(good, st, params)  # recovery: finite steps resume
+    params = optax.apply_updates(params, upd)
+    assert np.all(np.isfinite(np.asarray(params["w"])))
+    assert not np.array_equal(np.asarray(params["w"]), after_good)
+
+    # exceed max_consecutive_errors: the NaN must finally propagate
+    for _ in range(3):
+        upd, st = tx.update(bad, st, params)
+        params = optax.apply_updates(params, upd)
+    assert not np.all(np.isfinite(np.asarray(params["w"])))
+
+
 def test_single_device_loss_decreases():
     batches = _fake_batches(8, seed=3)
     # repeat the same batch so the model can memorize it

@@ -80,6 +80,7 @@ def make_optimizer(
     total_steps: int | None = None,
     optimizer: str = "sgd",
     clip_norm: float | None = None,
+    skip_nonfinite: int | None = None,
     compress: str | None = None,
     compress_axis: str = DATA_AXIS,
     compress_devices: int | None = None,
@@ -102,6 +103,27 @@ def make_optimizer(
     ``clip_norm`` prepends global-norm gradient clipping (the standard
     LM-training stabilizer; applies after the cross-device mean since sync
     runs inside the step before tx.update).
+
+    ``skip_nonfinite=N`` wraps the whole chain in
+    ``optax.apply_if_finite``: a step whose gradients contain NaN/Inf is
+    SKIPPED (params and inner optimizer state untouched) instead of
+    poisoning the weights — torch users get this from GradScaler's
+    inf-check skip.  After N consecutive bad steps the updates apply
+    anyway, so the NaN propagates and the watchdog's ``check_finite``
+    turns a persistent instability into a loud failure rather than an
+    infinite silent skip-loop.  Resilience for transient bf16 overflow in
+    the backward pass; off by default (the reference semantics).
+
+    SPMD REQUIREMENT: the skip decision is a per-device ``lax.cond`` on
+    the gradients ``tx.update`` receives, so those gradients must already
+    be cross-device synchronized — true for the DP rungs (sync runs
+    before the update) and ZeRO-1 (replicated grads), NOT for rungs whose
+    update sees shard-local gradients (tp/pp/fsdp/ep): there a NaN on one
+    shard would skip on some devices and apply on others, silently
+    desyncing replicated state.  Incompatible with ``compress`` for the
+    same reason, only sharper — the compressed collective would sit
+    inside the cond and a non-uniform predicate deadlocks the ring; that
+    combination raises.
 
     ``compress='int8_ef'`` prepends the error-feedback int8-wire ring
     all-reduce (tpudp.parallel.compress) — pair with a shard_map step
@@ -144,15 +166,29 @@ def make_optimizer(
     if clip_norm is not None:
         head.append(optax.clip_by_global_norm(clip_norm))
     if optimizer == "adamw":
-        return optax.chain(*head, optax.adamw(lr, weight_decay=weight_decay))
-    if optimizer != "sgd":
+        tx = optax.chain(*head, optax.adamw(lr, weight_decay=weight_decay))
+    elif optimizer == "sgd":
+        tx = optax.chain(
+            *head,
+            optax.add_decayed_weights(weight_decay),
+            optax.sgd(lr, momentum=momentum),
+        )
+    else:
         raise ValueError(
             f"unknown optimizer {optimizer!r}; choose 'sgd' or 'adamw'")
-    return optax.chain(
-        *head,
-        optax.add_decayed_weights(weight_decay),
-        optax.sgd(lr, momentum=momentum),
-    )
+    if skip_nonfinite is not None:
+        if skip_nonfinite < 1:
+            raise ValueError(
+                f"skip_nonfinite must be >= 1, got {skip_nonfinite}")
+        if compress is not None:
+            raise ValueError(
+                "skip_nonfinite cannot wrap compress='int8_ef': the "
+                "compressed ring collective would run inside a per-device "
+                "lax.cond whose predicate (local-grad finiteness) can "
+                "differ across devices — some devices would enter the "
+                "ring and others not, deadlocking it")
+        tx = optax.apply_if_finite(tx, max_consecutive_errors=skip_nonfinite)
+    return tx
 
 
 def init_state(
