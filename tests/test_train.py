@@ -37,6 +37,26 @@ def _run_steps(mesh, sync, batches, spmd_mode="shard_map", seed=0):
     return losses, state
 
 
+def test_fixed_seed_runs_are_bit_identical(mesh8):
+    """The reference's determinism scaffolding (torch/numpy seeds at every
+    entrypoint, src/Part 2a/main.py:20-21) exists so loss curves are
+    comparable across runs and sync strategies; our guarantee is stronger
+    — two independent runs with the same seed produce BIT-identical loss
+    trajectories and final parameters (same program, same data, XLA's
+    deterministic execution)."""
+    batches = _fake_batches(3, seed=9)
+    losses_a, state_a = _run_steps(mesh8, "allreduce", batches, seed=0)
+    losses_b, state_b = _run_steps(mesh8, "allreduce", batches, seed=0)
+    assert losses_a == losses_b  # exact float equality, not allclose
+    for a, b in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and a different seed really changes the run (the scaffolding works);
+    # one step suffices — init divergence shows in the first loss
+    losses_c, _ = _run_steps(mesh8, "allreduce", batches[:1], seed=1)
+    assert losses_a[0] != losses_c[0]
+
+
 def test_skip_nonfinite_protects_params():
     """make_optimizer(skip_nonfinite=N): a NaN/Inf gradient step is
     SKIPPED (params + momentum untouched — torch GradScaler's inf-skip
