@@ -31,5 +31,9 @@ def bn_params(b):
     return {"scale": grab(b.weight), "bias": grab(b.bias)}
 
 
+# LayerNorm carries the same scale/bias mapping as BatchNorm params.
+ln_params = bn_params
+
+
 def bn_stats(b):
     return {"mean": grab(b.running_mean), "var": grab(b.running_var)}

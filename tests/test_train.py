@@ -48,8 +48,12 @@ def test_fixed_seed_runs_are_bit_identical(mesh8):
     losses_a, state_a = _run_steps(mesh8, "allreduce", batches, seed=0)
     losses_b, state_b = _run_steps(mesh8, "allreduce", batches, seed=0)
     assert losses_a == losses_b  # exact float equality, not allclose
-    for a, b in zip(jax.tree.leaves(state_a.params),
-                    jax.tree.leaves(state_b.params)):
+    # the WHOLE state: params, BN running stats, and momentum traces — a
+    # nondeterminism bug corrupting only batch_stats/opt_state would
+    # diverge eval behavior while params still matched
+    full_a = (state_a.params, state_a.batch_stats, state_a.opt_state)
+    full_b = (state_b.params, state_b.batch_stats, state_b.opt_state)
+    for a, b in zip(jax.tree.leaves(full_a), jax.tree.leaves(full_b)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # and a different seed really changes the run (the scaffolding works);
     # one step suffices — init divergence shows in the first loss

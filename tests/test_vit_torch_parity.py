@@ -1,0 +1,156 @@
+"""ViT numerical parity vs a torch mirror — the fourth model family pinned
+(VGG: test_torch_parity, ResNet: test_resnet_torch_parity, GPT-2:
+test_gpt2_hf_parity).
+
+The mirror reproduces tpudp/models/vit.py exactly: strided-conv patch
+embedding, learned positional embeddings, pre-LN blocks with a fused qkv
+projection (split into thirds, matching jnp.split ordering), tanh-approx
+GELU (flax ``nn.gelu`` default — torch needs ``approximate='tanh'``, NOT
+its exact-erf default), final LayerNorm, global-average-pool head.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tpudp.models.vit import ViT, ViTConfig  # noqa: E402
+from tpudp.train import init_state, make_optimizer, make_train_step  # noqa: E402
+
+from parity_utils import (conv_params, grab, linear_params,  # noqa: E402
+                          ln_params)
+
+CFG = ViTConfig(image_size=16, patch_size=4, num_classes=10, num_layers=2,
+                num_heads=2, d_model=32)
+BATCH, STEPS, LR, MOM, WD = 4, 3, 0.01, 0.9, 1e-4
+
+
+class TorchViT(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        d, h = CFG.d_model, CFG.num_heads
+        # flax LayerNorm defaults to eps=1e-6; torch's default 1e-5 would
+        # drift every norm output by ~sqrt((var+1e-6)/(var+1e-5))
+        eps = 1e-6
+        self.patch = torch.nn.Conv2d(3, d, CFG.patch_size,
+                                     stride=CFG.patch_size)
+        self.pos = torch.nn.Parameter(
+            torch.randn(1, CFG.num_patches, d) * 0.02)
+        self.heads = h
+        blocks = []
+        for _ in range(CFG.num_layers):
+            blocks.append(torch.nn.ModuleDict({
+                "ln_1": torch.nn.LayerNorm(d, eps=eps),
+                "qkv": torch.nn.Linear(d, 3 * d),
+                "proj": torch.nn.Linear(d, d),
+                "ln_2": torch.nn.LayerNorm(d, eps=eps),
+                "mlp_fc": torch.nn.Linear(d, CFG.mlp_ratio * d),
+                "mlp_proj": torch.nn.Linear(CFG.mlp_ratio * d, d),
+            }))
+        self.blocks = torch.nn.ModuleList(blocks)
+        self.ln_f = torch.nn.LayerNorm(d, eps=eps)
+        self.head = torch.nn.Linear(d, CFG.num_classes)
+
+    def _attn(self, blk, x):
+        b, t, d = x.shape
+        dh = d // self.heads
+        q, k, v = blk["qkv"](x).split(d, dim=-1)
+        q = q.reshape(b, t, self.heads, dh).transpose(1, 2)
+        k = k.reshape(b, t, self.heads, dh).transpose(1, 2)
+        v = v.reshape(b, t, self.heads, dh).transpose(1, 2)
+        a = torch.softmax(q @ k.transpose(-1, -2) / math.sqrt(dh), dim=-1)
+        out = (a @ v).transpose(1, 2).reshape(b, t, d)
+        return blk["proj"](out)
+
+    def forward(self, images):  # NCHW
+        x = self.patch(images)  # (B, D, H', W')
+        b, d = x.shape[:2]
+        # flax reshapes NHWC (B, H', W', D) row-major -> token t = (row,
+        # col); NCHW must permute before flattening to match
+        x = x.permute(0, 2, 3, 1).reshape(b, -1, d)
+        x = x + self.pos
+        for blk in self.blocks:
+            x = x + self._attn(blk, blk["ln_1"](x))
+            h = torch.nn.functional.gelu(blk["mlp_fc"](blk["ln_2"](x)),
+                                         approximate="tanh")
+            x = x + blk["mlp_proj"](h)
+        x = self.ln_f(x).mean(dim=1)
+        return self.head(x)
+
+
+def transplant(tmodel, params):
+    params = dict(params)
+    params["patch_embed"] = conv_params(tmodel.patch)
+    params["pos_embed"] = grab(tmodel.pos)
+    for i, blk in enumerate(tmodel.blocks):
+        flax_block = {
+            "ln_1": ln_params(blk["ln_1"]),
+            "ln_2": ln_params(blk["ln_2"]),
+            "attn": {"qkv": linear_params(blk["qkv"]),
+                     "proj": linear_params(blk["proj"])},
+            "mlp_fc": linear_params(blk["mlp_fc"]),
+            "mlp_proj": linear_params(blk["mlp_proj"]),
+        }
+        assert set(flax_block) == set(params[f"h_{i}"])
+        params[f"h_{i}"] = flax_block
+    params["ln_f"] = ln_params(tmodel.ln_f)
+    params["head"] = linear_params(tmodel.head)
+    return params
+
+
+@pytest.fixture
+def paired():
+    torch.manual_seed(0)
+    torch.set_num_threads(1)
+    tmodel = TorchViT()
+    model = ViT(CFG)
+    tx = make_optimizer(LR, MOM, WD)
+    state = init_state(model, tx, input_shape=(1, 16, 16, 3))
+    return tmodel, model, tx, state.replace(
+        params=transplant(tmodel, state.params))
+
+
+def test_vit_forward_parity(paired):
+    tmodel, model, _, state = paired
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(BATCH, 16, 16, 3)).astype(np.float32)
+    tmodel.eval()
+    with torch.no_grad():
+        t_logits = tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    j_logits = np.asarray(model.apply({"params": state.params},
+                                      jnp.asarray(x), train=False))
+    np.testing.assert_allclose(j_logits, t_logits, rtol=1e-4, atol=1e-4)
+
+
+def test_vit_training_trajectory_parity(paired):
+    tmodel, model, tx, state = paired
+    rng = np.random.default_rng(1)
+    xs = rng.normal(size=(STEPS, BATCH, 16, 16, 3)).astype(np.float32)
+    ys = rng.integers(0, CFG.num_classes, size=(STEPS, BATCH))
+
+    tmodel.train()
+    opt = torch.optim.SGD(tmodel.parameters(), lr=LR, momentum=MOM,
+                          weight_decay=WD)
+    crit = torch.nn.CrossEntropyLoss()
+    t_losses = []
+    for x, y in zip(xs, ys):
+        opt.zero_grad()
+        loss = crit(tmodel(torch.from_numpy(x.transpose(0, 3, 1, 2))),
+                    torch.from_numpy(y))
+        loss.backward()
+        opt.step()
+        t_losses.append(float(loss.detach()))
+
+    step = make_train_step(model, tx, None, "none", spmd_mode="single",
+                           donate=False)
+    j_losses = []
+    for x, y in zip(xs, ys):
+        state, loss = step(state, jnp.asarray(x),
+                           jnp.asarray(y, dtype=jnp.int32))
+        j_losses.append(float(loss))
+
+    np.testing.assert_allclose(j_losses, t_losses, rtol=2e-3, atol=2e-3)
