@@ -1,7 +1,8 @@
 """Headline benchmark: VGG-11/CIFAR-10 training throughput (images/sec).
 
-Runs the fused jitted DP train step (sync=allreduce over all local devices)
-at the reference's global batch size 256 and prints ONE JSON line.
+Runs the fused jitted DP train step (sync=allreduce by default; BENCH_SYNC
+selects another rung on multi-chip slices) at the reference's global batch
+size 256 and prints ONE JSON line.
 
 ``vs_baseline`` compares against the north-star denominator — the reference's
 "4-node Gloo images/sec" (BASELINE.json:5).  The reference publishes no
@@ -39,7 +40,9 @@ backoff ≈ 700s, well inside the driver's observed >=21-minute budget.
 Env knobs: BENCH_TRIES (2), BENCH_TIMEOUT (300s per attempt),
 BENCH_PROBE_TIMEOUT (90s), BENCH_PROBE=0 (skip probe), BENCH_STRICT=1
 (disable the banked fallback), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP,
-BENCH_DTYPE, BENCH_PLATFORM (cpu smoke mode).
+BENCH_DTYPE, BENCH_PLATFORM (cpu smoke mode), BENCH_SYNC (gradient-sync
+rung, validated against the ladder minus 'none'; banked fallback rows
+must match the requested rung).
 """
 
 import json
@@ -94,7 +97,12 @@ def child_main() -> None:
     # ``state`` to the step's output, so the invalidated input is never
     # reused).  BENCH_DONATE=0 opts out for A/B comparison.
     donate = os.environ.get("BENCH_DONATE", "1") != "0"
-    step = make_train_step(model, tx, mesh, sync="allreduce", donate=donate)
+    # BENCH_SYNC selects the gradient-sync rung (default the Part 2b
+    # psum); on a multi-chip slice this lets the headline bench compare
+    # ring/hd/a2a/int8 wire flavors without code edits.  Validated by the
+    # parent before any attempt spawns (_requested_sync).
+    sync = os.environ.get("BENCH_SYNC", "allreduce")
+    step = make_train_step(model, tx, mesh, sync=sync, donate=donate)
 
     rng = np.random.default_rng(0)
     images = jax.device_put(
@@ -190,6 +198,7 @@ def child_main() -> None:
         "device_kind": device_kind,
         "global_batch": batch,
         "dtype": dtype_name,
+        "sync": sync,
         "sec_per_step": round(sec_per_step, 5),
         "mfu": round(step_mfu, 4) if step_mfu is not None else None,
         "model_flops_per_step": flops_per_step,
@@ -239,7 +248,23 @@ def _bench_json_path() -> str:
                         "bench_results", "bench.json")
 
 
-def _banked_good() -> dict | None:
+def _requested_sync() -> str:
+    """The sync rung this run measures — validated EARLY in the parent so
+    a typo fails fast instead of crashing every child and then emitting a
+    plausible-looking banked number for a different rung.  'none' is
+    rejected: on a multi-chip mesh it trains divergent replicas and its
+    zero-comm throughput would be banked as real evidence."""
+    sync = os.environ.get("BENCH_SYNC", "allreduce")
+    from tpudp.parallel.sync import EXAMPLE_SYNC_CHOICES
+
+    if sync not in EXAMPLE_SYNC_CHOICES:
+        raise SystemExit(
+            f"error: BENCH_SYNC={sync!r} is not a benchmarkable rung; "
+            f"choose from {', '.join(EXAMPLE_SYNC_CHOICES)}")
+    return sync
+
+
+def _banked_good(sync: str) -> dict | None:
     """Newest banked REAL headline measurement, or None.
 
     Reads bench_results/bench.history.jsonl (where bench.py banks every
@@ -255,6 +280,9 @@ def _banked_good() -> dict | None:
             if (row.get("metric") == METRIC and "error" not in row
                 and row.get("source") != "last_known_good"
                 and "TPU" in str(row.get("device_kind", ""))
+                # banked evidence must be for the SAME rung being
+                # requested (rows predating the sync field were allreduce)
+                and row.get("sync", "allreduce") == sync
                 and isinstance(row.get("value"), (int, float))
                 and row["value"] > 0)
         ]
@@ -304,8 +332,9 @@ def main() -> None:
     # nor consume banked TPU ones (a smoke run re-emitting a real TPU
     # number as its headline would be confusing and wrong).
     smoke = bool(os.environ.get("BENCH_PLATFORM"))
+    sync = _requested_sync()  # fail fast on a bad BENCH_SYNC
     banked = (None if smoke or os.environ.get("BENCH_STRICT") == "1"
-              else _banked_good())
+              else _banked_good(sync))
 
     # Fast pre-probe: a wedged relay short-circuits to the banked line in
     # under 2 minutes instead of burning the full attempt budget (round-2

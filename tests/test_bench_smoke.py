@@ -70,6 +70,10 @@ def test_banked_fallback_selection(tmp_path, monkeypatch):
         {"metric": bench.METRIC, "value": 300.0, "device_kind": "TPU v5",
          "measured_at_utc": "2026-07-30T03:00:00Z",
          "source": "last_known_good"},
+        # a different sync rung's measurement must never stand in for the
+        # requested one
+        {"metric": bench.METRIC, "value": 400.0, "device_kind": "TPU v5",
+         "measured_at_utc": "2026-07-30T06:00:00Z", "sync": "ring"},
     ]
     hist = tmp_path / "bench.history.jsonl"
     hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
@@ -80,8 +84,10 @@ def test_banked_fallback_selection(tmp_path, monkeypatch):
          "measured_at_utc": "2026-07-30T01:00:00Z"}) + "\n")
     monkeypatch.setattr(bench, "_bench_json_path",
                         lambda: str(tmp_path / "bench.json"))
-    good = bench._banked_good()
+    good = bench._banked_good("allreduce")
     assert good is not None and good["value"] == 100.0
+    ring = bench._banked_good("ring")
+    assert ring is not None and ring["value"] == 400.0
 
 
 def test_matrix_bench_rows_parse():
