@@ -68,6 +68,55 @@ def test_epoch_gap(tmp_path):
     assert not epoch_missing(d)  # banked history row counts
 
 
+def test_record_bench_renders_freshest_rows(tmp_path):
+    """tools/record_bench.py: the newest measured headline wins over file
+    order; banked re-emissions are annotated; epoch and MFU rows render;
+    a missing resident-batch number never prints a literal 'None%'."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path)
+    _write(os.path.join(d, "bench.history.jsonl"), [
+        {"metric": "vgg11_cifar10_images_per_sec_per_chip", "value": 90000.0,
+         "unit": "images/sec/chip", "vs_baseline": 340.0, "mfu": 0.41,
+         "sec_per_step": 0.00285, "device_kind": "TPU v5 lite",
+         "dtype": "bfloat16", "global_batch": 256,
+         "measured_at_utc": "2026-07-30T04:00:00Z"},
+        {"metric": "vgg11_cifar10_images_per_sec_per_chip", "value": 92469.2,
+         "unit": "images/sec/chip", "vs_baseline": 349.4, "mfu": 0.43,
+         "sec_per_step": 0.00277, "device_kind": "TPU v5 lite",
+         "dtype": "bfloat16", "global_batch": 256,
+         "measured_at_utc": "2026-07-30T04:36:00Z"},
+    ])
+    _write(os.path.join(d, "bench.json"), [
+        {"metric": "vgg11_cifar10_images_per_sec_per_chip", "value": 92469.2,
+         "unit": "images/sec/chip", "vs_baseline": 349.4, "mfu": 0.43,
+         "sec_per_step": 0.00277, "device_kind": "TPU v5 lite",
+         "dtype": "bfloat16", "global_batch": 256,
+         "measured_at_utc": "2026-07-30T04:36:00Z",
+         "source": "last_known_good", "stale_reason": "relay wedged"},
+    ])
+    _write(os.path.join(d, "epoch.json"), [
+        {"metric": "vgg11_epoch_images_per_sec", "value": 88000.0,
+         "epoch_seconds": 0.29, "input_pipeline_gap_pct": None},
+    ])
+    _write(os.path.join(d, "mfu.jsonl"), [
+        {"variant": "full", "sec_per_step": 0.00277, "mfu": 0.43,
+         "device_kind": "TPU v5 lite"},
+        {"variant": "no_bn", "sec_per_step": 0.0023,
+         "bn_share_of_full": 0.17, "device_kind": "TPU v5 lite"},
+    ])
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "record_bench.py"),
+         "--dir", d], capture_output=True, text=True, cwd=repo).stdout
+    assert "92,469.2" in out          # newest measured row wins
+    assert "last-known-good" in out   # re-emission annotated
+    assert "88,000.0" in out          # epoch row renders
+    assert "BatchNorm 17.0%" in out   # MFU attribution row renders
+    assert "None%" not in out         # missing gap never prints literally
+
+
 def test_mfu_gap_requires_all_variants_on_tpu(tmp_path):
     """A window dying after the FIRST row must not mark the sweep done;
     CPU-smoke rows never satisfy the gate; bf16_params counts attempted
