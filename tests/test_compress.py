@@ -174,6 +174,11 @@ def test_trainer_level_compress(mesh8, tmp_path):
                  learning_rate=0.01, log_every=1, log_fn=lambda s: None)
     tr.train_epoch(loader, epoch=0)
     assert np.isfinite(float(tr.state.loss_sum))
+    # eval with the stacked per-device EF residuals in the state: the eval
+    # step threads state_partition_specs, so the sharded residuals must
+    # pass through without being all-gathered or erroring (r2 advisor)
+    eval_loss, eval_acc = tr.evaluate(DataLoader(ds, 16, train=False))
+    assert np.isfinite(eval_loss) and 0.0 <= eval_acc <= 1.0
     # EF residuals exist, stacked and sharded per device
     stacked = [l for l in jax.tree.leaves(tr.state.opt_state)
                if getattr(l, "ndim", 0) >= 1 and l.shape[0] == mesh8.size]
