@@ -24,9 +24,10 @@ wall time AND surfaces banked evidence early:
    A wedged relay short-circuits to step 4 in under 2 minutes.
 2. The measurement runs in a CHILD process; the parent retries crashed
    children (transient UNAVAILABLE) with a short backoff.
-3. A child that HANGS past its per-attempt cap short-circuits straight to
-   step 4 when banked evidence exists (a wedge never resolves within one
-   window); with nothing banked there is nothing to lose, so it retries.
+3. A child that HANGS past its per-attempt cap ends the attempt ladder:
+   a wedge never resolves within one window, so retries are reserved for
+   transient crashes.  With banked evidence the hang short-circuits
+   straight to step 4; without it the failure row prints immediately.
 4. If no fresh measurement was captured, the parent re-emits the newest
    BANKED real measurement (bench.py appends every fresh headline line to
    ``bench_results/bench.history.jsonl`` the moment it is captured),
@@ -72,6 +73,13 @@ def child_main() -> None:
     # logic on a simulated mesh for smoke testing.
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    # Persistent executable cache: after one successful compile, later runs
+    # (watcher retries, the driver's end-of-round bench) skip the compile
+    # RPC — the step the wedge-prone relay most often hangs on.  No-ops on
+    # the CPU backend (smoke mode) — the helper checks the resolved backend.
+    from tpudp.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     import jax.numpy as jnp
     import numpy as np
 
@@ -365,10 +373,14 @@ def main() -> None:
                           "(wedged backend init or device discovery)")
             # A hang is a wedge, and wedges don't clear within a window:
             # surface the banked evidence NOW rather than after more
-            # attempts burn the caller's budget (round-2 judge directive).
+            # attempts burn the caller's budget (round-2 judge directive),
+            # and stop the ladder either way — retries are for transient
+            # CRASHES (fast UNAVAILABLE at init), not hangs (2026-07-31
+            # postmortem: two blind back-to-back 600s hangs burnt the
+            # whole morning relay window).
             if banked is not None:
                 _emit_banked(banked, errors[-1])
-            continue
+            break
         line = _extract_json_line(proc.stdout)
         if line:
             # A parsed headline line is a successful measurement even if the
@@ -398,15 +410,16 @@ def main() -> None:
     # Every attempt failed.  Banked real measurement (if any) beats an
     # error row: the relay window comes and goes (BASELINE.md), and a wedge
     # at collection time should not erase evidence already captured.
+    n_ran = len(errors)  # a hang cuts the ladder short of `tries`
     if banked is not None:
-        _emit_banked(banked, f"all {tries} attempts failed: "
+        _emit_banked(banked, f"{n_ran}/{tries} attempts failed: "
                              + "; ".join(e[:200] for e in errors))
     print(json.dumps({
         "metric": METRIC,
         "value": 0.0,
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
-        "error": f"all {tries} attempts failed and no banked measurement "
+        "error": f"{n_ran}/{tries} attempts failed and no banked measurement "
                  + ("was consulted (smoke mode never consumes banked "
                     "evidence)" if smoke else
                     "exists (a banked one would have been re-emitted as "

@@ -30,6 +30,9 @@ def main() -> None:
 
     if os.environ.get("COLLECTIVE_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["COLLECTIVE_PLATFORM"])
+    from tpudp.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # no-op on the CPU backend (smoke mode)
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P

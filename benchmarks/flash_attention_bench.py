@@ -30,6 +30,10 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+from tpudp.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()  # no-op on the CPU backend (smoke mode)
+
 from tpudp.ops.flash_attention import flash_attention  # noqa: E402
 from tpudp.utils.flops import chip_peak_flops  # noqa: E402
 

@@ -65,6 +65,9 @@ def main() -> None:
 
     if os.environ.get("MATRIX_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["MATRIX_PLATFORM"])
+    from tpudp.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # no-op on the CPU backend (smoke mode)
     import jax.numpy as jnp
     import numpy as np
 

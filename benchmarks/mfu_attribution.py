@@ -38,6 +38,9 @@ def main() -> None:
 
     if os.environ.get("MFU_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["MFU_PLATFORM"])
+    from tpudp.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # no-op on the CPU backend (smoke mode)
     import flax.linen as nn
     import jax.numpy as jnp
     import numpy as np

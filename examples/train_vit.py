@@ -61,6 +61,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    from tpudp.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # no-op on the CPU backend (smoke mode)
     import jax
     import jax.numpy as jnp
     import numpy as np

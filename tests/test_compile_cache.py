@@ -1,0 +1,53 @@
+"""Persistent-compile-cache helper (tpudp/utils/compile_cache.py).
+
+The helper must (a) no-op on the CPU backend — the suite's platform —
+so smoke runs never see XLA:CPU's per-hit AOT mismatch noise, (b) honor
+the TPUDP_COMPILE_CACHE=0 opt-out, and (c) when forced, actually point
+JAX's config at the cache dir with zeroed thresholds (a silently
+renamed config flag in a JAX upgrade would otherwise disable caching
+without any signal — the function is deliberately never fatal).
+"""
+
+import jax
+import pytest
+
+from tpudp.utils.compile_cache import enable_persistent_cache
+
+
+@pytest.fixture()
+def _restore_cache_config():
+    prev = (jax.config.jax_compilation_cache_dir,
+            jax.config.jax_persistent_cache_min_compile_time_secs,
+            jax.config.jax_persistent_cache_min_entry_size_bytes)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev[0])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev[1])
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", prev[2])
+
+
+def test_noop_on_cpu_backend(tmp_path):
+    # conftest forces the CPU platform, so the resolved-backend gate trips.
+    assert enable_persistent_cache(str(tmp_path / "cache")) is None
+    assert not (tmp_path / "cache").exists()
+
+
+def test_opt_out_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUDP_COMPILE_CACHE", "0")
+    assert enable_persistent_cache(force=True) is None
+
+
+def test_forced_enable_sets_config(tmp_path, _restore_cache_config):
+    d = str(tmp_path / "cache")
+    assert enable_persistent_cache(d, force=True) == d
+    import os
+
+    assert os.path.isdir(d)
+    assert jax.config.jax_compilation_cache_dir == d
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+
+
+def test_env_path_default(monkeypatch, tmp_path, _restore_cache_config):
+    d = str(tmp_path / "env_cache")
+    monkeypatch.setenv("TPUDP_COMPILE_CACHE", d)
+    assert enable_persistent_cache(force=True) == d

@@ -131,10 +131,16 @@ while true; do
     else
       # BENCH_STRICT: under the watcher only a FRESH measurement counts —
       # a banked re-emission would satisfy battery_ok and mask the gap.
-      # BENCH_PROBE=0: the watcher just probed.
+      # BENCH_PROBE=0: the watcher just probed.  bench.py's ladder retries
+      # transient CRASHES only; a hung attempt ends it (wedges don't clear
+      # within a window — 2026-07-31 postmortem: two blind back-to-back
+      # 600s hangs consumed the whole morning window).  Stage cap bounds
+      # the TRUE worst case — slow crash (~600s) + 10s backoff + full
+      # second attempt (600s) ≈ 1210s — so the outer timeout can't SIGKILL
+      # a legitimately measuring second attempt.
       ensure_window
       BENCH_STRICT=1 BENCH_PROBE=0 BENCH_TRIES=2 BENCH_TIMEOUT=600 \
-        timeout -k "$GRACE" "$(stage_t 1500)" python bench.py \
+        timeout -k "$GRACE" "$(stage_t 1300)" python bench.py \
         > bench_results/bench.json 2> bench_results/bench.err
       log "bench.py rc=$? -> bench_results/bench.json"
       if ! battery_ok; then

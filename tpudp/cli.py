@@ -147,6 +147,15 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     if args.platform:  # must precede the first device query
         jax.config.update("jax_platforms", args.platform)
     initialize_distributed(args.master, args.num_nodes, args.rank, PORT)
+    # Persistent executable cache (see tpudp/utils/compile_cache.py): a
+    # trainer relaunched on the relay-gated TPU skips the train-step
+    # compile RPC after the first successful run.  No-ops on the CPU
+    # backend (--platform cpu smoke runs).  AFTER distributed init — the
+    # helper resolves the backend, and jax.distributed.initialize must
+    # precede the first backend touch on multi-host.
+    from tpudp.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     mesh = None if single_device else make_mesh(args.num_devices)
     world = 1 if mesh is None else mesh.size

@@ -1,0 +1,70 @@
+"""Persistent XLA compilation cache for the relay-gated TPU.
+
+The axon relay gives short, unpredictable windows of TPU health
+(BASELINE.md "relay outage" note); the dominant cost inside a window is
+the first compile of the fused train step (tens of seconds of RPC the
+relay can wedge on).  JAX's persistent compilation cache removes that
+cost for every run after the first successful one: the serialized
+executable is stored on disk keyed by program hash, and later processes
+(including the driver's own end-of-round ``bench.py``) deserialize it
+instead of recompiling, shrinking the window a measurement needs.
+
+Accelerator backends only: on XLA:CPU the AOT loader re-checks the host
+feature string on every cache hit and prints multi-line "machine type
+mismatch ... SIGILL" errors (the compile-side string carries XLA
+preference pseudo-features like ``+prefer-no-gather`` that the runtime
+probe never reports), drowning trainer output for a cache the 1-core
+smoke path doesn't benefit from anyway — so the helper checks the
+RESOLVED backend itself and no-ops on CPU.  Call it after any
+``jax.config.update("jax_platforms", ...)`` override.
+
+Opt-out with ``TPUDP_COMPILE_CACHE=0``; set a path to relocate.  Safe on
+backends without executable serialization: JAX falls back to a normal
+compile with a warning.  The reference has no analogue (eager torch
+compiles nothing); this is TPU-runtime machinery.
+"""
+
+import os
+
+# Inside the repo (the environment forbids writes elsewhere) and inside
+# bench_results/ (gitignored by the `bench_results/*` rule).
+DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "bench_results", "xla_cache")
+
+
+def enable_persistent_cache(path: str | None = None, *,
+                            force: bool = False) -> str | None:
+    """Point JAX at the on-disk executable cache; returns the dir or None.
+
+    Must run before the first compile (config flags are read per-compile).
+    ``force=True`` skips the CPU-backend check (tests).  Every threshold
+    is zeroed: on this relay even a small program's compile rides a
+    wedge-prone RPC, so caching everything is the right trade.
+    """
+    import jax
+
+    path = path if path is not None else os.environ.get(
+        "TPUDP_COMPILE_CACHE", DEFAULT_DIR)
+    if not path or path == "0":
+        return None
+    if not force:
+        try:
+            # Resolving the backend may itself ride the relay; callers
+            # initialize the same backend immediately afterwards, so this
+            # adds no new hang surface.
+            if jax.default_backend() == "cpu":
+                return None
+        except Exception:  # noqa: BLE001 — no backend, nothing to cache
+            return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        # Thresholds BEFORE the cache dir: the dir is the on/off switch,
+        # so a failure anywhere leaves caching fully off — never half-on
+        # with default thresholds while the caller was told "disabled".
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        return None
+    return path
