@@ -35,15 +35,20 @@ wall time AND surfaces banked evidence early:
    cannot erase evidence already banked.  Only if no banked row exists
    does the line carry ``value: 0`` plus the error trail.
 
-Worst case (no banked row, everything hangs): probe 90s + 2 x 300s + 10s
-backoff ≈ 700s, well inside the driver's observed >=21-minute budget.
+Worst case (no banked row): a hang ends the ladder, so the hang path is
+lock wait 240s + probe 90s + one 300s attempt ≈ 630s; the crash path is
+lock 240s + probe 90s + crash (<=300s) + 10s backoff + 300s ≈ 940s.
+Both inside the driver's observed >=21-minute budget, and the lock/probe
+terms only appear when another live client holds the device or the
+relay is wedged.
 
 Env knobs: BENCH_TRIES (2), BENCH_TIMEOUT (300s per attempt),
-BENCH_PROBE_TIMEOUT (90s), BENCH_PROBE=0 (skip probe), BENCH_STRICT=1
-(disable the banked fallback), BENCH_BATCH, BENCH_STEPS, BENCH_WARMUP,
-BENCH_DTYPE, BENCH_PLATFORM (cpu smoke mode), BENCH_SYNC (gradient-sync
-rung, validated against the ladder minus 'none'; banked fallback rows
-must match the requested rung).
+BENCH_PROBE_TIMEOUT (90s), BENCH_PROBE=0 (skip probe),
+BENCH_LOCK_TIMEOUT (240s wait for the single-client device lock),
+BENCH_STRICT=1 (disable the banked fallback), BENCH_BATCH, BENCH_STEPS,
+BENCH_WARMUP, BENCH_DTYPE, BENCH_PLATFORM (cpu smoke mode), BENCH_SYNC
+(gradient-sync rung, validated against the ladder minus 'none'; banked
+fallback rows must match the requested rung).
 """
 
 import json
@@ -344,6 +349,34 @@ def main() -> None:
     banked = (None if smoke or os.environ.get("BENCH_STRICT") == "1"
               else _banked_good(sync))
 
+    # Single-client device lock: a second concurrent TPU client wedges
+    # the relay for hours (2026-07-31 postmortem), so hold the lock across
+    # the probe and every attempt (children inherit it via env).  If
+    # another live client holds it, prefer banked evidence; with nothing
+    # banked, wait out the timeout and then run anyway — an empty artifact
+    # is worse for the round than a collision risk.  Smoke mode has no
+    # shared device and skips the lock.
+    import contextlib
+
+    if smoke:
+        lock_ctx = contextlib.nullcontext(True)
+    else:
+        from tpudp.utils.device_lock import tpu_client_lock
+
+        lock_ctx = tpu_client_lock(
+            timeout=float(os.environ.get("BENCH_LOCK_TIMEOUT", 240)))
+    with lock_ctx as lock_mine:
+        if not lock_mine:
+            if banked is not None:
+                _emit_banked(banked, "another TPU client holds the device "
+                                     "lock (live process on the relay)")
+            print("[bench] device lock held by another client and nothing "
+                  "banked; attempting anyway", file=sys.stderr, flush=True)
+        _measure_with_retries(tries, timeout, probe_timeout, smoke, banked)
+
+
+def _measure_with_retries(tries: int, timeout: float, probe_timeout: float,
+                          smoke: bool, banked: dict | None) -> None:
     # Fast pre-probe: a wedged relay short-circuits to the banked line in
     # under 2 minutes instead of burning the full attempt budget (round-2
     # postmortem: the driver's timeout fired while attempts were sleeping).

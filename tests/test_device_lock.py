@@ -1,0 +1,78 @@
+"""Single-client TPU mutex (tpudp/utils/device_lock.py).
+
+The lock must (a) grant a free lock and release it on exit, (b) report
+busy — without blocking past the timeout — while another open file
+description holds it (flock(2) semantics make two opens conflict even
+in one process, so no subprocess is needed), and (c) let cooperative
+children skip acquisition via the inherit env var, since bench.py's
+probe/measurement children run while their parent already holds it.
+"""
+
+import fcntl
+import time
+
+from tpudp.utils.device_lock import HELD_ENV, tpu_client_lock
+
+
+def test_acquire_and_release(tmp_path, monkeypatch):
+    monkeypatch.delenv(HELD_ENV, raising=False)
+    p = str(tmp_path / "lock")
+    with tpu_client_lock(path=p) as mine:
+        assert mine
+    # Released: a fresh open can lock it immediately.
+    with open(p, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+
+
+def test_busy_reports_false_within_timeout(tmp_path, monkeypatch):
+    monkeypatch.delenv(HELD_ENV, raising=False)
+    p = str(tmp_path / "lock")
+    holder = open(p, "w")
+    try:
+        fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        t0 = time.monotonic()
+        with tpu_client_lock(timeout=0.0, path=p) as mine:
+            assert not mine
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        holder.close()
+
+
+def test_held_env_inherits(tmp_path, monkeypatch):
+    p = str(tmp_path / "lock")
+    holder = open(p, "w")
+    try:
+        fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        monkeypatch.setenv(HELD_ENV, "1")
+        # A cooperative child skips acquisition entirely, so the held
+        # flock does not make it report busy.
+        with tpu_client_lock(path=p) as mine:
+            assert mine
+    finally:
+        holder.close()
+
+
+def test_unwritable_lock_path_proceeds_unprotected(tmp_path, monkeypatch,
+                                                   capsys):
+    # Broken locking infrastructure must never block a measurement (or
+    # break bench.py's always-print-a-line contract): yield True + warn.
+    monkeypatch.delenv(HELD_ENV, raising=False)
+    # Parent "directory" is a regular file, so the lock dir cannot be
+    # created — and unlike a chmod-based setup this fails for root too.
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    with tpu_client_lock(path=str(blocker / "lock")) as mine:
+        assert mine
+    assert "WITHOUT single-client protection" in capsys.readouterr().err
+
+
+def test_exports_inherit_flag_while_held(tmp_path, monkeypatch):
+    import os
+
+    monkeypatch.delenv(HELD_ENV, raising=False)
+    p = str(tmp_path / "lock")
+    assert os.environ.get(HELD_ENV) is None
+    with tpu_client_lock(path=p) as mine:
+        assert mine
+        assert os.environ.get(HELD_ENV) == "1"
+    assert os.environ.get(HELD_ENV) is None

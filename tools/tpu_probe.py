@@ -7,18 +7,37 @@ can never disagree about "healthy"; run under an external timeout (the
 whole point is that a wedged relay HANGS rather than erroring):
 
     timeout 90 python tools/tpu_probe.py
+
+Takes the single-client device lock first (tpudp/utils/device_lock.py):
+a second concurrent TPU client wedges the relay, so "some other client
+holds the lock" exits 2 — distinct from unhealthy, but equally "do not
+touch the TPU right now".
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    from tpudp.utils.device_lock import tpu_client_lock
 
-    d = jax.devices()
-    assert d and d[0].platform != "cpu", f"no accelerator: {d}"
-    x = jnp.ones((256, 256), jnp.bfloat16)
-    np.asarray(jnp.sum(x @ x))
+    with tpu_client_lock() as mine:
+        if not mine:
+            print("tpu_probe: another TPU client holds the device lock; "
+                  "refusing to create a second relay connection",
+                  file=sys.stderr)
+            raise SystemExit(2)
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        d = jax.devices()
+        assert d and d[0].platform != "cpu", f"no accelerator: {d}"
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        np.asarray(jnp.sum(x @ x))
 
 
 if __name__ == "__main__":

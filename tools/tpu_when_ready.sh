@@ -8,6 +8,20 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p bench_results
+# Single-client TPU lock (tpudp/utils/device_lock.py): two concurrent
+# relay clients wedge it for hours, so the watcher owns the device for
+# its whole lifetime and exports the inherit flag to every stage it
+# spawns.  -n: a second watcher instance dies instantly instead of
+# queueing behind the first.  The kernel releases the lock when the
+# watcher exits (including the deadline stand-down), handing the device
+# back to the driver's end-of-round bench.py.
+LOCK_FILE="$(python -c 'from tpudp.utils.device_lock import LOCK_PATH; print(LOCK_PATH)')"
+exec 9>"$LOCK_FILE"
+if ! flock -n 9; then
+  echo "tpu_when_ready: another TPU client holds $LOCK_FILE; refusing to start" >&2
+  exit 1
+fi
+export TPUDP_DEVICE_LOCK_HELD=1
 PERIOD="${PERIOD:-180}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-90}"
 log() { echo "[$(date +%H:%M:%S)] $*" >> bench_results/watch.log; }
@@ -19,7 +33,7 @@ log() { echo "[$(date +%H:%M:%S)] $*" >> bench_results/watch.log; }
 probe() {
   ensure_window
   timeout -k "$GRACE" "$(stage_t "$PROBE_TIMEOUT")" \
-    python tools/tpu_probe.py >/dev/null 2>&1
+    python tools/tpu_probe.py >/dev/null 2>&1 9>&-
 }
 
 # The battery "succeeded" only if bench.py produced a FRESH real
@@ -141,11 +155,11 @@ while true; do
       ensure_window
       BENCH_STRICT=1 BENCH_PROBE=0 BENCH_TRIES=2 BENCH_TIMEOUT=600 \
         timeout -k "$GRACE" "$(stage_t 1300)" python bench.py \
-        > bench_results/bench.json 2> bench_results/bench.err
+        > bench_results/bench.json 2> bench_results/bench.err 9>&-
       log "bench.py rc=$? -> bench_results/bench.json"
       if ! battery_ok; then
         log "bench produced no real measurement; re-entering wait loop"
-        sleep "$PERIOD"
+        sleep "$PERIOD" 9>&-
         continue
       fi
     fi
@@ -159,11 +173,11 @@ while true; do
       MATRIX_CONFIGS="$(python tools/bench_gaps.py matrix)" \
         MATRIX_STEPS=30 timeout -k "$GRACE" "$(stage_t 2400)" \
         python benchmarks/matrix_bench.py \
-        > bench_results/matrix.jsonl 2> bench_results/matrix.err
+        > bench_results/matrix.jsonl 2> bench_results/matrix.err 9>&-
       log "matrix_bench rc=$? -> bench_results/matrix.jsonl"
       if ! matrix_ok && ! probe; then
         log "matrix died and relay unhealthy; re-entering wait loop"
-        sleep "$PERIOD"
+        sleep "$PERIOD" 9>&-
         continue
       fi
     fi
@@ -175,7 +189,7 @@ while true; do
       # shellcheck disable=SC2046 — word-split the missing t values
       timeout -k "$GRACE" "$(stage_t 2400)" python benchmarks/flash_attention_bench.py \
         $(python tools/bench_gaps.py flash) \
-        > bench_results/flash.jsonl 2> bench_results/flash.err
+        > bench_results/flash.jsonl 2> bench_results/flash.err 9>&-
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
     fi
     if epoch_ok; then
@@ -184,7 +198,7 @@ while true; do
       bank bench_results/epoch.json
       ensure_window
       timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/epoch_bench.py \
-        > bench_results/epoch.json 2> bench_results/epoch.err
+        > bench_results/epoch.json 2> bench_results/epoch.err 9>&-
       log "epoch_bench rc=$? -> bench_results/epoch.json"
     fi
     if mfu_ok; then
@@ -193,7 +207,7 @@ while true; do
       bank bench_results/mfu.jsonl
       ensure_window
       timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/mfu_attribution.py \
-        > bench_results/mfu.jsonl 2> bench_results/mfu.err
+        > bench_results/mfu.jsonl 2> bench_results/mfu.err 9>&-
       log "mfu_attribution rc=$? -> bench_results/mfu.jsonl"
     fi
     # Exit only when every stage holds a complete result; otherwise keep
@@ -204,9 +218,9 @@ while true; do
       exit 0
     fi
     log "battery incomplete; re-entering wait loop"
-    sleep "$PERIOD"
+    sleep "$PERIOD" 9>&-
     continue
   fi
   log "TPU unavailable; sleeping ${PERIOD}s"
-  sleep "$PERIOD"
+  sleep "$PERIOD" 9>&-
 done

@@ -1,0 +1,99 @@
+"""Single-client mutex for the relay-gated TPU.
+
+The axon relay wedges — for hours — when two OS processes touch the TPU
+concurrently (2026-07-31 postmortem: a manual ``tpu_probe.py`` overlapping
+the watcher's own probe by a few seconds cost the whole morning window).
+Every first-party TPU client (``tools/tpu_probe.py``, ``bench.py``, the
+watcher battery) therefore takes this advisory ``flock`` before its first
+device touch, so an accidental second client fails fast with a clear
+"busy" instead of wedging the relay for everyone.
+
+Kernel-backed, so a crashed/SIGKILLed holder releases automatically —
+stale locks cannot outlive their process.  Cooperative children of a
+holder (e.g. bench.py's measurement child, the watcher's battery stages)
+skip re-acquisition via the ``TPUDP_DEVICE_LOCK_HELD=1`` env var the
+holder exports.  CPU smoke runs never take it (no shared device).
+
+The reference has no analogue — Gloo ranks each own their process and
+the assignment assumes a human launches exactly one per node
+(``/root/reference/src/Part 2a/main.py:156-175``); the relay's
+one-client constraint is a property of THIS runtime, handled here.
+"""
+
+import contextlib
+import errno
+import fcntl
+import os
+import sys
+import time
+
+LOCK_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "bench_results", ".tpu.lock")
+
+HELD_ENV = "TPUDP_DEVICE_LOCK_HELD"
+
+
+@contextlib.contextmanager
+def tpu_client_lock(timeout: float = 0.0, path: str = LOCK_PATH):
+    """Yield False iff a LIVE competing TPU client holds the lock.
+
+    Polls up to ``timeout`` seconds (0 = one non-blocking try).  Yielding
+    False — rather than raising — leaves the caller the policy decision:
+    a probe should report "busy = unhealthy", while the driver's
+    end-of-round bench may prefer banked evidence or a last-resort run.
+
+    Every OTHER outcome yields True: held, inherited via the env flag, or
+    the locking infrastructure itself being unavailable (unwritable
+    bench_results/, a filesystem without flock support raising ENOLCK,
+    ...).  Mutual exclusion is best-effort protection for the relay;
+    measurement availability wins when the two conflict — bench.py's
+    "always print a headline line" contract must survive an unwritable
+    lock file, and a phantom "another client holds the lock" diagnosis
+    would freeze benching on banked evidence forever.  Infrastructure
+    failures warn on stderr instead of silently degrading.
+    """
+    if os.environ.get(HELD_ENV) == "1":
+        yield True
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        f = open(path, "w")
+    except OSError as e:
+        print(f"[device_lock] warning: cannot open lock file {path} ({e}); "
+              "proceeding WITHOUT single-client protection",
+              file=sys.stderr, flush=True)
+        yield True
+        return
+    acquired = False
+    busy = False
+    deadline = time.monotonic() + timeout
+    try:
+        while True:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                acquired = True
+                break
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    # Broken locking (e.g. ENOLCK), not a competitor:
+                    # warn and proceed unprotected rather than inventing
+                    # a phantom client.
+                    print(f"[device_lock] warning: flock failed ({e}); "
+                          "proceeding WITHOUT single-client protection",
+                          file=sys.stderr, flush=True)
+                    break
+                if time.monotonic() >= deadline:
+                    busy = True
+                    break
+                time.sleep(1.0)
+        if acquired:
+            os.environ[HELD_ENV] = "1"  # inherited by children we spawn
+        try:
+            yield not busy
+        finally:
+            if acquired:
+                os.environ.pop(HELD_ENV, None)
+                fcntl.flock(f, fcntl.LOCK_UN)
+    finally:
+        f.close()
