@@ -46,7 +46,9 @@ Env knobs: BENCH_TRIES (2), BENCH_TIMEOUT (300s per attempt),
 BENCH_PROBE_TIMEOUT (90s), BENCH_PROBE=0 (skip probe),
 BENCH_LOCK_TIMEOUT (240s wait for the single-client device lock),
 BENCH_STRICT=1 (disable the banked fallback), BENCH_BATCH, BENCH_STEPS,
-BENCH_WARMUP, BENCH_DTYPE, BENCH_PLATFORM (cpu smoke mode), BENCH_SYNC
+BENCH_WARMUP, BENCH_DTYPE, BENCH_PARAM_DTYPE (bfloat16 casts params +
+momentum: the mfu_attribution 'bf16_params' lever), BENCH_PLATFORM (cpu
+smoke mode), BENCH_SYNC
 (gradient-sync rung, validated against the ladder minus 'none'; banked
 fallback rows must match the requested rung).
 """
@@ -105,6 +107,20 @@ def child_main() -> None:
     model = VGG11(dtype=dtype)
     tx = make_optimizer()
     state = init_state(model, tx)
+    # BENCH_PARAM_DTYPE=bfloat16 casts params AND momentum to bf16 —
+    # halves weight-side HBM traffic (the benchmarks/mfu_attribution.py
+    # 'bf16_params' lever, selectable here so the headline number can
+    # adopt it once the attribution row proves the win on-chip).
+    param_dtype = _requested_param_dtype()
+    if param_dtype == "bfloat16":
+        state = state.replace(
+            params=jax.tree.map(lambda a: a.astype(jnp.bfloat16),
+                                state.params),
+            opt_state=jax.tree.map(
+                lambda a: (a.astype(jnp.bfloat16)
+                           if isinstance(a, jax.Array)
+                           and a.dtype == jnp.float32 else a),
+                state.opt_state))
     # Donated state buffers: XLA updates params/momentum in place instead of
     # copying the full TrainState every step (the loop always rebinds
     # ``state`` to the step's output, so the invalidated input is never
@@ -211,6 +227,7 @@ def child_main() -> None:
         "device_kind": device_kind,
         "global_batch": batch,
         "dtype": dtype_name,
+        "param_dtype": param_dtype,
         "sync": sync,
         "sec_per_step": round(sec_per_step, 5),
         "mfu": round(step_mfu, 4) if step_mfu is not None else None,
@@ -261,6 +278,19 @@ def _bench_json_path() -> str:
                         "bench_results", "bench.json")
 
 
+def _requested_param_dtype() -> str:
+    """Validated early in the parent for the same reason as
+    ``_requested_sync``: a typo (e.g. ``bf16``) must fail fast, not
+    silently measure fp32 params while the evidence row claims
+    otherwise."""
+    pd = os.environ.get("BENCH_PARAM_DTYPE", "float32")
+    if pd not in ("float32", "bfloat16"):
+        raise SystemExit(
+            f"error: BENCH_PARAM_DTYPE={pd!r} is not a valid param dtype; "
+            "choose float32 or bfloat16")
+    return pd
+
+
 def _requested_sync() -> str:
     """The sync rung this run measures — validated EARLY in the parent so
     a typo fails fast instead of crashing every child and then emitting a
@@ -277,7 +307,7 @@ def _requested_sync() -> str:
     return sync
 
 
-def _banked_good(sync: str) -> dict | None:
+def _banked_good(sync: str, param_dtype: str) -> dict | None:
     """Newest banked REAL headline measurement, or None.
 
     Reads bench_results/bench.history.jsonl (where bench.py banks every
@@ -293,9 +323,11 @@ def _banked_good(sync: str) -> dict | None:
             if (row.get("metric") == METRIC and "error" not in row
                 and row.get("source") != "last_known_good"
                 and "TPU" in str(row.get("device_kind", ""))
-                # banked evidence must be for the SAME rung being
-                # requested (rows predating the sync field were allreduce)
+                # banked evidence must be for the SAME rung and the same
+                # param dtype being requested (rows predating those fields
+                # were allreduce / float32)
                 and row.get("sync", "allreduce") == sync
+                and row.get("param_dtype", "float32") == param_dtype
                 and isinstance(row.get("value"), (int, float))
                 and row["value"] > 0)
         ]
@@ -346,8 +378,9 @@ def main() -> None:
     # number as its headline would be confusing and wrong).
     smoke = bool(os.environ.get("BENCH_PLATFORM"))
     sync = _requested_sync()  # fail fast on a bad BENCH_SYNC
+    param_dtype = _requested_param_dtype()  # fail fast on a bad dtype
     banked = (None if smoke or os.environ.get("BENCH_STRICT") == "1"
-              else _banked_good(sync))
+              else _banked_good(sync, param_dtype))
 
     # Single-client device lock: a second concurrent TPU client wedges
     # the relay for hours (2026-07-31 postmortem), so hold the lock across

@@ -38,6 +38,11 @@ def main() -> None:
 
     if os.environ.get("MFU_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["MFU_PLATFORM"])
+    from tpudp.utils.device_lock import acquire_for_process
+
+    # Fail fast if another live client (e.g. the watcher) is on the
+    # relay — two concurrent clients wedge it (device_lock.py).
+    acquire_for_process(skip=bool(os.environ.get("MFU_PLATFORM")))
     from tpudp.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()  # no-op on the CPU backend (smoke mode)

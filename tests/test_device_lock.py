@@ -76,3 +76,53 @@ def test_exports_inherit_flag_while_held(tmp_path, monkeypatch):
         assert mine
         assert os.environ.get(HELD_ENV) == "1"
     assert os.environ.get(HELD_ENV) is None
+
+
+def test_acquire_for_process_busy_exits_2(tmp_path, monkeypatch):
+    import fcntl as _fcntl
+
+    import pytest
+
+    from tpudp.utils import device_lock
+
+    monkeypatch.delenv(HELD_ENV, raising=False)
+    monkeypatch.setattr(device_lock, "_PROCESS_LOCK", None)
+    p = str(tmp_path / "lock")
+    holder = open(p, "w")
+    try:
+        _fcntl.flock(holder, _fcntl.LOCK_EX | _fcntl.LOCK_NB)
+        with pytest.raises(SystemExit) as ei:
+            device_lock.acquire_for_process(path=p)
+        assert ei.value.code == 2
+    finally:
+        holder.close()
+
+
+def test_acquire_for_process_skip_and_idempotent(tmp_path, monkeypatch):
+    import fcntl as _fcntl
+
+    from tpudp.utils import device_lock
+
+    monkeypatch.delenv(HELD_ENV, raising=False)
+    monkeypatch.setattr(device_lock, "_PROCESS_LOCK", None)
+    p = str(tmp_path / "lock")
+    # skip=True must not create or lock anything (CPU smoke path).
+    device_lock.acquire_for_process(skip=True, path=p)
+    assert device_lock._PROCESS_LOCK is None
+    # First real call takes the lock; the second is a no-op, not a
+    # self-deadlock.
+    device_lock.acquire_for_process(path=p)
+    assert device_lock._PROCESS_LOCK is not None
+    device_lock.acquire_for_process(path=p)
+    # Held: an independent open cannot lock it.
+    other = open(p, "w")
+    try:
+        import pytest
+
+        with pytest.raises(OSError):
+            _fcntl.flock(other, _fcntl.LOCK_EX | _fcntl.LOCK_NB)
+    finally:
+        other.close()
+    # Release for test hygiene (atexit would otherwise hold it).
+    device_lock._PROCESS_LOCK.__exit__(None, None, None)
+    monkeypatch.setattr(device_lock, "_PROCESS_LOCK", None)

@@ -12,9 +12,12 @@ mkdir -p bench_results
 # relay clients wedge it for hours, so the watcher owns the device for
 # its whole lifetime and exports the inherit flag to every stage it
 # spawns.  -n: a second watcher instance dies instantly instead of
-# queueing behind the first.  The kernel releases the lock when the
-# watcher exits (including the deadline stand-down), handing the device
-# back to the driver's end-of-round bench.py.
+# queueing behind the first.  TPU-touching stage children inherit fd 9
+# on purpose: the flock must outlive a killed watcher while any stage
+# still runs against the relay (only the sleeps close the fd — they
+# never touch the device and would otherwise pin the lock pointlessly).
+# The kernel releases the lock when the watcher AND all stage children
+# have exited, handing the device to the driver's end-of-round bench.py.
 LOCK_FILE="$(python -c 'from tpudp.utils.device_lock import LOCK_PATH; print(LOCK_PATH)')"
 exec 9>"$LOCK_FILE"
 if ! flock -n 9; then
@@ -33,7 +36,7 @@ log() { echo "[$(date +%H:%M:%S)] $*" >> bench_results/watch.log; }
 probe() {
   ensure_window
   timeout -k "$GRACE" "$(stage_t "$PROBE_TIMEOUT")" \
-    python tools/tpu_probe.py >/dev/null 2>&1 9>&-
+    python tools/tpu_probe.py >/dev/null 2>&1
 }
 
 # The battery "succeeded" only if bench.py produced a FRESH real
@@ -155,7 +158,7 @@ while true; do
       ensure_window
       BENCH_STRICT=1 BENCH_PROBE=0 BENCH_TRIES=2 BENCH_TIMEOUT=600 \
         timeout -k "$GRACE" "$(stage_t 1300)" python bench.py \
-        > bench_results/bench.json 2> bench_results/bench.err 9>&-
+        > bench_results/bench.json 2> bench_results/bench.err
       log "bench.py rc=$? -> bench_results/bench.json"
       if ! battery_ok; then
         log "bench produced no real measurement; re-entering wait loop"
@@ -173,7 +176,7 @@ while true; do
       MATRIX_CONFIGS="$(python tools/bench_gaps.py matrix)" \
         MATRIX_STEPS=30 timeout -k "$GRACE" "$(stage_t 2400)" \
         python benchmarks/matrix_bench.py \
-        > bench_results/matrix.jsonl 2> bench_results/matrix.err 9>&-
+        > bench_results/matrix.jsonl 2> bench_results/matrix.err
       log "matrix_bench rc=$? -> bench_results/matrix.jsonl"
       if ! matrix_ok && ! probe; then
         log "matrix died and relay unhealthy; re-entering wait loop"
@@ -189,7 +192,7 @@ while true; do
       # shellcheck disable=SC2046 — word-split the missing t values
       timeout -k "$GRACE" "$(stage_t 2400)" python benchmarks/flash_attention_bench.py \
         $(python tools/bench_gaps.py flash) \
-        > bench_results/flash.jsonl 2> bench_results/flash.err 9>&-
+        > bench_results/flash.jsonl 2> bench_results/flash.err
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
     fi
     if epoch_ok; then
@@ -198,7 +201,7 @@ while true; do
       bank bench_results/epoch.json
       ensure_window
       timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/epoch_bench.py \
-        > bench_results/epoch.json 2> bench_results/epoch.err 9>&-
+        > bench_results/epoch.json 2> bench_results/epoch.err
       log "epoch_bench rc=$? -> bench_results/epoch.json"
     fi
     if mfu_ok; then
@@ -207,7 +210,7 @@ while true; do
       bank bench_results/mfu.jsonl
       ensure_window
       timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/mfu_attribution.py \
-        > bench_results/mfu.jsonl 2> bench_results/mfu.err 9>&-
+        > bench_results/mfu.jsonl 2> bench_results/mfu.err
       log "mfu_attribution rc=$? -> bench_results/mfu.jsonl"
     fi
     # Exit only when every stage holds a complete result; otherwise keep

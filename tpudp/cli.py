@@ -154,7 +154,12 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     # helper resolves the backend, and jax.distributed.initialize must
     # precede the first backend touch on multi-host.
     from tpudp.utils.compile_cache import enable_persistent_cache
+    from tpudp.utils.device_lock import acquire_for_process
 
+    # Fail fast if another live client (e.g. the watcher) is on the relay
+    # — two concurrent clients wedge it (device_lock.py).  Platform
+    # overrides (cpu smoke / simulated meshes) have no shared device.
+    acquire_for_process(skip=args.platform is not None)
     enable_persistent_cache()
 
     mesh = None if single_device else make_mesh(args.num_devices)

@@ -4,9 +4,11 @@ The axon relay wedges — for hours — when two OS processes touch the TPU
 concurrently (2026-07-31 postmortem: a manual ``tpu_probe.py`` overlapping
 the watcher's own probe by a few seconds cost the whole morning window).
 Every first-party TPU client (``tools/tpu_probe.py``, ``bench.py``, the
-watcher battery) therefore takes this advisory ``flock`` before its first
-device touch, so an accidental second client fails fast with a clear
-"busy" instead of wedging the relay for everyone.
+benchmark harnesses and Part/example trainers via
+``acquire_for_process``, and the watcher battery) therefore takes this
+advisory ``flock`` before its first device touch, so an accidental
+second client fails fast with a clear "busy" instead of wedging the
+relay for everyone.
 
 Kernel-backed, so a crashed/SIGKILLed holder releases automatically —
 stale locks cannot outlive their process.  Cooperative children of a
@@ -20,6 +22,7 @@ the assignment assumes a human launches exactly one per node
 one-client constraint is a property of THIS runtime, handled here.
 """
 
+import atexit
 import contextlib
 import errno
 import fcntl
@@ -97,3 +100,36 @@ def tpu_client_lock(timeout: float = 0.0, path: str = LOCK_PATH):
                 fcntl.flock(f, fcntl.LOCK_UN)
     finally:
         f.close()
+
+
+_PROCESS_LOCK = None  # keeps the context (and its fd) alive for the process
+
+
+def acquire_for_process(skip: bool = False, timeout: float = 0.0,
+                        path: str = LOCK_PATH) -> None:
+    """Hold the single-client lock for this process's remaining lifetime.
+
+    The entry hook for long-running TPU clients that are not structured
+    around a ``with`` block (benchmark harnesses, the Part/ example
+    trainers): call once before the first device touch; the lock is
+    released at interpreter exit.  A live competing client raises
+    ``SystemExit(2)`` with a pointer at the watcher — the manual-overlap
+    wedge from the 2026-07-31 postmortem is exactly this path.  ``skip``
+    is for CPU/smoke modes (no shared device; also avoids resolving a
+    backend before the caller's platform override).  Idempotent.
+    """
+    global _PROCESS_LOCK
+    if skip or _PROCESS_LOCK is not None:
+        return
+    ctx = tpu_client_lock(timeout=timeout, path=path)
+    mine = ctx.__enter__()
+    if not mine:
+        ctx.__exit__(None, None, None)
+        print("device_lock: another TPU client holds the device lock "
+              f"({path}) — a second concurrent relay client wedges the "
+              "TPU for hours.  If tools/tpu_when_ready.sh is running, let "
+              "it finish (check bench_results/watch.log) or kill its "
+              "process tree first.", file=sys.stderr, flush=True)
+        raise SystemExit(2)
+    _PROCESS_LOCK = ctx
+    atexit.register(ctx.__exit__, None, None, None)
