@@ -35,7 +35,7 @@ from tpudp.utils.device_lock import acquire_for_process  # noqa: E402
 
 # Fail fast if another live client (e.g. the watcher) is on the relay —
 # two concurrent clients wedge it (device_lock.py).
-acquire_for_process(skip=bool(os.environ.get("FLASH_PLATFORM")))
+acquire_for_process()  # self-skips when jax_platforms is cpu-pinned
 enable_persistent_cache()  # no-op on the CPU backend (smoke mode)
 
 from tpudp.ops.flash_attention import flash_attention  # noqa: E402

@@ -42,7 +42,7 @@ def main() -> None:
 
     # Fail fast if another live client (e.g. the watcher) is on the
     # relay — two concurrent clients wedge it (device_lock.py).
-    acquire_for_process(skip=bool(os.environ.get("MFU_PLATFORM")))
+    acquire_for_process()  # self-skips when jax_platforms is cpu-pinned
     from tpudp.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()  # no-op on the CPU backend (smoke mode)

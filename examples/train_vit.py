@@ -64,8 +64,9 @@ def main() -> None:
     from tpudp.utils.compile_cache import enable_persistent_cache
     from tpudp.utils.device_lock import acquire_for_process
 
-    # Fail fast if another live relay client exists (device_lock.py).
-    acquire_for_process(skip=args.platform is not None)
+    # Fail fast if another live relay client exists (device_lock.py);
+    # self-skips when jax_platforms is cpu-pinned.
+    acquire_for_process()
     enable_persistent_cache()  # no-op on the CPU backend (smoke mode)
     import jax
     import jax.numpy as jnp

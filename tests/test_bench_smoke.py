@@ -74,6 +74,10 @@ def test_banked_fallback_selection(tmp_path, monkeypatch):
         # requested one
         {"metric": bench.METRIC, "value": 400.0, "device_kind": "TPU v5",
          "measured_at_utc": "2026-07-30T06:00:00Z", "sync": "ring"},
+        # nor may a different param dtype's (bf16-params vs fp32)
+        {"metric": bench.METRIC, "value": 500.0, "device_kind": "TPU v5",
+         "measured_at_utc": "2026-07-30T07:00:00Z",
+         "param_dtype": "bfloat16"},
     ]
     hist = tmp_path / "bench.history.jsonl"
     hist.write_text("".join(json.dumps(r) + "\n" for r in rows))
@@ -84,10 +88,12 @@ def test_banked_fallback_selection(tmp_path, monkeypatch):
          "measured_at_utc": "2026-07-30T01:00:00Z"}) + "\n")
     monkeypatch.setattr(bench, "_bench_json_path",
                         lambda: str(tmp_path / "bench.json"))
-    good = bench._banked_good("allreduce")
+    good = bench._banked_good("allreduce", "float32")
     assert good is not None and good["value"] == 100.0
-    ring = bench._banked_good("ring")
+    ring = bench._banked_good("ring", "float32")
     assert ring is not None and ring["value"] == 400.0
+    bf16 = bench._banked_good("allreduce", "bfloat16")
+    assert bf16 is not None and bf16["value"] == 500.0
 
 
 def test_matrix_bench_rows_parse():

@@ -92,7 +92,7 @@ def test_acquire_for_process_busy_exits_2(tmp_path, monkeypatch):
     try:
         _fcntl.flock(holder, _fcntl.LOCK_EX | _fcntl.LOCK_NB)
         with pytest.raises(SystemExit) as ei:
-            device_lock.acquire_for_process(path=p)
+            device_lock.acquire_for_process(path=p, force=True)
         assert ei.value.code == 2
     finally:
         holder.close()
@@ -107,13 +107,16 @@ def test_acquire_for_process_skip_and_idempotent(tmp_path, monkeypatch):
     monkeypatch.setattr(device_lock, "_PROCESS_LOCK", None)
     p = str(tmp_path / "lock")
     # skip=True must not create or lock anything (CPU smoke path).
-    device_lock.acquire_for_process(skip=True, path=p)
+    device_lock.acquire_for_process(skip=True, path=p, force=True)
+    assert device_lock._PROCESS_LOCK is None
+    # Without force, the suite's cpu-pinned jax_platforms config skips too.
+    device_lock.acquire_for_process(path=p)
     assert device_lock._PROCESS_LOCK is None
     # First real call takes the lock; the second is a no-op, not a
     # self-deadlock.
-    device_lock.acquire_for_process(path=p)
+    device_lock.acquire_for_process(path=p, force=True)
     assert device_lock._PROCESS_LOCK is not None
-    device_lock.acquire_for_process(path=p)
+    device_lock.acquire_for_process(path=p, force=True)
     # Held: an independent open cannot lock it.
     other = open(p, "w")
     try:

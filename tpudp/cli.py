@@ -157,9 +157,10 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     from tpudp.utils.device_lock import acquire_for_process
 
     # Fail fast if another live client (e.g. the watcher) is on the relay
-    # — two concurrent clients wedge it (device_lock.py).  Platform
-    # overrides (cpu smoke / simulated meshes) have no shared device.
-    acquire_for_process(skip=args.platform is not None)
+    # — two concurrent clients wedge it (device_lock.py).  The helper
+    # self-skips when jax_platforms is cpu-pinned (--platform cpu smoke
+    # runs, the test suite's conftest); any accelerator pin still locks.
+    acquire_for_process()
     enable_persistent_cache()
 
     mesh = None if single_device else make_mesh(args.num_devices)

@@ -106,7 +106,8 @@ _PROCESS_LOCK = None  # keeps the context (and its fd) alive for the process
 
 
 def acquire_for_process(skip: bool = False, timeout: float = 0.0,
-                        path: str = LOCK_PATH) -> None:
+                        path: str = LOCK_PATH, *,
+                        force: bool = False) -> None:
     """Hold the single-client lock for this process's remaining lifetime.
 
     The entry hook for long-running TPU clients that are not structured
@@ -114,13 +115,28 @@ def acquire_for_process(skip: bool = False, timeout: float = 0.0,
     trainers): call once before the first device touch; the lock is
     released at interpreter exit.  A live competing client raises
     ``SystemExit(2)`` with a pointer at the watcher — the manual-overlap
-    wedge from the 2026-07-31 postmortem is exactly this path.  ``skip``
-    is for CPU/smoke modes (no shared device; also avoids resolving a
-    backend before the caller's platform override).  Idempotent.
+    wedge from the 2026-07-31 postmortem is exactly this path.
+    Self-skips when ``jax_platforms`` is cpu-pinned (smoke runs, the
+    test suite) — callers apply their platform override first; ``skip``
+    lets a caller opt out on its own knowledge.  Idempotent.
     """
     global _PROCESS_LOCK
     if skip or _PROCESS_LOCK is not None:
         return
+    # CPU-pinned processes (simulated meshes, the test suite's conftest)
+    # have no shared device and must not take — or block on — the TPU
+    # lock.  The jax_platforms CONFIG value is readable without
+    # initializing a backend (resolving the backend would itself touch
+    # the relay before the lock is held, defeating fail-fast); callers
+    # apply their platform overrides before calling here.
+    if not force:  # force=True: tests exercise the lock on the CPU suite
+        try:
+            import jax
+
+            if "cpu" in str(getattr(jax.config, "jax_platforms", "") or ""):
+                return
+        except Exception:  # noqa: BLE001 — no config, fall through to lock
+            pass
     ctx = tpu_client_lock(timeout=timeout, path=path)
     mine = ctx.__enter__()
     if not mine:
