@@ -133,7 +133,14 @@ def acquire_for_process(skip: bool = False, timeout: float = 0.0,
         try:
             import jax
 
-            if "cpu" in str(getattr(jax.config, "jax_platforms", "") or ""):
+            # cpu-pinned means EVERY entry is cpu: the axon sitecustomize
+            # pins "axon,cpu" (accelerator first, cpu fallback) and a
+            # substring test on that would skip the lock on the real TPU
+            # host — the exact wedge this lock exists to prevent.  None/
+            # empty (auto-detect) locks too: on this host it finds the TPU.
+            platforms = str(getattr(jax.config, "jax_platforms", "") or "")
+            entries = {p.strip() for p in platforms.split(",") if p.strip()}
+            if entries == {"cpu"}:
                 return
         except Exception:  # noqa: BLE001 — no config, fall through to lock
             pass
