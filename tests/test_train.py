@@ -307,3 +307,54 @@ def test_clip_norm_bounds_update():
     np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
     with pytest.raises(ValueError, match="clip_norm"):
         make_optimizer(clip_norm=0.0)
+
+
+def test_mid_epoch_resume_fast_forward_matches_uninterrupted(mesh4):
+    """Emergency-dump recovery semantics: training the first k batches,
+    then resuming with ``skip_batches=k``, must land on the EXACT state an
+    uninterrupted epoch reaches — no batch trained twice, none dropped,
+    and the augmentation RNG consumed identically (the skip path draws
+    and discards, rather than index-skipping, for precisely that reason).
+    """
+    from tpudp.data.cifar10 import Dataset
+    from tpudp.data.loader import DataLoader
+
+    rng = np.random.default_rng(3)
+    images = rng.integers(0, 256, size=(96, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=96).astype(np.int32)
+    ds = Dataset(images, labels)
+    k, epoch = 2, 0
+
+    def make_trainer():
+        return Trainer(VGG11(), mesh4, "allreduce", log_every=2,
+                       log_fn=lambda s: None)
+
+    # Uninterrupted: one full epoch (96/16 = 6 batches).
+    t_full = make_trainer()
+    loader = DataLoader(ds, 16, train=True)
+    t_full.train_epoch(loader, epoch)
+    assert int(t_full.state.step) == 6
+
+    # Interrupted after k batches (same deterministic epoch order) ...
+    t_res = make_trainer()
+    loader2 = DataLoader(ds, 16, train=True)
+    loader2.set_epoch(epoch)
+    t_res._install_place_hook(loader2)
+    for i, (im, lb, _w) in enumerate(loader2):
+        if i >= k:
+            break
+        im, lb = t_res._device_batch(im, lb)
+        t_res.state, _ = t_res.train_step(t_res.state, im, lb)
+    assert int(t_res.state.step) == k
+    # ... then resumed with the fast-forward.
+    t_res.train_epoch(loader2, epoch, skip_batches=k)
+    assert int(t_res.state.step) == 6
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        t_full.state.params, t_res.state.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        t_full.state.batch_stats, t_res.state.batch_stats)

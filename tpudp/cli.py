@@ -232,6 +232,7 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
           f"test samples={len(test_set.images)}")
 
     start_epoch = 0
+    skip_first = 0  # mid-epoch fast-forward (emergency-dump resume)
     restored = False
     epoch_end_fn = None
     async_writer = None
@@ -277,8 +278,24 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                 os.rename(emerg, used)
                 clear_emergency_sentinel(args.checkpoint_dir)
             if not args.eval_only:
+                # Fast-forward instead of re-running the epoch head: the
+                # dump's optimizer-step counter is one per loader batch
+                # and the sampler order is deterministic per (seed,
+                # epoch), so the counter alone fixes the resume position
+                # — epoch = step // per_epoch, batches into it = step %
+                # per_epoch.  Derived from the counter rather than the
+                # step_N series on purpose: with --checkpoint-async the
+                # dump can be AHEAD of the newest finalized epoch
+                # checkpoint (the write was still in flight at the hang),
+                # and anchoring on the stale series would silently
+                # re-train the next epoch's head.  No batch is trained
+                # twice, none is dropped.
+                per_epoch = len(train_loader)
+                start_epoch = int(trainer.state.step) // per_epoch
+                skip_first = int(trainer.state.step) % per_epoch
                 print(f"[tpudp] resumed mid-epoch state from emergency dump "
-                      f"{emerg} (re-running epoch {start_epoch})")
+                      f"{emerg} (epoch {start_epoch}: fast-forwarding "
+                      f"{skip_first}/{per_epoch} already-trained batches)")
 
         if watchdog is not None:
             # Failure recovery (VERDICT r1 #9): a detected hang dumps the
@@ -363,7 +380,8 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     try:
         with trace(args.profile_dir):
             trainer.fit(train_loader, test_loader, epochs=args.epochs,
-                        start_epoch=start_epoch, epoch_end_fn=epoch_end_fn)
+                        start_epoch=start_epoch, epoch_end_fn=epoch_end_fn,
+                        skip_batches_first_epoch=skip_first)
     finally:
         if async_writer is not None:
             async_writer.close()  # join the last epoch's write

@@ -894,24 +894,42 @@ class Trainer:
         with open(self.metrics_jsonl, "a") as f:
             f.write(json.dumps(record) + "\n")
 
-    def train_epoch(self, loader, epoch: int = 0) -> float:
+    def train_epoch(self, loader, epoch: int = 0, *,
+                    skip_batches: int = 0) -> float:
         """One epoch; returns mean loss. Prints the reference's metric lines.
 
         In ``fused`` mode the host only synchronizes at window edges — steps
         are dispatched back-to-back and the cumulative device-side
         ``state.loss_sum`` is fetched once per window (one round trip per
         ``log_every`` steps), keeping the device pipeline full.
+
+        ``skip_batches`` fast-forwards a mid-epoch resume: the first K
+        batches of this epoch's (deterministic, seeded) data order are
+        drawn from the pipeline and DISCARDED, so training continues with
+        exactly the batches the interrupted run never consumed instead of
+        re-training the epoch's head twice.  Consuming rather than
+        index-skipping keeps every host-side RNG (augmentation draws) in
+        the same state as the uninterrupted run.
         """
         loader.set_epoch(epoch)
         self._install_place_hook(loader)
         fwd_t, bwd_t = 0.0, 0.0
         losses = []
         prev_loss_sum = float(self.state.loss_sum)
+        beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
+        batches = iter(loader)
+        if skip_batches:
+            skipped = 0
+            for skipped, _discard in enumerate(batches, start=1):
+                beat()  # host-side work only, but the watchdog must see life
+                if skipped >= skip_batches:
+                    break
+            self.log(f"[tpudp] fast-forwarded {skipped} already-trained "
+                     f"batches of epoch {epoch} (mid-epoch resume)")
         window_start = time.perf_counter()
         window_samples = 0
         it = 0
-        beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
-        for it, (images, labels, _w) in enumerate(loader, start=1):
+        for it, (images, labels, _w) in enumerate(batches, start=1):
             window_samples += _host_local_rows(images)
             images, labels = self._device_batch(images, labels)
             if self.timing_mode == "split":
@@ -999,10 +1017,14 @@ class Trainer:
         return avg_loss, accuracy
 
     def fit(self, train_loader, test_loader=None, epochs: int = 1,
-            *, start_epoch: int = 0, epoch_end_fn=None) -> None:
+            *, start_epoch: int = 0, epoch_end_fn=None,
+            skip_batches_first_epoch: int = 0) -> None:
         """The reference's epoch loop (``src/Part 2a/main.py:64-68``).
         ``start_epoch`` supports checkpoint resume; ``epoch_end_fn(epoch)``
-        runs after each epoch's eval (checkpoint hook).
+        runs after each epoch's eval (checkpoint hook);
+        ``skip_batches_first_epoch`` fast-forwards epoch ``start_epoch``
+        past batches an interrupted run already trained (mid-epoch
+        emergency-dump resume — see ``train_epoch``).
 
         With a watchdog attached, the whole loop runs under heartbeat
         monitoring: every train/eval iteration beats, so any blocking host
@@ -1013,16 +1035,18 @@ class Trainer:
             self.watchdog.arm()
         try:
             self._fit(train_loader, test_loader, epochs, start_epoch,
-                      epoch_end_fn)
+                      epoch_end_fn, skip_batches_first_epoch)
         finally:
             if self.watchdog is not None:
                 self.watchdog.disarm()
 
     def _fit(self, train_loader, test_loader, epochs, start_epoch,
-             epoch_end_fn) -> None:
+             epoch_end_fn, skip_first=0) -> None:
         for epoch in range(start_epoch, epochs):
             start = time.perf_counter()
-            self.train_epoch(train_loader, epoch)
+            self.train_epoch(train_loader, epoch,
+                             skip_batches=skip_first if epoch == start_epoch
+                             else 0)
             fetch_fence(self.state.params)  # honest epoch wall-time edge
             epoch_s = time.perf_counter() - start
             self.log(
