@@ -1,0 +1,246 @@
+"""Elastic mesh resume: checkpoints are mesh-size portable.
+
+The reference fixes world size at launch and can never change it — the
+process group is created with a static ``world_size`` and a dead or
+added node means starting over (``src/Part 2a/main.py:152,160-161``;
+SURVEY.md §5 "world size is fixed at launch").  Here the TrainState is a
+pytree of arrays whose SAVED form is topology-free: ``restore_checkpoint``
+rebuilds every leaf with the CURRENT target's sharding
+(``tpudp/utils/checkpoint.py::restore_checkpoint``), so a run
+checkpointed on an N-device mesh resumes on an M-device mesh — fewer
+chips after a failure, more after a scale-up — with the training
+trajectory preserved.
+
+Two rungs pinned:
+  * plain DP (replicated state): the restored run must continue the
+    uninterrupted trajectory to tolerance (DP mean-gradient math is
+    mesh-size independent at fixed global batch);
+  * ZeRO-1 (optimizer state SHARDED over the data axis): the 8-way
+    momentum shards must reassemble and re-shard 4-way, and the
+    continued run must still track the replicated-DP oracle.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tpudp.mesh import make_mesh
+from tpudp.models.gpt2 import gpt2_small
+from tpudp.parallel.sync import get_sync
+from tpudp.train import (_loss_and_updates, init_state, make_optimizer,
+                         make_train_step, make_zero1_train_step)
+from tpudp.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+TINY = dict(vocab_size=64, max_seq_len=32, num_layers=2, num_heads=4,
+            d_model=32)
+
+
+class _MLP(nn.Module):
+    """BN-free so the DP trajectory is exactly mesh-size independent."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def _image_batches(num, batch=32, seed=11):
+    rng = np.random.default_rng(seed)
+    return [
+        (jnp.asarray(rng.normal(size=(batch, 32, 32, 3)), jnp.float32),
+         jnp.asarray(rng.integers(0, 10, size=batch), jnp.int32))
+        for _ in range(num)
+    ]
+
+
+def _token_batches(num, batch=8, t=16, vocab=64, seed=12):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, size=(num, batch, t)).astype(np.int32)
+    return [(jnp.asarray(x), jnp.roll(jnp.asarray(x), -1, axis=1))
+            for x in toks]
+
+
+def test_dp_checkpoint_restores_onto_smaller_mesh(tmp_path):
+    model, tx = _MLP(), make_optimizer()
+    batches = _image_batches(4)
+    mesh8, mesh4 = make_mesh(8), make_mesh(4)
+
+    # Uninterrupted oracle: all 4 steps on the 4-device mesh.
+    oracle = init_state(model, tx, seed=0)
+    step4 = make_train_step(model, tx, mesh4, "allreduce", donate=False)
+    for x, y in batches:
+        oracle, _ = step4(oracle, x, y)
+
+    # 2 steps on 8 devices -> checkpoint -> "the pod shrank" -> restore on
+    # 4 devices (fresh state with a DIFFERENT seed, proving restore
+    # overwrites every leaf) -> 2 more steps.
+    s8 = init_state(model, tx, seed=0)
+    step8 = make_train_step(model, tx, mesh8, "allreduce", donate=False)
+    for x, y in batches[:2]:
+        s8, _ = step8(s8, x, y)
+    save_checkpoint(tmp_path / "ck", s8)
+
+    # The target carries the CURRENT topology's shardings (replicated over
+    # the 4-device mesh — what the DP shard_map step expects); restore
+    # reassembles the 8-device checkpoint onto it.
+    target = jax.device_put(
+        init_state(model, tx, seed=123),
+        jax.sharding.NamedSharding(mesh4, P()))
+    resumed = restore_checkpoint(tmp_path / "ck", target)
+    assert int(resumed.step) == 2
+    for x, y in batches[2:]:
+        resumed, _ = step4(resumed, x, y)
+
+    assert int(resumed.step) == int(oracle.step) == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        resumed.params, oracle.params)
+
+
+def test_zero1_sharded_optimizer_state_reshards_across_mesh_sizes(tmp_path):
+    model = gpt2_small(**TINY)
+    tx = make_optimizer(learning_rate=0.01)
+    batches = _token_batches(4, vocab=TINY["vocab_size"])
+    mesh8, mesh4 = make_mesh(8), make_mesh(4)
+
+    # Replicated-DP oracle (zero1 is trajectory-exact vs DP).
+    oracle = init_state(model, tx, input_shape=(1, 8), seed=0)
+
+    @jax.jit
+    def ref_step(state, x, y):
+        return _loss_and_updates(model, tx, state, x, y, get_sync("none"),
+                                 None)
+
+    for x, y in batches:
+        oracle, _ = ref_step(oracle, x, y)
+
+    # 2 steps with momentum sharded 8-way -> checkpoint -> restore with
+    # momentum sharded 4-way -> 2 more steps.
+    z8_state, z8_step = make_zero1_train_step(
+        model, tx, mesh8, init_state(model, tx, input_shape=(1, 8), seed=0),
+        min_size=128, donate=False)
+    for x, y in batches[:2]:
+        z8_state, _ = z8_step(z8_state, x, y)
+    save_checkpoint(tmp_path / "ck", z8_state)
+
+    z4_target, z4_step = make_zero1_train_step(
+        model, tx, mesh4, init_state(model, tx, input_shape=(1, 8), seed=123),
+        min_size=128, donate=False)
+    resumed = restore_checkpoint(tmp_path / "ck", z4_target)
+
+    # The momentum leaf really changed topology: 8-way -> 4-way shards.
+    trace_wte = None
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            resumed.opt_state)[0]:
+        if "wte" in jax.tree_util.keystr(path):
+            trace_wte = leaf
+    assert trace_wte is not None and trace_wte.sharding.spec == P("data")
+    assert {s.data.shape[0] for s in trace_wte.addressable_shards} == {64 // 4}
+
+    for x, y in batches[2:]:
+        resumed, _ = z4_step(resumed, x, y)
+
+    np.testing.assert_allclose(
+        np.asarray(resumed.params["h_0"]["mlp_fc"]["kernel"]),
+        np.asarray(oracle.params["h_0"]["mlp_fc"]["kernel"]), atol=2e-4)
+
+
+import pytest
+
+
+@pytest.mark.slow
+def test_true_pod_shrink_across_processes(tmp_path):
+    """The REAL elastic scenario: the save-time process (8 virtual
+    devices) is gone, and the restore happens in a NEW process that has
+    only 4 — the recorded 8-device sharding names devices that no longer
+    exist, so the restore must deserialize straight onto the current
+    topology via the placed target.  In-process subset meshes cannot
+    catch this (orbax can still reconstruct the recorded sharding while
+    all 8 devices are alive)."""
+    import subprocess
+    import sys
+
+    script = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, {repo!r})
+from tpudp.mesh import make_mesh
+from tpudp.train import init_state, make_optimizer, make_train_step
+from tpudp.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def batches(num, batch=32, seed=11):
+    rng = np.random.default_rng(seed)
+    return [
+        (jnp.asarray(rng.normal(size=(batch, 32, 32, 3)), jnp.float32),
+         jnp.asarray(rng.integers(0, 10, size=batch), jnp.int32))
+        for _ in range(num)
+    ]
+
+
+mode, ck, out = sys.argv[1], sys.argv[2], sys.argv[3]
+model, tx = MLP(), make_optimizer()
+bs = batches(4)
+mesh = make_mesh()  # ALL this process's devices: 8 on save, 4 on restore
+step = make_train_step(model, tx, mesh, "allreduce", donate=False)
+if mode == "save":
+    state = init_state(model, tx, seed=0)
+    for x, y in bs[:2]:
+        state, _ = step(state, x, y)
+    save_checkpoint(ck, state)
+    # The oracle the restore side must match: all 4 steps, uninterrupted
+    # (DP trajectory is mesh-size independent at fixed global batch).
+    oracle = init_state(model, tx, seed=0)
+    for x, y in bs:
+        oracle, _ = step(oracle, x, y)
+    np.save(out, np.asarray(oracle.params["Dense_0"]["kernel"]))
+else:
+    target = jax.device_put(init_state(model, tx, seed=123),
+                            NamedSharding(mesh, P()))
+    state = restore_checkpoint(ck, target)
+    assert int(state.step) == 2, int(state.step)
+    for x, y in bs[2:]:
+        state, _ = step(state, x, y)
+    np.save(out, np.asarray(state.params["Dense_0"]["kernel"]))
+"""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = script.format(repo=repo)
+    ck = str(tmp_path / "ck")
+
+    def run(mode, n_dev, out):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_dev}"
+        proc = subprocess.run(
+            [sys.executable, "-c", script, mode, ck, out],
+            capture_output=True, text=True, env=env, timeout=900)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+    oracle_npy = str(tmp_path / "oracle.npy")
+    resumed_npy = str(tmp_path / "resumed.npy")
+    run("save", 8, oracle_npy)
+    run("restore", 4, resumed_npy)
+
+    np.testing.assert_allclose(np.load(resumed_npy), np.load(oracle_npy),
+                               rtol=1e-4, atol=1e-5)

@@ -800,6 +800,24 @@ class Trainer:
                 from tpudp.parallel.compress import state_partition_specs
 
                 state_specs = state_partition_specs(self.state)
+            # COMMIT the state to its topology (replicated over the mesh;
+            # EF-compress residuals follow their stacked per-device specs;
+            # single-device runs pin the default device).  A committed
+            # state is what makes checkpoint restore ELASTIC: its
+            # shardings are forwarded to orbax's deserialization layer,
+            # so a checkpoint saved at N devices materializes directly on
+            # THIS topology — an uncommitted target would fall back to
+            # the recorded sharding, which names save-time devices that
+            # may no longer exist (tpudp/utils/checkpoint.py).
+            if mesh is not None:
+                self.state = jax.device_put(
+                    self.state,
+                    jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                 state_specs)
+                    if state_specs is not None
+                    else NamedSharding(mesh, P()))
+            else:
+                self.state = jax.device_put(self.state, jax.devices()[0])
             self.train_step = make_train_step(
                 model, self.tx, mesh, sync, spmd_mode=spmd_mode,
                 donate=(timing_mode != "split"), grad_accum=grad_accum,

@@ -46,16 +46,30 @@ def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
         raise RuntimeError("orbax-checkpoint is not installed")
     path = os.path.abspath(os.fspath(path))
 
+    # ELASTIC resume (checkpoint saved on an N-device mesh, restored on an
+    # M-device one — the pod shrank after a failure, or grew): the sharding
+    # must reach orbax's DESERIALIZATION layer via restore_args, so each
+    # array materializes directly on the CURRENT topology; the recorded
+    # sharding file names save-time devices that may no longer exist, and
+    # post-hoc device_put never runs if deserialization already failed.
+    # A COMMITTED target leaf is an intentional statement of the current
+    # topology — its sharding is forwarded.  An UNCOMMITTED leaf (fresh
+    # init_state before any mesh placement, e.g. the same-topology CLI
+    # resume path) carries no placement intent: no sharding is forwarded
+    # and orbax falls back to the checkpoint's recorded sharding, which is
+    # only valid while the save-time devices still exist — elastic flows
+    # must pass a placed target.
     def as_abstract(x):
         if isinstance(x, jax.Array):
-            # Keep the target's sharding so restore places arrays on the
-            # CURRENT topology instead of whatever the checkpoint recorded
-            # (which is unsafe when resuming on a different mesh).
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding if x.committed
+                                        else None)
         return ocp.utils.to_shape_dtype_struct(x)
 
     abstract = jax.tree.map(as_abstract, target)
-    return _checkpointer().restore(path, item=abstract)
+    restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
+    return _checkpointer().restore(path, item=abstract,
+                                   restore_args=restore_args)
 
 
 class AsyncCheckpointWriter:
