@@ -24,6 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from tpudp.mesh import make_mesh
@@ -150,9 +151,6 @@ def test_zero1_sharded_optimizer_state_reshards_across_mesh_sizes(tmp_path):
         np.asarray(oracle.params["h_0"]["mlp_fc"]["kernel"]), atol=2e-4)
 
 
-import pytest
-
-
 @pytest.mark.slow
 def test_true_pod_shrink_across_processes(tmp_path):
     """The REAL elastic scenario: the save-time process (8 virtual
@@ -244,3 +242,36 @@ else:
 
     np.testing.assert_allclose(np.load(resumed_npy), np.load(oracle_npy),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_cli_resume_on_fewer_devices(tmp_path):
+    """PRODUCTION elastic path: the Part 2b trainer checkpoints on an
+    8-device process, then a NEW 4-device process resumes from that
+    checkpoint directory (the trainer state is mesh-committed at init, so
+    the restore deserializes onto the shrunken topology directly)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ck = str(tmp_path / "ckpt")
+
+    def run(n_dev, epochs):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_dev}"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "src", "Part 2b", "main.py"),
+             "--platform", "cpu", "--synthetic-train-size", "128",
+             "--synthetic-test-size", "64", "--batch-size", "32",
+             "--epochs", str(epochs), "--checkpoint-dir", ck],
+            capture_output=True, text=True, env=env, timeout=1500, cwd=repo)
+        assert proc.returncode == 0, (proc.stdout[-2000:],
+                                      proc.stderr[-2000:])
+        return proc.stdout
+
+    run(8, 1)
+    assert os.path.isdir(os.path.join(ck, "step_1"))
+    out = run(4, 2)  # resumes at epoch 1, trains epoch 2 on 4 devices
+    assert "resumed from" in out and "step_1" in out
+    assert "Training time after 2 epoch" in out
