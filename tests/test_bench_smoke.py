@@ -112,3 +112,16 @@ def test_matrix_bench_rows_parse():
     assert configs["dp_psum"]["devices"] == 4
     # the DP rows carry the measured collective wall time
     assert configs["dp_ring"]["grad_allreduce_wall_time_s"] > 0
+
+
+def test_bad_param_dtype_fails_fast():
+    """BENCH_PARAM_DTYPE typos (e.g. 'bf16') must exit with an error before
+    any measurement — a silent fp32 run recorded as 'bf16' would be a false
+    evidence row (same contract as _requested_sync for BENCH_SYNC)."""
+    proc = _run("bench.py", {
+        "BENCH_PLATFORM": "cpu",
+        "BENCH_PARAM_DTYPE": "bf16",
+        "BENCH_PROBE": "0",
+    }, timeout=300)
+    assert proc.returncode != 0
+    assert "BENCH_PARAM_DTYPE" in (proc.stderr + proc.stdout)
