@@ -63,8 +63,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # Measured by benchmarks/torch_reference_bench.py on this machine (1-core
 # CPU host; reference config: batch 256, 4 torch threads).  Recorded in
-# BASELINE.md.  4-node Gloo upper bound = 4 * single-process.
-TORCH_CPU_IMAGES_PER_SEC = 66.17
+# BASELINE.md.  4-node Gloo upper bound = 4 * single-process.  Two
+# measurements exist (66.17 on 2026-07-29 under session load, 92.42 on
+# 2026-07-31 on an idle host); the FASTER one is used — the conservative
+# choice for our ratio, since a stronger baseline lowers vs_baseline.
+TORCH_CPU_IMAGES_PER_SEC = 92.42
 BASELINE_4NODE_GLOO_IPS = 4 * TORCH_CPU_IMAGES_PER_SEC
 
 METRIC = "vgg11_cifar10_images_per_sec_per_chip"
@@ -360,6 +363,20 @@ def _emit_banked(banked: dict, why: str) -> None:
     out = dict(banked)
     out["source"] = "last_known_good"
     out["stale_reason"] = why
+    # The baseline denominator can be re-measured between capture and
+    # re-emission (it was: 66.17 -> 92.42 img/s on 2026-07-31).  Re-state
+    # the ratio against the CURRENT denominator so the artifact matches
+    # bench.py's documented baseline, keeping the at-capture values for
+    # the audit trail.
+    ips = out.get("images_per_sec_total", out.get("value"))
+    if (isinstance(ips, (int, float)) and ips > 0
+            and out.get("baseline_4node_gloo_images_per_sec")
+            != BASELINE_4NODE_GLOO_IPS):
+        out["vs_baseline_at_capture"] = out.get("vs_baseline")
+        out["baseline_at_capture"] = out.get(
+            "baseline_4node_gloo_images_per_sec")
+        out["vs_baseline"] = round(ips / BASELINE_4NODE_GLOO_IPS, 2)
+        out["baseline_4node_gloo_images_per_sec"] = BASELINE_4NODE_GLOO_IPS
     print(json.dumps(out))
     sys.exit(0)
 
