@@ -46,3 +46,37 @@ def test_eval_only_empty_checkpoint_dir_errors(tmp_path):
 
     with pytest.raises(SystemExit, match="no checkpoint"):
         run_part("allreduce", "t", argv=_argv(tmp_path, "--eval-only"))
+
+
+def test_emergency_resume_fast_forwards_cli(tmp_path, capsys):
+    """CLI wiring of the mid-epoch fast-forward: an emergency dump whose
+    optimizer-step counter sits 2 batches into epoch 1 must resume at
+    epoch 1 skipping exactly those 2 of 4 batches (step // per_epoch and
+    step % per_epoch derivation in tpudp/cli.py), then finish the epoch —
+    no batch trained twice."""
+    import jax.numpy as jnp
+
+    from tpudp.utils.checkpoint import (clear_emergency_sentinel,
+                                        save_checkpoint,
+                                        write_emergency_sentinel)
+
+    argv = ["--synthetic-train-size", "128", "--synthetic-test-size", "64",
+            "--batch-size", "32", "--checkpoint-dir", str(tmp_path / "ckpt")]
+    trained = run_part("allreduce", "t", argv=argv)  # epoch 0 -> step_1
+    assert int(trained.state.step) == 4  # 128/32 batches per epoch
+
+    # Manufacture the watchdog's mid-epoch dump: 2 batches into epoch 1.
+    root = str(tmp_path / "ckpt")
+    dumped = trained.state.replace(step=jnp.asarray(6, jnp.int32))
+    clear_emergency_sentinel(root)
+    save_checkpoint(f"{root}/emergency", dumped)
+    write_emergency_sentinel(root, step=6)
+    capsys.readouterr()
+
+    resumed = run_part("allreduce", "t", argv=argv + ["--epochs", "2"])
+    out = capsys.readouterr().out
+    assert "fast-forwarding 2/4 already-trained batches" in out
+    assert "fast-forwarded 2 already-trained batches of epoch 1" in out
+    # 6 (dump) + the 2 never-trained batches of epoch 1 = 8, and nothing
+    # beyond: epoch 1 completed exactly once.
+    assert int(resumed.state.step) == 8
