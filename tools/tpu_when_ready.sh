@@ -166,6 +166,23 @@ while true; do
         continue
       fi
     fi
+    # Stage order = round-4 capture priority (VERDICT #1): headline first,
+    # then MFU attribution (the open round-2 directive), then matrix,
+    # epoch, flash — so a short window banks the highest-value evidence.
+    if mfu_ok; then
+      log "mfu.jsonl already good; skipping mfu attribution"
+    else
+      bank bench_results/mfu.jsonl
+      ensure_window
+      timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/mfu_attribution.py \
+        > bench_results/mfu.jsonl 2> bench_results/mfu.err
+      log "mfu_attribution rc=$? -> bench_results/mfu.jsonl"
+      if ! mfu_ok && ! probe; then
+        log "mfu attribution died and relay unhealthy; re-entering wait loop"
+        sleep "$PERIOD" 9>&-
+        continue
+      fi
+    fi
     if matrix_ok; then
       log "matrix.jsonl already good; skipping matrix_bench"
     else
@@ -184,6 +201,15 @@ while true; do
         continue
       fi
     fi
+    if epoch_ok; then
+      log "epoch.json already good; skipping epoch bench"
+    else
+      bank bench_results/epoch.json
+      ensure_window
+      timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/epoch_bench.py \
+        > bench_results/epoch.json 2> bench_results/epoch.err
+      log "epoch_bench rc=$? -> bench_results/epoch.json"
+    fi
     if flash_ok; then
       log "flash.jsonl already good; skipping flash bench"
     else
@@ -194,24 +220,6 @@ while true; do
         $(python tools/bench_gaps.py flash) \
         > bench_results/flash.jsonl 2> bench_results/flash.err
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
-    fi
-    if epoch_ok; then
-      log "epoch.json already good; skipping epoch bench"
-    else
-      bank bench_results/epoch.json
-      ensure_window
-      timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/epoch_bench.py \
-        > bench_results/epoch.json 2> bench_results/epoch.err
-      log "epoch_bench rc=$? -> bench_results/epoch.json"
-    fi
-    if mfu_ok; then
-      log "mfu.jsonl already good; skipping mfu attribution"
-    else
-      bank bench_results/mfu.jsonl
-      ensure_window
-      timeout -k "$GRACE" "$(stage_t 1500)" python benchmarks/mfu_attribution.py \
-        > bench_results/mfu.jsonl 2> bench_results/mfu.err
-      log "mfu_attribution rc=$? -> bench_results/mfu.jsonl"
     fi
     # Exit only when every stage holds a complete result; otherwise keep
     # waiting for the next window (a stage that died on a healthy relay —
