@@ -1,8 +1,18 @@
 """Part 3 — automatic, compiler-scheduled gradient sync (reference: src/Part 3/main.py:61).
 
-The DDP rung: the whole train step is one XLA program compiled via GSPMD
-(jit + sharding annotations, no explicit collectives) so the compiler
-inserts and overlaps the gradient all-reduce with the backward pass.
+The DDP rung: no manual sync call in the train loop — the collective is
+scheduled for you.  Default ``spmd_mode='shard_map'``: the step carries an
+explicit psum that XLA overlaps with the backward pass (the TPU equivalent
+of DDP's bucketed C++ reducer), and BatchNorm keeps the reference's LOCAL
+per-rank batch statistics (DDP syncs gradients only — never BN stats).
+
+``--spmd-mode gspmd`` selects the fully compiler-partitioned path (jit +
+sharding annotations, zero explicit collectives).  Same gradient math, but
+BatchNorm then normalizes over the GLOBAL batch (SyncBN-like semantics,
+because the program is written over the global batch) — a documented
+semantic variant, pinned by tests/test_train.py::
+test_gspmd_bn_is_syncbn_semantics and bounded against the ladder by
+test_gspmd_bn_close_to_shard_map_on_vgg.
 """
 import os
 import sys
@@ -12,5 +22,4 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 from tpudp.cli import run_part
 
 if __name__ == "__main__":
-    run_part("auto", "Part 3: DP with automatic (GSPMD) grad sync",
-             spmd_mode="gspmd")
+    run_part("auto", "Part 3: DP with automatic (compiler-scheduled) grad sync")

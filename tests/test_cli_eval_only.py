@@ -80,3 +80,43 @@ def test_emergency_resume_fast_forwards_cli(tmp_path, capsys):
     # 6 (dump) + the 2 never-trained batches of epoch 1 = 8, and nothing
     # beyond: epoch 1 completed exactly once.
     assert int(resumed.state.step) == 8
+
+
+def test_emergency_resume_refuses_changed_batch_grid(tmp_path, capsys):
+    """Round-3 advisor: the fast-forward maps the dump's step counter onto
+    the loader's batch grid, so a relaunch with a different batches/epoch
+    (changed --batch-size here) must REFUSE instead of silently
+    re-training or dropping batches — and must leave the dump in place so
+    a correctly-configured relaunch can still consume it."""
+    import os
+
+    import jax.numpy as jnp
+    import pytest
+
+    from tpudp.utils.checkpoint import (clear_emergency_sentinel,
+                                        save_checkpoint,
+                                        write_emergency_sentinel)
+
+    argv = ["--synthetic-train-size", "128", "--synthetic-test-size", "64",
+            "--batch-size", "32", "--checkpoint-dir", str(tmp_path / "ckpt")]
+    trained = run_part("allreduce", "t", argv=argv)  # 4 batches/epoch
+    root = str(tmp_path / "ckpt")
+    dumped = trained.state.replace(step=jnp.asarray(6, jnp.int32))
+    clear_emergency_sentinel(root)
+    save_checkpoint(f"{root}/emergency", dumped)
+    write_emergency_sentinel(root, step=6, per_epoch_batches=4)
+    capsys.readouterr()
+
+    with pytest.raises(SystemExit, match="batches/epoch"):
+        run_part("allreduce", "t",
+                 argv=["--synthetic-train-size", "128",
+                       "--synthetic-test-size", "64", "--batch-size", "16",
+                       "--checkpoint-dir", root, "--epochs", "2"])
+    # The refusal happened BEFORE the dump was consumed.
+    assert os.path.isdir(f"{root}/emergency")
+    capsys.readouterr()
+
+    resumed = run_part("allreduce", "t", argv=argv + ["--epochs", "2"])
+    out = capsys.readouterr().out
+    assert "fast-forwarding 2/4 already-trained batches" in out
+    assert int(resumed.state.step) == 8

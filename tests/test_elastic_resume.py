@@ -20,6 +20,11 @@ Two rungs pinned:
     continued run must still track the replicated-DP oracle.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute/subprocess tier (VERDICT r3 #6);
+# deselect with -m "not slow" for the <15-min pass
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

@@ -7,6 +7,11 @@ examples own their platform/device setup) for a couple of tiny steps on
 the simulated mesh and must log finite losses.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute/subprocess tier (VERDICT r3 #6);
+# deselect with -m "not slow" for the <15-min pass
+
 import os
 import re
 import subprocess

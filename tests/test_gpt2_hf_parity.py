@@ -9,6 +9,11 @@ HF's Conv1D stores weights (in, out), the same layout as flax Dense
 kernels, so the transplant needs no transposes.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute/subprocess tier (VERDICT r3 #6);
+# deselect with -m "not slow" for the <15-min pass
+
 import numpy as np
 import pytest
 

@@ -7,6 +7,11 @@ This is the reference's defining UX — N processes, ``--master``/``--rank``
 (``src/Part 2a/main.py:148-153``) — executed end-to-end, not just unit
 -tested."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute/subprocess tier (VERDICT r3 #6);
+# deselect with -m "not slow" for the <15-min pass
+
 import json
 import os
 import socket

@@ -27,7 +27,7 @@ def _run_sync(mesh, name, tree):
 
 
 @pytest.mark.parametrize("name", ["coordinator", "allreduce", "ring",
-                                  "ring_uni", "allreduce_hd",
+                                  "ring_uni", "ring_bidir", "allreduce_hd",
                                   "allreduce_a2a", "auto"])
 def test_strategies_produce_mean(mesh8, name):
     n = mesh8.size

@@ -24,6 +24,11 @@ Two deliberate differences from the short test:
   (zero-egress images usually lack it).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute/subprocess tier (VERDICT r3 #6);
+# deselect with -m "not slow" for the <15-min pass
+
 import os
 
 import numpy as np
@@ -43,13 +48,16 @@ BATCH, STEPS, LR, MOM, WD = 64, 50, 0.01, 0.9, 1e-4
 TEST_N = 1024
 
 
-def _synthetic_learnable(rng, n, protos):
+def _synthetic_learnable(rng, n, protos, scale=0.5):
     """Class-prototype images: learnable, so accuracy parity is
     informative.  ``protos`` must be SHARED between the train and test
     draws — freshly drawn prototypes would make the test set a different
-    task and pin both stacks at chance."""
+    task and pin both stacks at chance.  ``scale`` sets the
+    signal-to-noise ratio: 0.5 saturates (99%+ accuracy), smaller values
+    leave the run mid-learning-curve where accuracy parity is a real
+    comparison (the non-saturating variant below)."""
     y = rng.integers(0, 10, size=n)
-    x = (protos[y] * 0.5
+    x = (protos[y] * scale
          + rng.normal(size=(n, 32, 32, 3))).astype(np.float32)
     return x, y.astype(np.int64)
 
@@ -72,9 +80,10 @@ def _jax_accuracy(model, state, x, y):
     return correct / len(y)
 
 
-def _run_both(train_x, train_y, test_x, test_y):
-    """Transplant-initialize both stacks, train 50 identical steps, return
-    (per-step torch losses, per-step jax losses, torch acc, jax acc)."""
+def _run_both(train_x, train_y, test_x, test_y, steps=STEPS):
+    """Transplant-initialize both stacks, train ``steps`` identical steps,
+    return (per-step torch losses, per-step jax losses, torch acc,
+    jax acc)."""
     torch.manual_seed(0)
     torch.set_num_threads(1)
     tmodel = TorchVGG(CONFIGS["VGG11"])
@@ -84,8 +93,8 @@ def _run_both(train_x, train_y, test_x, test_y):
     params, bs = transplant(tmodel, state.params, state.batch_stats)
     state = state.replace(params=params, batch_stats=bs)
 
-    xs = train_x.reshape(STEPS, BATCH, 32, 32, 3)
-    ys = train_y.reshape(STEPS, BATCH)
+    xs = train_x.reshape(steps, BATCH, 32, 32, 3)
+    ys = train_y.reshape(steps, BATCH)
 
     tmodel.train()
     opt = torch.optim.SGD(tmodel.parameters(), lr=LR, momentum=MOM,

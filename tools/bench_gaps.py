@@ -75,6 +75,8 @@ def measured(r: dict) -> bool:
         return r.get("value", 0) > 0
     if "variant" in r:  # mfu_attribution.py rows
         return r.get("sec_per_step", 0) > 0
+    if "strategy" in r:  # collective_bench.py rows
+        return r.get("wall_time_s", 0) > 0
     return False
 
 
@@ -123,9 +125,36 @@ def mfu_missing(d: str) -> bool:
     return not (need <= have and "bf16_params" in attempted)
 
 
+def collective_missing(d: str) -> bool:
+    """Ring-vs-psum head-to-head (VERDICT r3 #5: back the ring default
+    with a number).  Complete once the three key schedules each hold a
+    real multi-device TPU measurement (simulated CPU-mesh sweeps never
+    satisfy the gate, same rule as mfu_missing) — or once collective_bench
+    has recorded its labeled single-device skip row AND the most recent
+    healthy probe still saw a 1-device slice (on 1 chip every collective
+    compiles to a no-op; the HLO evidence in BASELINE.md is the backing
+    instead).  A probe that sees a multi-chip slice re-opens the stage:
+    the skip row must not mask the measurement it exists to schedule."""
+    rows = list(rows_with_history(os.path.join(d, "collective.jsonl")))
+    have = {r.get("strategy") for r in rows
+            if measured(r) and r.get("devices", 0) > 1
+            and "TPU" in str(r.get("device_kind", ""))}
+    if {"allreduce", "ring", "ring_bidir"} <= have:
+        return False
+    try:
+        with open(os.path.join(d, "probe.json")) as f:
+            probed_devices = json.load(f).get("devices")
+    except (OSError, json.JSONDecodeError):
+        probed_devices = None
+    if probed_devices is not None and probed_devices > 1:
+        return True
+    return not any(r.get("skipped") and r.get("devices") == 1 for r in rows)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu"])
+    p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
+                                     "collective"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -134,6 +163,8 @@ def main() -> None:
         print("epoch" if epoch_missing(args.dir) else "", end="")
     elif args.stage == "mfu":
         print("mfu" if mfu_missing(args.dir) else "", end="")
+    elif args.stage == "collective":
+        print("collective" if collective_missing(args.dir) else "", end="")
     else:
         print(" ".join(str(t) for t in flash_missing(args.dir)), end="")
 

@@ -1,0 +1,104 @@
+"""HLO-level evidence for the ring-schedule default (round-3 VERDICT #5).
+
+A 1-real-chip host cannot time multi-device collectives (they compile to
+no-ops), so this tool records the *compiler-level* facts that justify the
+single-direction ring default: for each schedule, the number of
+collective ops in the optimized HLO (every collective-permute is one
+serial dispatch on the transport) and the bytes each moves.  Runs on the
+simulated N-device CPU mesh — op structure, unlike wall time, is
+identical in kind to what the TPU backend schedules.
+
+Facts it shows (N=8, one flat buffer):
+  * ring (single-direction): 2(N-1) = 14 collective-permutes, each moving
+    payload/N bytes.
+  * ring_bidir: 4(N-1) = 28 collective-permutes, each moving payload/2N —
+    same total wire bytes, double the dispatches.  The win claimed for a
+    real torus (both ICI directions in flight) exists only if the
+    transport runs paired ops concurrently; XLA:CPU does not fuse the two
+    directions' permutes into one op, so on every mesh measured so far
+    the doubled dispatch count costs ~1.6x wall time (BASELINE.md).
+  * psum: ONE all-reduce op — the fused-transport baseline.
+
+One JSON line per schedule; `python tools/ring_hlo_evidence.py [N] [elems]`.
+"""
+
+import collections
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    elems = int(sys.argv[2]) if len(sys.argv) > 2 else 262_144  # 1 MiB fp32
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpudp.mesh import DATA_AXIS, make_mesh
+    from tpudp.parallel.ring import ring_all_reduce
+
+    mesh = make_mesh(n)
+    # REPLICATED input — the sync path's real shape: in DP every device
+    # holds the full gradient tree, and the ring moves payload/N (uni) or
+    # payload/2N (bidir) per permute.  (A P(data)-sharded input would make
+    # each device's buffer elems/N and silently shrink every quoted
+    # bytes/op by N — round-4 review finding.)
+    x = jnp.zeros((elems,), jnp.float32)
+
+    def compiled_text(body):
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                                   out_specs=P(), check_vma=False))
+        return fn.lower(x).compile().as_text()
+
+    schedules = {
+        "ring": lambda xs: ring_all_reduce(xs, DATA_AXIS),
+        "ring_bidir": lambda xs: ring_all_reduce(xs, DATA_AXIS,
+                                                 bidirectional=True),
+        "psum": lambda xs: jax.lax.psum(xs, DATA_AXIS),
+    }
+    # Count collective ops in the optimized HLO.  Op spellings vary by
+    # backend version (collective-permute vs collective-permute-start),
+    # so match the family prefix on instruction lines (`= <shape> op-name(`,
+    # excluding -done halves of async pairs so one logical op counts once).
+    families = ("collective-permute", "all-reduce", "all-gather",
+                "all-to-all", "reduce-scatter")
+    op_re = re.compile(
+        r"=\s+\S+\s+(" + "|".join(families) + r")(?:-start)?\(")
+
+    # Read the permute payload FROM the HLO rather than asserting
+    # arithmetic: the result shape on collective-permute instruction lines
+    # (`%ppermute.42 = f32[32768]{0} collective-permute(...)`).
+    shape_re = re.compile(
+        r"=\s+f32\[(\d+)\]\S*\s+collective-permute(?:-start)?\(")
+
+    for name, body in schedules.items():
+        text = compiled_text(body)
+        counts = collections.Counter(m.group(1)
+                                     for m in op_re.finditer(text))
+        permute_elems = sorted({int(m.group(1))
+                                for m in shape_re.finditer(text)})
+        row = {
+            "schedule": name,
+            "devices": n,
+            "payload_bytes": elems * 4,
+            "collective_ops": dict(sorted(counts.items())),
+            "total_collective_dispatches": sum(counts.values()),
+        }
+        if permute_elems:
+            row["bytes_per_permute_from_hlo"] = [e * 4 for e in permute_elems]
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,26 @@ def main() -> None:
         assert d and d[0].platform != "cpu", f"no accelerator: {d}"
         x = jnp.ones((256, 256), jnp.bfloat16)
         np.asarray(jnp.sum(x @ x))
+        # Record what a healthy window looks like for the stdlib-only gap
+        # gates: bench_gaps.py 'collective' lets a 1-device skip row
+        # satisfy the stage ONLY while the attached slice really has one
+        # device — the moment a probe sees a multi-chip slice, the
+        # ring-vs-psum head-to-head is owed again.  Best-effort: the probe
+        # verdict must never depend on this write.
+        try:
+            import json
+            import time
+
+            here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            os.makedirs(os.path.join(here, "bench_results"), exist_ok=True)
+            with open(os.path.join(here, "bench_results", "probe.json"),
+                      "w") as f:
+                json.dump({"devices": len(d),
+                           "device_kind": d[0].device_kind,
+                           "probed_at_utc": time.strftime(
+                               "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 if __name__ == "__main__":

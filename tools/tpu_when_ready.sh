@@ -87,6 +87,10 @@ mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
 }
+collective_ok() {
+  local out; out=$(python tools/bench_gaps.py collective) || return 1
+  [ -z "$out" ]
+}
 # A retried stage truncates its result file; bank the partial rows first so
 # a window that died mid-matrix never erases already-measured configs
 # (gap computation and tools/record_bench.py read the history too).
@@ -221,10 +225,23 @@ while true; do
         > bench_results/flash.jsonl 2> bench_results/flash.err
       log "flash_attention_bench rc=$? -> bench_results/flash.jsonl"
     fi
+    if collective_ok; then
+      log "collective.jsonl already good; skipping collective bench"
+    else
+      # Ring-vs-psum head-to-head (VERDICT r3 #5).  On the 1-chip relay
+      # the bench emits a labeled skip row (nothing measurable; the HLO
+      # evidence in BASELINE.md backs the default instead); on a
+      # multi-chip slice it records the numbers the ring default follows.
+      bank bench_results/collective.jsonl
+      ensure_window
+      timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/collective_bench.py \
+        > bench_results/collective.jsonl 2> bench_results/collective.err
+      log "collective_bench rc=$? -> bench_results/collective.jsonl"
+    fi
     # Exit only when every stage holds a complete result; otherwise keep
     # waiting for the next window (a stage that died on a healthy relay —
     # e.g. per-stage timeout — must not end the watch with gaps).
-    if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok; then
+    if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok && collective_ok; then
       log "battery done"
       exit 0
     fi

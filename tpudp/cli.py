@@ -77,6 +77,18 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "like one device at batch B. Default keeps the "
                         "reference's local-stats semantics (src/Part "
                         "2a/main.py:59-68). shard_map rungs only")
+    p.add_argument("--spmd-mode", choices=["shard_map", "gspmd"],
+                   default=None,
+                   help="Part 3 (auto rung) only: how the compiler-"
+                        "scheduled sync is obtained. 'shard_map' (default) "
+                        "runs per-device with an explicit psum XLA overlaps "
+                        "— BatchNorm keeps the reference's LOCAL per-rank "
+                        "batch statistics (DDP syncs gradients only, src/"
+                        "Part 3/main.py:61). 'gspmd' lets XLA's partitioner "
+                        "insert the collectives from sharding annotations; "
+                        "note BatchNorm then normalizes over the GLOBAL "
+                        "batch (SyncBN-like semantics — pinned by tests/"
+                        "test_train.py::test_gspmd_bn_is_syncbn_semantics)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations during backward "
                         "(jax.checkpoint): identical gradients, lower peak "
@@ -123,6 +135,13 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     from tpudp.models import VGG11
 
     args = build_parser(description).parse_args(argv)
+    if args.spmd_mode is not None:
+        if sync != "auto":
+            raise SystemExit(
+                "error: --spmd-mode applies only to the Part 3 'auto' rung "
+                "(the other Parts' sync strategies are explicit shard_map "
+                "collectives by definition)")
+        spmd_mode = args.spmd_mode
     if args.checkpoint_async and not args.checkpoint_dir:
         raise SystemExit(
             "error: --checkpoint-async requires --checkpoint-dir (nothing "
@@ -254,6 +273,28 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         # resumes fall back to the regular epoch series.
         emerg = emergency_dir(args.checkpoint_dir)
         if emerg:
+            # Refuse a mismatched relaunch BEFORE the dump is consumed:
+            # the fast-forward below maps the optimizer-step counter onto
+            # the loader's batch grid, which only works if this relaunch
+            # has the same batches/epoch as the interrupted run (a changed
+            # --batch-size or train-set size would silently re-train or
+            # drop batches — round-3 advisor).  Old sentinels without the
+            # field skip the check (nothing to compare against).
+            from tpudp.utils.checkpoint import read_emergency_sentinel
+
+            sent = read_emergency_sentinel(args.checkpoint_dir) or {}
+            dumped_pe = sent.get("per_epoch_batches")
+            if (not args.eval_only and dumped_pe is not None
+                    and dumped_pe != len(train_loader)):
+                raise SystemExit(
+                    f"error: emergency dump at {emerg} was written with "
+                    f"{dumped_pe} batches/epoch but this relaunch has "
+                    f"{len(train_loader)} (different --batch-size or "
+                    "train-set size) — the dump's step counter cannot be "
+                    "mapped to a resume position on this batch grid. "
+                    "Relaunch with the original configuration, or remove "
+                    "the dump directory to restart the epoch from the "
+                    "last step_N checkpoint.")
             trainer.state = restore_checkpoint(emerg, trainer.state)
             restored = True
             if args.eval_only:
@@ -318,7 +359,8 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                     # Commit record: written only after orbax finalized.
                     write_emergency_sentinel(
                         args.checkpoint_dir,
-                        step=int(trainer.state.step))
+                        step=int(trainer.state.step),
+                        per_epoch_batches=len(train_loader))
                     print(f"[tpudp] emergency checkpoint saved to {path}",
                           flush=True)
 

@@ -16,13 +16,21 @@ TPU-first design notes:
   * Static Python loop over ring steps: N is known at trace time, so XLA sees
     a straight-line schedule of ppermutes it can pipeline; chunk indices are
     traced values derived from ``lax.axis_index``.
-  * Bidirectional by default: the buffer is split into two counter-rotating
-    halves, one riding the clockwise ring and one the counter-clockwise
-    ring.  The two directions' ppermutes are data-independent and
-    interleaved in the trace, so XLA can run them concurrently — on a TPU
-    torus each ICI link carries traffic in both directions at once, so
-    per-step payload (and ideally wall time) halves; even over host shared
-    memory the independent halves give the scheduler overlap to exploit.
+  * Single-direction by default — a MEASURED decision (round-3 VERDICT #5):
+    on every mesh this repo has timed (BASELINE.md "gradient-collective
+    sweep": uni 698 ms vs bidirectional 1091 ms on the 8-device simulated
+    mesh; psum 147 ms) the single ring wins, because the bidirectional
+    schedule doubles the collective-permute dispatch count
+    (tools/ring_hlo_evidence.py counts the compiled HLO ops) and on a
+    non-torus transport the halved per-message payload buys nothing back.
+  * ``bidirectional=True`` remains selectable (the ``ring_bidir`` sync
+    rung): two counter-rotating half-buffers whose ppermutes are
+    data-independent, so on a REAL TPU torus — where each ICI link carries
+    traffic both directions at once — per-step payload halves.  That is a
+    hypothesis this host cannot test (1 real chip; collectives compile to
+    no-ops): benchmarks/collective_bench.py records the head-to-head the
+    moment a multi-chip window exists, and the default should follow the
+    data then too.
 """
 
 from __future__ import annotations
@@ -39,18 +47,19 @@ def _ring_perm(n: int, sign: int = 1) -> list[tuple[int, int]]:
 
 
 def ring_all_reduce(x: jnp.ndarray, axis_name: str, *,
-                    bidirectional: bool = True) -> jnp.ndarray:
+                    bidirectional: bool = False) -> jnp.ndarray:
     """Sum ``x`` over ``axis_name`` with an explicit ppermute ring.
 
     Must be called inside ``shard_map``/``pmap``.  Works for any shape; the
     flat buffer is zero-padded to a multiple of ``directions * axis size``
     (the "non-divisible tensor sizes" hard part from SURVEY.md §7).
 
-    ``bidirectional=True`` (default) splits the buffer into two
+    ``bidirectional=False`` (default) is the textbook single-direction
+    schedule — the faster one on every mesh measured so far (see the
+    module docstring).  ``True`` splits the buffer into two
     counter-rotating halves — still 2(N-1) ring steps, but each step moves
     two independent half-size messages the compiler can overlap (both ICI
-    directions of a TPU torus).  ``False`` is the single-direction
-    textbook schedule, kept for comparison benchmarks.
+    directions of a TPU torus); selectable pending real multi-chip data.
     """
     n = lax.axis_size(axis_name)
     if n == 1:
@@ -274,7 +283,7 @@ def all_reduce_mean_tree(tree, axis_name: str, reduce_fn):
 
 
 def ring_all_reduce_mean(tree, axis_name: str, *,
-                         bidirectional: bool = True):
+                         bidirectional: bool = False):
     """Mean-reduce a gradient pytree over the ring as ONE flat buffer."""
     def reduce_fn(flat, ax):
         return ring_all_reduce(flat, ax, bidirectional=bidirectional)

@@ -12,13 +12,22 @@ on every device — the observable contract of every rung of the ladder.
   coordinator Part 2a — semantics of gather-to-rank-0 → mean → scatter
               (src/Part 2a/main.py:117-127).  SPMD has no privileged rank, so
               every device all-gathers and means — numerically identical,
-              same traffic shape (each device's grad crosses the wire once,
-              the mean once), without the rank-0 serialization bottleneck.
+              without the rank-0 serialization bottleneck, but NOT the same
+              traffic shape: all_gather lands N× the gradient payload on
+              every device (vs 2 wire crossings per non-root rank in the
+              hub pattern), and BASELINE.md measures it at ~10.5× psum's
+              wall time on the 8-device mesh.  It exists for semantic
+              parity with the reference's rung, not as a fast path.
   allreduce   Part 2b — built-in collective: psum then divide by world size
               (src/Part 2b/main.py:116-119: all_reduce(SUM); grad /= size).
   ring        north-star extra — hand-rolled ring all-reduce from ppermute,
-              bidirectional by default (see tpudp.parallel.ring);
-              ring_uni selects the single-direction textbook schedule.
+              single-direction (the schedule that measures fastest on every
+              mesh timed so far — BASELINE.md sweep; round-3 VERDICT #5
+              reverted the faith-based bidirectional default).  ring_uni is
+              a kept alias of the same schedule; ring_bidir selects the two
+              counter-rotating half-buffers (both ICI directions of a real
+              torus — a hypothesis benchmarks/collective_bench.py will
+              test the moment a multi-chip window exists).
   allreduce_hd / allreduce_a2a  beyond-reference manual flavors —
               Rabenseifner halving-doubling (2*log2 N pairwise exchanges)
               and all_to_all+local-sum reduce-scatter (2 dispatches); same
@@ -61,7 +70,9 @@ def sync_none(grads, axis_name: str):
 def sync_coordinator(grads, axis_name: str):
     """Part 2a semantics: every device ends with the mean gradient via
     all-gather + local mean (rank-0 asymmetry is a Gloo API artifact, not
-    observable behavior — SURVEY.md §7 hard parts)."""
+    observable behavior — SURVEY.md §7 hard parts).  Traffic cost is N×
+    the gradient payload per device — measured ~10.5× psum (BASELINE.md);
+    see the module docstring."""
     def gather_mean(g):
         return lax.all_gather(g, axis_name).mean(axis=0)
     return jax.tree.map(gather_mean, grads)
@@ -76,16 +87,24 @@ def sync_allreduce(grads, axis_name):
 
 def sync_ring(grads, axis_name: str):
     """North-star: hand-rolled ppermute ring all-reduce over one flat
-    buffer — bidirectional (two counter-rotating halves, both ICI
-    directions of the torus in flight at once)."""
+    buffer — single-direction, the schedule that measures fastest on
+    every mesh timed so far (BASELINE.md sweep; see the module
+    docstring for why the bidirectional default was reverted)."""
     return ring_all_reduce_mean(grads, axis_name)
 
 
-def sync_ring_uni(grads, axis_name: str):
-    """Single-direction textbook ring — the comparison baseline for the
-    bidirectional default, kept selectable for benchmarks
-    (benchmarks/collective_bench.py)."""
-    return ring_all_reduce_mean(grads, axis_name, bidirectional=False)
+# Kept alias: round-2/3 CLIs, banked bench rows, and examples refer to the
+# single-direction schedule by this name.
+sync_ring_uni = sync_ring
+
+
+def sync_ring_bidir(grads, axis_name: str):
+    """Two counter-rotating half-buffers — both ICI directions of a TPU
+    torus in flight at once.  Unmeasured on real multi-chip hardware (the
+    torus-overlap win is a hypothesis; on the simulated mesh the doubled
+    ppermute dispatch count makes it ~1.6x slower than the single ring,
+    BASELINE.md) — selectable for benchmarks, not the default."""
+    return ring_all_reduce_mean(grads, axis_name, bidirectional=True)
 
 
 def sync_allreduce_hd(grads, axis_name):
@@ -183,6 +202,7 @@ SYNC_STRATEGIES: dict[str, SyncFn] = {
     "allreduce_int8": sync_allreduce_int8,
     "ring": sync_ring,
     "ring_uni": sync_ring_uni,
+    "ring_bidir": sync_ring_bidir,
     "allreduce_hd": sync_allreduce_hd,
     "allreduce_a2a": sync_allreduce_a2a,
     "auto": sync_auto,

@@ -996,6 +996,12 @@ class Trainer:
                     "sec_per_iter": window_time / self.log_every,
                     "samples_per_sec": window_samples / window_time,
                     "warmup_window": it == self.log_every,
+                    # Partial-epoch marker (round-3 advisor): after a
+                    # mid-epoch fast-forward the epoch's aggregates cover
+                    # only the remaining batches — downstream consumers
+                    # must not compare them to full-epoch records.
+                    **({"batches_skipped": skip_batches}
+                       if skip_batches else {}),
                 })
                 window_samples = 0
                 fwd_t, bwd_t = 0.0, 0.0
@@ -1062,9 +1068,8 @@ class Trainer:
              epoch_end_fn, skip_first=0) -> None:
         for epoch in range(start_epoch, epochs):
             start = time.perf_counter()
-            self.train_epoch(train_loader, epoch,
-                             skip_batches=skip_first if epoch == start_epoch
-                             else 0)
+            skip = skip_first if epoch == start_epoch else 0
+            self.train_epoch(train_loader, epoch, skip_batches=skip)
             fetch_fence(self.state.params)  # honest epoch wall-time edge
             epoch_s = time.perf_counter() - start
             self.log(
@@ -1072,8 +1077,12 @@ class Trainer:
                     epoch + 1, epoch_s
                 )
             )
+            # batches_skipped marks a resumed PARTIAL epoch: its wall time
+            # and mean loss cover only the remaining batches (r3 advisor).
             self._emit_metrics({"kind": "epoch", "epoch": epoch,
-                                "seconds": epoch_s})
+                                "seconds": epoch_s,
+                                **({"batches_skipped": skip}
+                                   if skip else {})})
             if self.verify_replicas:
                 from tpudp.utils.consistency import (verify_across_processes,
                                                      verify_replicas)

@@ -138,19 +138,39 @@ def clear_emergency_sentinel(root: str | os.PathLike) -> None:
 
 
 def write_emergency_sentinel(root: str | os.PathLike,
-                             step: int | None = None) -> None:
+                             step: int | None = None,
+                             per_epoch_batches: int | None = None) -> None:
     """Mark the emergency dump complete.  Call ONLY after the orbax save
     returned (finalization done): the dumping thread is abandoned after a
     timeout and the process exits (tpudp/cli.py), so a dump directory can
     be left half-written — the sentinel is the commit record that
-    distinguishes a restorable dump from a truncated one."""
+    distinguishes a restorable dump from a truncated one.
+
+    ``per_epoch_batches`` records the interrupted run's loader length so a
+    resume can verify the step counter still maps onto the same batch grid
+    — a relaunch with a different --batch-size or train-set size would
+    otherwise silently re-train or drop batches (round-3 advisor)."""
     import json
     import time
 
     with open(_emergency_sentinel_path(root), "w") as f:
         json.dump({"step": step,
+                   "per_epoch_batches": per_epoch_batches,
                    "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                time.gmtime())}, f)
+
+
+def read_emergency_sentinel(root: str | os.PathLike) -> dict | None:
+    """The sentinel's JSON payload, or None if absent/unreadable (dumps
+    from before the sentinel carried data, or accepted via orbax's own
+    finalization metadata)."""
+    import json
+
+    try:
+        with open(_emergency_sentinel_path(root)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return None
 
 
 def emergency_dir(root: str | os.PathLike) -> str | None:
