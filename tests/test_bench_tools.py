@@ -5,9 +5,9 @@ set reports no gaps — the properties the TPU-window accumulation depends on.""
 import json
 import os
 
-from tools.bench_gaps import (FLASH_TS, MATRIX_CONFIGS, epoch_missing,
-                              flash_missing, history_path, matrix_missing,
-                              mfu_missing)
+from tools.bench_gaps import (FLASH_TS, MATRIX_CONFIGS, collective_missing,
+                              epoch_missing, flash_missing, history_path,
+                              matrix_missing, mfu_missing)
 
 
 def _write(path, rows):
@@ -141,3 +141,43 @@ def test_mfu_gap_requires_all_variants_on_tpu(tmp_path):
     rows.append({"variant": "bf16_params", "error": "donation clash"})
     _write(os.path.join(d, "mfu.jsonl"), rows)
     assert not mfu_missing(d)  # all measured + bf16 attempted (error row)
+
+
+def test_collective_gap_gate(tmp_path):
+    """The ring-default evidence stage (VERDICT r3 #5): complete on real
+    multi-device TPU rows for all three key schedules, or on a labeled
+    1-device skip row — but a probe that sees a multi-chip slice re-opens
+    the stage, and simulated CPU-mesh rows never satisfy it."""
+    d = str(tmp_path)
+    assert collective_missing(d)  # nothing measured yet
+
+    # simulated CPU-mesh sweep rows must NOT satisfy the gate
+    _write(os.path.join(d, "collective.jsonl"), [
+        {"strategy": s, "wall_time_s": 0.1, "devices": 8,
+         "device_kind": "cpu"}
+        for s in ("allreduce", "ring", "ring_bidir")])
+    assert collective_missing(d)
+
+    # the labeled 1-device skip row completes the stage on a 1-chip host
+    _write(os.path.join(d, "collective.jsonl"), [
+        {"skipped": "1 device", "devices": 1, "device_kind": "TPU v5 lite"}])
+    assert not collective_missing(d)
+
+    # ... until a probe records a multi-chip slice: the head-to-head is
+    # owed again and the skip row must not mask it
+    with open(os.path.join(d, "probe.json"), "w") as f:
+        json.dump({"devices": 8, "device_kind": "TPU v4"}, f)
+    assert collective_missing(d)
+
+    # real multi-device TPU rows for all three schedules close it for good
+    _write(os.path.join(d, "collective.history.jsonl"), [
+        {"strategy": s, "wall_time_s": 0.01, "devices": 8,
+         "device_kind": "TPU v4"}
+        for s in ("allreduce", "ring", "ring_bidir")])
+    assert not collective_missing(d)
+
+    # incomplete schedule coverage keeps the gap open
+    _write(os.path.join(d, "collective.history.jsonl"), [
+        {"strategy": "allreduce", "wall_time_s": 0.01, "devices": 8,
+         "device_kind": "TPU v4"}])
+    assert collective_missing(d)

@@ -80,7 +80,7 @@ def _jax_accuracy(model, state, x, y):
     return correct / len(y)
 
 
-def _run_both(train_x, train_y, test_x, test_y, steps=STEPS):
+def _run_both(train_x, train_y, test_x, test_y, steps=STEPS, lr=LR):
     """Transplant-initialize both stacks, train ``steps`` identical steps,
     return (per-step torch losses, per-step jax losses, torch acc,
     jax acc)."""
@@ -88,7 +88,7 @@ def _run_both(train_x, train_y, test_x, test_y, steps=STEPS):
     torch.set_num_threads(1)
     tmodel = TorchVGG(CONFIGS["VGG11"])
     model = VGG11()
-    tx = make_optimizer(LR, MOM, WD)
+    tx = make_optimizer(lr, MOM, WD)
     state = init_state(model, tx, input_shape=(1, 32, 32, 3))
     params, bs = transplant(tmodel, state.params, state.batch_stats)
     state = state.replace(params=params, batch_stats=bs)
@@ -97,7 +97,7 @@ def _run_both(train_x, train_y, test_x, test_y, steps=STEPS):
     ys = train_y.reshape(steps, BATCH)
 
     tmodel.train()
-    opt = torch.optim.SGD(tmodel.parameters(), lr=LR, momentum=MOM,
+    opt = torch.optim.SGD(tmodel.parameters(), lr=lr, momentum=MOM,
                           weight_decay=WD)
     crit = torch.nn.CrossEntropyLoss()
     t_losses = []
@@ -122,20 +122,27 @@ def _run_both(train_x, train_y, test_x, test_y, steps=STEPS):
     return np.array(t_losses), np.array(j_losses), t_acc, j_acc
 
 
-def _assert_parity(t_losses, j_losses, t_acc, j_acc):
-    # Per-step tolerance tracking: the allowed ABS divergence grows
-    # linearly with step (fp32 rounding compounds through BN stats and
-    # momentum), inside the measured envelope with ~1.5x headroom.
-    # Relative tolerance is meaningless here: converged losses are ~0.03.
+def _assert_envelope(t_losses, j_losses, base, slope=0.02, label="parity"):
+    """Per-step tolerance tracking shared by the saturating and
+    non-saturating tests: the allowed ABS divergence grows linearly with
+    step (fp32 rounding compounds through BN stats and momentum).
+    Relative tolerance is meaningless here: converged losses are ~0.03.
+    Returns (diffs, bounds) for any regime-specific follow-up asserts."""
     diffs = np.abs(t_losses - j_losses)
     with np.printoptions(precision=4, suppress=True):
-        print(f"[parity] per-step |loss diff|: {diffs}")
-    bounds = 0.05 + 0.02 * np.arange(STEPS)
+        print(f"[{label}] per-step |loss diff|: {diffs}")
+    bounds = base + slope * np.arange(len(diffs))
     bad = np.nonzero(diffs > bounds)[0]
     assert bad.size == 0, (
         f"trajectory diverged beyond envelope at steps {bad[:5]}: "
         f"diffs={diffs[bad[:5]]}, bounds={bounds[bad[:5]]}; "
         f"max diff {diffs.max():.4f} at step {diffs.argmax()}")
+    return diffs, bounds
+
+
+def _assert_parity(t_losses, j_losses, t_acc, j_acc):
+    t_losses, j_losses = np.asarray(t_losses), np.asarray(j_losses)
+    _assert_envelope(t_losses, j_losses, base=0.05)
     # End-game agreement: both stacks settled on the same optimum.  The
     # bound is loose in RELATIVE terms only because converged losses are
     # tiny (~0.03-0.05): under pytest the conftest's 8-virtual-device XLA
@@ -167,6 +174,56 @@ def test_long_trajectory_and_accuracy_parity_synthetic():
     train_x, train_y = _synthetic_learnable(rng, STEPS * BATCH, protos)
     test_x, test_y = _synthetic_learnable(rng, TEST_N, protos)
     _assert_parity(*_run_both(train_x, train_y, test_x, test_y))
+
+
+def test_nonsaturating_trajectory_and_accuracy_parity():
+    """VERDICT r3 #7: accuracy parity where it is INFORMATIVE.  The
+    saturating test above lands both stacks at ~99.5% — mostly evidence
+    that neither stack is broken.  Here the prototype signal drops to
+    0.25 (vs 0.5), lr to 0.005, and the horizon doubles to 100 steps, so
+    both stacks land mid-learning-curve (<90% test accuracy, asserted)
+    where borderline samples are plentiful and agreement measures
+    implementation parity.  Calibrated 2026-07-31 (single-device run):
+    torch 79.8% / jax 81.3% (delta 1.56 points), per-step |loss diff|
+    max 0.24 / late-20 mean 0.066; re-validated the same day UNDER the
+    pytest harness (conftest's 8-virtual-device XLA topology, whose
+    different reduction order compounds extra rounding — see
+    _assert_parity's note): passes with these bounds.  A harder variant (signal 0.18,
+    ~50% accuracy — the steepest point of the curve) measured a 4.8-point
+    delta with the SAME tight loss envelope: at max d(acc)/d(loss) the
+    accuracy comparison amplifies benign fp32 rounding, so this regime,
+    past the steepest section but well short of saturation, is where the
+    accuracy criterion is both meaningful and stable.  Also asserts the
+    divergence envelope stays SUB-linear: the per-step tolerance grows
+    linearly as headroom and real divergence must not track it."""
+    hard_steps = 100
+    rng = np.random.default_rng(23)
+    protos = rng.normal(size=(10, 32, 32, 3)).astype(np.float32)
+    train_x, train_y = _synthetic_learnable(
+        rng, hard_steps * BATCH, protos, scale=0.25)
+    test_x, test_y = _synthetic_learnable(rng, TEST_N, protos, scale=0.25)
+    t_losses, j_losses, t_acc, j_acc = _run_both(
+        train_x, train_y, test_x, test_y, steps=hard_steps, lr=0.005)
+
+    diffs, bounds = _assert_envelope(t_losses, j_losses, base=0.08,
+                                     label="parity/hard")
+    # Sub-linear growth: late-window mean divergence stays far under the
+    # linear allowance (measured 0.066 vs allowance ~1.87 — a divergence
+    # that TRACKS the envelope would sit near 1.0x).
+    assert diffs[-20:].mean() < 0.5 * bounds[-20:].mean(), (
+        f"divergence tracks the linear envelope: late mean "
+        f"{diffs[-20:].mean():.4f} vs allowance {bounds[-20:].mean():.4f}")
+    print(f"[parity/hard] accuracy: torch={t_acc:.4f} jax={j_acc:.4f} "
+          f"delta={abs(t_acc - j_acc) * 100:.3f}%")
+    # Genuinely non-saturating, well above chance (measured ~0.80/0.81).
+    assert 0.5 < t_acc < 0.90, t_acc
+    assert 0.5 < j_acc < 0.90, j_acc
+    # Mid-curve agreement bound: 2.5x headroom over the measured 1.56-pt
+    # delta — looser than the saturating test's 0.5% because borderline
+    # samples are the POINT here, still tight enough to catch a real
+    # semantic divergence (the 0.18-signal probe showed even benign
+    # rounding reaches 4.8 points at the curve's steepest section).
+    assert abs(t_acc - j_acc) < 0.04
 
 
 _DATA_ROOT = os.path.join(os.path.dirname(os.path.dirname(
@@ -216,8 +273,7 @@ def test_long_trajectory_and_accuracy_parity_cifar():
                                                  test_x, test_y)
     # Same trajectory envelope; accuracy threshold relaxed (50 steps on
     # real CIFAR doesn't reach 90%) — the criterion is the DELTA.
-    diffs = np.abs(t_losses - j_losses)
-    assert (diffs <= 0.05 + 0.02 * np.arange(STEPS)).all()
+    _assert_envelope(t_losses, j_losses, base=0.05, label="parity/cifar")
     delta = abs(t_acc - j_acc)
     print(f"[parity/cifar] torch={t_acc:.4f} jax={j_acc:.4f} "
           f"delta={delta * 100:.3f}%")
