@@ -1,6 +1,7 @@
 """Checkpoint/resume roundtrip: restored state continues training with the
 exact same trajectory as the uninterrupted run."""
 
+import pytest
 import os
 import jax.numpy as jnp
 import numpy as np
@@ -22,6 +23,7 @@ def test_latest_step_dir_ignores_orbax_tmp(tmp_path):
     assert latest_step_dir(tmp_path / "missing") is None
 
 
+@pytest.mark.slow
 def test_async_writer_roundtrip(tmp_path, mesh4):
     """AsyncCheckpointWriter under the CLI's actual hazard: training
     continues with a DONATING step while the write is in flight, so the

@@ -1,6 +1,10 @@
 """--eval-only: restore the latest checkpoint and run only the reference
 eval loop (no training).  Drives run_part in-process on the CPU mesh."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # integration tier (VERDICT r3 #6): rung oracles stay in the fast tier
+
 import numpy as np
 
 from tpudp.cli import run_part

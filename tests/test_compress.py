@@ -11,6 +11,7 @@ tree sharded ``P(data)`` (never mislabeled replicated), threaded through
 shard_map via ``state_partition_specs``.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -156,6 +157,7 @@ def test_rejects_unbound_axis_and_missing_devices():
         tx.update({"w": jnp.ones((4,))}, st)
 
 
+@pytest.mark.slow
 def test_trainer_level_compress(mesh8, tmp_path):
     """Trainer(compress='int8_ef', sync='none'): the full epoch driver over
     the EF-compressed collective, including a checkpoint round-trip of the

@@ -20,7 +20,10 @@ def _model_and_params(seed=0, **overrides):
     return model, state.params
 
 
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("dtype", [
+    jnp.float32,
+    pytest.param(jnp.bfloat16, marks=pytest.mark.slow),
+])
 def test_greedy_matches_full_forward(dtype):
     """Parity must hold for bf16 too — the op/dtype sequence of the decode
     attention mirrors the training path exactly."""
@@ -104,6 +107,7 @@ def test_beam_width_1_equals_greedy():
     assert np.all(np.isfinite(np.asarray(scores)))
 
 
+@pytest.mark.slow
 def test_beam_search_finds_optimal_sequence():
     """With beam_width = vocab^n the search is exhaustive, so it must find
     the true max-logprob continuation — checked against brute force."""
@@ -133,6 +137,7 @@ def test_beam_search_finds_optimal_sequence():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_beam_search_batch_independence():
     """batch=3, width=3: each batch element's beams must equal the beams of
     a standalone batch=1 search on that element — pins the cross-batch

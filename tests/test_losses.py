@@ -42,6 +42,7 @@ def test_value_and_grads_match_dense(chunk):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_metrics_match_dense_eval():
     from tpudp.models.gpt2 import gpt2_small
     from tpudp.train import eval_metrics, init_state, make_optimizer
@@ -87,6 +88,7 @@ def test_train_path_loss_chunk_matches_dense(mesh4):
     np.testing.assert_allclose(losses[6], losses[None], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_trainer_loss_chunk_end_to_end(mesh4):
     """Trainer(loss_chunk=...) drives both the chunked train step and the
     chunked eval; metrics match the dense Trainer."""

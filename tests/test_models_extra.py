@@ -1,6 +1,7 @@
 """Beyond-parity model families: ResNet (BASELINE configs[3]) and GPT-2
 (configs[4]) — shape, parameter-count, and train-step integration tests."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,6 +55,7 @@ def test_gpt2_small_param_count():
     assert _param_count(variables["params"]) == 124_439_808
 
 
+@pytest.mark.slow
 def test_tiny_gpt2_trains_dp(mesh4):
     """A tiny GPT-2 config runs the same DP ladder unchanged (LM labels are
     (B, T) — the integer-CE loss broadcasts over leading axes)."""

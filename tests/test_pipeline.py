@@ -31,7 +31,11 @@ def test_stack_unstack_roundtrip():
     jax.tree.map(np.testing.assert_array_equal, params, back)
 
 
-@pytest.mark.parametrize("dp,pp,micro", [(1, 4, 2), (2, 4, 4), (1, 2, 1)])
+@pytest.mark.parametrize("dp,pp,micro", [
+    pytest.param(1, 4, 2, marks=pytest.mark.slow),
+    (2, 4, 4),
+    pytest.param(1, 2, 1, marks=pytest.mark.slow),
+])
 def test_pp_matches_single_device_trajectory(dp, pp, micro):
     mesh = make_mesh_nd({"data": dp, "pipe": pp},
                         devices=jax.devices()[: dp * pp])
@@ -103,6 +107,7 @@ def test_pp_rejects_indivisible_layers():
                            n_microbatches=2)
 
 
+@pytest.mark.slow
 def test_pp_remat_matches_plain():
     """remat=True (jax.checkpoint around each block) is semantics-preserving
     for the pipelined step: same loss as the plain PP step."""
@@ -123,7 +128,11 @@ def test_pp_remat_matches_plain():
                                rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("dp,pp,micro", [(1, 4, 2), (2, 4, 4), (1, 2, 8)])
+@pytest.mark.parametrize("dp,pp,micro", [
+    pytest.param(1, 4, 2, marks=pytest.mark.slow),
+    (2, 4, 4),
+    pytest.param(1, 2, 8, marks=pytest.mark.slow),
+])
 def test_1f1b_matches_single_device_trajectory(dp, pp, micro):
     """The 1F1B schedule is the same math as GPipe/single-device: identical
     loss trajectory to the non-pipelined oracle (the referee for the tick

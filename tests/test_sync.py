@@ -240,6 +240,7 @@ def test_allreduce_int8_trains_like_fp32(mesh8):
     assert abs(losses["allreduce_int8"] - losses["allreduce"]) < 0.5
 
 
+@pytest.mark.slow
 def test_int8_headroom_quantizer_never_wraps_fuzz(mesh8):
     """Property fuzz of the wraparound invariant (round-2 advisor finding):
     for ANY per-device fp32 buffers — adversarial same-sign maxima, tiny

@@ -10,6 +10,10 @@ must NOT — each shard normalizes by its own 2-sample statistics — which is
 asserted too, so the option demonstrably changes the math it claims to.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # integration tier (VERDICT r3 #6): rung oracles stay in the fast tier
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -74,6 +74,7 @@ class _ImageLoader:
         return len(self.batches)
 
 
+@pytest.mark.slow
 def test_trainer_dp_smoke(mesh8):
     """ViT through the standard DP Trainer path: loss decreases."""
     from tpudp.train import Trainer
