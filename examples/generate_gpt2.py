@@ -1,0 +1,152 @@
+"""Generate text from a tpudp GPT-2 — the user-facing decode CLI.
+
+Completes the inference surface around tpudp.models.generate (KV-cached
+prefill+decode compiled as one program; tests/test_generate.py pins exact
+greedy parity with the training forward): checkpoint restore, greedy /
+temperature / top-k / top-p sampling, and beam search from one script.
+The reference has no inference path at all (SURVEY.md — training scripts
+only); this is a beyond-parity capability.
+
+  # Greedy, random-init demo (no checkpoint needed; zero-egress friendly):
+  python examples/generate_gpt2.py --layers 2 --d-model 64 --vocab 256 \
+      --seq-len 128 --max-new-tokens 16 --platform cpu
+
+  # Restore the newest checkpoint an examples/train run saved, sample:
+  python examples/generate_gpt2.py --checkpoint-dir ckpt --layers 4 ... \
+      --temperature 0.8 --top-p 0.9 --seed 7
+
+  # Beam search:
+  python examples/generate_gpt2.py ... --beam 4
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--heads", type=int, default=None)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32")
+    p.add_argument("--checkpoint-dir", type=str, default=None,
+                   help="restore params from the newest step_N checkpoint "
+                        "(as saved by the Part CLIs / Trainer); without it "
+                        "the model is random-init (structure demo only, "
+                        "loudly labeled)")
+    p.add_argument("--prompt-ids", type=str, default=None,
+                   help="comma-separated int token ids; default: first 8 "
+                        "tokens of the training examples' synthetic corpus")
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy argmax; >0 samples at this temperature")
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0,
+                   help="PRNG seed for temperature sampling")
+    p.add_argument("--beam", type=int, default=None, metavar="W",
+                   help="beam-search decode with width W instead of "
+                        "greedy/sampling (mutually exclusive with "
+                        "--temperature/--top-k/--top-p)")
+    p.add_argument("--platform", type=str, default=None)
+    args = p.parse_args()
+
+    if args.beam is not None and (args.temperature or args.top_k
+                                  or args.top_p):
+        raise SystemExit("error: --beam is deterministic max-probability "
+                         "search; drop --temperature/--top-k/--top-p")
+    if (args.top_k is not None or args.top_p is not None) \
+            and args.temperature == 0.0:
+        raise SystemExit("error: --top-k/--top-p shape the SAMPLING "
+                         "distribution; set --temperature > 0 (greedy "
+                         "argmax ignores them)")
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from tpudp.utils.compile_cache import enable_persistent_cache
+    from tpudp.utils.device_lock import acquire_for_process
+
+    acquire_for_process()  # self-skips when cpu-pinned
+    enable_persistent_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudp.models.gpt2 import GPT2, GPT2Config
+    from tpudp.train import init_state, make_optimizer
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    cfg = GPT2Config(
+        vocab_size=args.vocab,
+        max_seq_len=args.seq_len,
+        num_layers=args.layers,
+        num_heads=args.heads or max(args.d_model // 64, 1),
+        d_model=args.d_model,
+        dtype=dtype,
+    )
+    model = GPT2(cfg)
+    if args.checkpoint_dir:
+        # Params-only restore: no knowledge of the training run's
+        # optimizer config needed (clip/skip wrappers change the
+        # TrainState structure; decode only wants the weights).
+        from tpudp.utils.checkpoint import latest_step_dir, restore_params
+
+        latest = latest_step_dir(args.checkpoint_dir)
+        if not latest:
+            raise SystemExit(
+                f"error: no step_N checkpoint under {args.checkpoint_dir!r} "
+                "— generating from random weights would be misleading; "
+                "drop --checkpoint-dir for an explicit random-init demo")
+        params = restore_params(latest)
+        print(f"[generate] restored params from {latest}")
+    else:
+        params = init_state(model, tx=make_optimizer(),
+                            input_shape=(1, min(args.seq_len, 16))).params
+        print("[generate] RANDOM-INIT weights (no --checkpoint-dir): "
+              "output demonstrates the decode path, not a trained model")
+
+    if args.prompt_ids:
+        ids = [int(x) for x in args.prompt_ids.split(",")]
+    else:
+        # first tokens of the training examples' deterministic corpus
+        rng = np.random.default_rng(0)
+        ids = (rng.integers(0, args.vocab, size=4096)[:8] % args.vocab).tolist()
+    if not ids or any(not 0 <= i < args.vocab for i in ids):
+        raise SystemExit(f"error: prompt ids must be in [0, {args.vocab})")
+    prompt = jnp.asarray([ids], jnp.int32)
+
+    if args.beam is not None:
+        from tpudp.models.generate import beam_search
+
+        seqs, scores = beam_search(model, params, prompt,
+                                   args.max_new_tokens,
+                                   beam_width=args.beam)
+        print(f"[generate] beam={args.beam} "
+              f"logprob={float(scores[0]):.4f} prompt={ids}")
+        print("tokens:", np.asarray(seqs[0, len(ids):]).tolist())
+        return
+
+    from tpudp.models.generate import generate
+
+    out = generate(model, params, prompt, args.max_new_tokens,
+                   temperature=args.temperature, top_k=args.top_k,
+                   top_p=args.top_p,
+                   key=(jax.random.PRNGKey(args.seed)
+                        if args.temperature > 0 else None))
+    mode = ("greedy" if args.temperature == 0 else
+            f"T={args.temperature} top_k={args.top_k} top_p={args.top_p} "
+            f"seed={args.seed}")
+    print(f"[generate] {mode} prompt={ids}")
+    print("tokens:", np.asarray(out[0, len(ids):]).tolist())
+
+
+if __name__ == "__main__":
+    main()

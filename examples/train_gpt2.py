@@ -67,6 +67,10 @@ def main() -> None:
                    help="after training, greedily generate N tokens from a "
                         "corpus prompt via the KV-cached decode path")
     p.add_argument("--tokens-file", type=str, default=None)
+    p.add_argument("--save-checkpoint", type=str, default=None, metavar="DIR",
+                   help="save the final TrainState to DIR/step_<steps> "
+                        "(orbax; restorable by examples/generate_gpt2.py "
+                        "--checkpoint-dir DIR)")
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
 
@@ -219,6 +223,13 @@ def main() -> None:
             print(f"step {it}: loss {(cum - prev_cum) / args.log_every:.4f} "
                   f"({tok_s:,.0f} tok/s)")
             prev_cum, t0 = cum, time.perf_counter()
+
+    if args.save_checkpoint:
+        from tpudp.utils.checkpoint import save_checkpoint
+
+        ckpt = save_checkpoint(
+            os.path.join(args.save_checkpoint, f"step_{args.steps}"), state)
+        print(f"[gpt2] saved checkpoint {ckpt}")
 
     if args.sample:
         from tpudp.models.generate import generate

@@ -106,3 +106,35 @@ def test_prune_step_dirs(tmp_path):
     assert prune_step_dirs(tmp_path / "missing", keep=2) == []
     with pytest.raises(ValueError):
         prune_step_dirs(tmp_path, keep=0)
+
+
+def test_restore_params_ignores_optimizer_structure(tmp_path):
+    """restore_params: decode/eval tools restore ONLY the weights, so a
+    checkpoint saved under a clip-wrapped optimizer (extra opt_state
+    leaves) restores fine without knowing the training flags."""
+    import flax.linen as nn
+    import jax
+    import numpy as np
+
+    from tpudp.train import init_state, make_optimizer
+    from tpudp.utils.checkpoint import restore_params, save_checkpoint
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    tx = make_optimizer(clip_norm=1.0)  # clip wrapper changes opt_state
+    state = init_state(M(), tx, input_shape=(1, 2, 2, 1))
+    path = str(tmp_path / "step_1")
+    save_checkpoint(path, state)
+
+    params = restore_params(path)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    import pytest
+
+    with pytest.raises(ValueError, match="params"):
+        save_checkpoint(str(tmp_path / "junk"), {"not_params": 1})
+        restore_params(str(tmp_path / "junk"))

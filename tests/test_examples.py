@@ -39,6 +39,61 @@ def _losses(stdout):
                                  stdout, re.IGNORECASE)]
 
 
+def test_generate_example_all_modes():
+    """The decode CLI (examples/generate_gpt2.py): greedy, sampling, and
+    beam modes each emit a token list; bad flag combos fail fast."""
+    tiny = ["--layers", "1", "--d-model", "32", "--vocab", "64",
+            "--seq-len", "64"]
+    greedy = _run("generate_gpt2.py", tiny + ["--max-new-tokens", "4"])
+    assert greedy.returncode == 0, greedy.stderr[-800:]
+    assert "tokens: [" in greedy.stdout
+    assert "RANDOM-INIT" in greedy.stdout  # unlabeled random output is a lie
+
+    sampled = _run("generate_gpt2.py",
+                   tiny + ["--max-new-tokens", "4", "--temperature", "0.8",
+                           "--top-k", "8", "--prompt-ids", "1,2,3"])
+    assert sampled.returncode == 0, sampled.stderr[-800:]
+    assert "tokens: [" in sampled.stdout
+
+    beam = _run("generate_gpt2.py", tiny + ["--max-new-tokens", "4",
+                                            "--beam", "2"])
+    assert beam.returncode == 0, beam.stderr[-800:]
+    assert "logprob=" in beam.stdout
+
+    bad = _run("generate_gpt2.py", tiny + ["--beam", "2",
+                                           "--temperature", "0.5"])
+    assert bad.returncode != 0
+    assert "drop --temperature" in (bad.stderr + bad.stdout)
+
+    bad_k = _run("generate_gpt2.py", tiny + ["--top-k", "8"])
+    assert bad_k.returncode != 0  # top-k without temperature: clean refusal
+    assert "--temperature" in (bad_k.stderr + bad_k.stdout)
+
+
+def test_train_then_generate_checkpoint_roundtrip(tmp_path):
+    """The documented decode workflow end to end: train_gpt2
+    --save-checkpoint, then generate_gpt2 --checkpoint-dir restores the
+    params (params-only restore — works regardless of the training run's
+    optimizer wrappers, here --clip-norm which changes opt_state shape)."""
+    tiny = ["--layers", "1", "--d-model", "32", "--vocab", "64",
+            "--seq-len", "16"]
+    ck = str(tmp_path / "ck")
+    trained = _run("train_gpt2.py",
+                   tiny + ["--steps", "2", "--batch-size", "8",
+                           "--log-every", "1", "--clip-norm", "1.0",
+                           "--save-checkpoint", ck])
+    assert trained.returncode == 0, trained.stderr[-800:]
+    assert "saved checkpoint" in trained.stdout
+
+    gen = _run("generate_gpt2.py",
+               tiny[:6] + ["--seq-len", "16", "--max-new-tokens", "4",
+                           "--checkpoint-dir", ck])
+    assert gen.returncode == 0, gen.stderr[-800:]
+    assert "restored params from" in gen.stdout
+    assert "RANDOM-INIT" not in gen.stdout
+    assert "tokens: [" in gen.stdout
+
+
 @pytest.mark.parametrize("script,args", [
     ("train_vit.py", ["--steps", "2", "--batch-size", "16",
                       "--train-size", "32", "--log-every", "1",

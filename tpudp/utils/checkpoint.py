@@ -249,6 +249,26 @@ def prune_step_dirs(root: str | os.PathLike, keep: int) -> list[str]:
     return deleted
 
 
+def restore_params(path: str | os.PathLike):
+    """Restore ONLY the ``params`` subtree of a saved TrainState.
+
+    Decode/eval tools need the weights, not the optimizer state — and a
+    full-TrainState ``restore_checkpoint`` target must structurally match
+    the optimizer the checkpoint was saved with (clip/skip wrappers add
+    state leaves), which a standalone tool cannot know.  Restoring the
+    raw tree target-free and slicing ``params`` sidesteps the mismatch.
+    """
+    if not HAVE_ORBAX:
+        raise RuntimeError("orbax-checkpoint is not installed")
+    raw = _checkpointer().restore(os.path.abspath(os.fspath(path)))
+    try:
+        return raw["params"]
+    except (KeyError, TypeError, IndexError):
+        raise ValueError(
+            f"{os.fspath(path)!r} holds no 'params' subtree — not a saved "
+            "TrainState?") from None
+
+
 def latest_step_dir(root: str | os.PathLike) -> str | None:
     """Return the highest-numbered ``step_N`` subdirectory, or None.
 
