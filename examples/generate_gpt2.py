@@ -57,10 +57,15 @@ def main() -> None:
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
 
-    if args.beam is not None and (args.temperature or args.top_k
-                                  or args.top_p):
+    if args.beam is not None and (args.temperature != 0.0
+                                  or args.top_k is not None
+                                  or args.top_p is not None):
         raise SystemExit("error: --beam is deterministic max-probability "
                          "search; drop --temperature/--top-k/--top-p")
+    if args.temperature < 0:
+        raise SystemExit(f"error: --temperature must be >= 0 (got "
+                         f"{args.temperature}); negative values would "
+                         "sample an inverted distribution")
     if (args.top_k is not None or args.top_p is not None) \
             and args.temperature == 0.0:
         raise SystemExit("error: --top-k/--top-p shape the SAMPLING "
@@ -81,7 +86,6 @@ def main() -> None:
     import numpy as np
 
     from tpudp.models.gpt2 import GPT2, GPT2Config
-    from tpudp.train import init_state, make_optimizer
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     cfg = GPT2Config(
@@ -106,15 +110,34 @@ def main() -> None:
                 "— generating from random weights would be misleading; "
                 "drop --checkpoint-dir for an explicit random-init demo")
         params = restore_params(latest)
+        # The restore is target-free, so a config/checkpoint mismatch
+        # would otherwise decode silently with half the layers or a
+        # clamped vocab — validate the structure against the CLI flags.
+        n_layers = sum(1 for k in params if str(k).startswith("h_"))
+        wte = params["wte"]["embedding"]
+        if n_layers != cfg.num_layers or wte.shape != (cfg.vocab_size,
+                                                       cfg.d_model):
+            raise SystemExit(
+                f"error: checkpoint {latest} holds {n_layers} layers and "
+                f"wte {tuple(wte.shape)}, but the flags describe "
+                f"{cfg.num_layers} layers / vocab {cfg.vocab_size} x "
+                f"d_model {cfg.d_model} — pass the training run's "
+                "--layers/--d-model/--vocab")
         print(f"[generate] restored params from {latest}")
     else:
-        params = init_state(model, tx=make_optimizer(),
-                            input_shape=(1, min(args.seq_len, 16))).params
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, min(args.seq_len, 16)),
+                                      jnp.int32))["params"]
         print("[generate] RANDOM-INIT weights (no --checkpoint-dir): "
               "output demonstrates the decode path, not a trained model")
 
     if args.prompt_ids:
-        ids = [int(x) for x in args.prompt_ids.split(",")]
+        try:
+            ids = [int(x) for x in args.prompt_ids.split(",")]
+        except ValueError:
+            raise SystemExit(
+                f"error: --prompt-ids must be comma-separated integers "
+                f"(got {args.prompt_ids!r})") from None
     else:
         # first tokens of the training examples' deterministic corpus
         rng = np.random.default_rng(0)

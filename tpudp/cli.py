@@ -44,6 +44,12 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timing-mode", choices=["fused", "split"], default="fused")
     p.add_argument("--dtype", choices=["float32", "bfloat16"], default="float32")
+    p.add_argument("--model", choices=["vgg11", "vgg13", "vgg16", "vgg19"],
+                   default="vgg11",
+                   help="VGG variant (reference default VGG-11; the "
+                        "reference's config table defines 13/16/19 but "
+                        "never exports them — src/Part 1/model.py:3-8,49-50 "
+                        "— tpudp makes the whole table launchable)")
     p.add_argument("--checkpoint-dir", type=str, default=None,
                    help="save TrainState each epoch and auto-resume from the "
                         "latest checkpoint (beyond-reference capability)")
@@ -132,7 +138,7 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     """Shared Part-N driver: parse flags, build mesh/data/model, fit."""
     import jax.numpy as jnp
 
-    from tpudp.models import VGG11
+    from tpudp.models import VGG11, VGG13, VGG16, VGG19
 
     args = build_parser(description).parse_args(argv)
     if args.spmd_mode is not None:
@@ -226,8 +232,10 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         test_loader = Prefetcher(test_loader, depth=args.prefetch)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model = VGG11(dtype=dtype,
-                  bn_axis=DATA_AXIS if args.sync_bn else None)
+    factory = {"vgg11": VGG11, "vgg13": VGG13, "vgg16": VGG16,
+               "vgg19": VGG19}[args.model]
+    model = factory(dtype=dtype,
+                    bn_axis=DATA_AXIS if args.sync_bn else None)
     watchdog = None
     if args.step_timeout:
         from tpudp.utils.watchdog import Watchdog
@@ -244,7 +252,8 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                       watchdog=watchdog, grad_accum=args.grad_accum,
                       remat=args.remat, metrics_jsonl=args.metrics_jsonl,
                       verify_replicas=args.verify_replicas)
-    print(f"[tpudp] sync={sync} devices={world} hosts={num_hosts} "
+    print(f"[tpudp] model={args.model} sync={sync} devices={world} "
+          f"hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
           f"data={data_backend}+prefetch{args.prefetch}")
     print(f"[tpudp] train samples={len(train_set.images)} "
