@@ -113,7 +113,7 @@ def main() -> None:
         # The restore is target-free, so a config/checkpoint mismatch
         # would otherwise decode silently with half the layers or a
         # clamped vocab — validate the structure against the CLI flags.
-        n_layers = sum(1 for k in params if str(k).startswith("h_"))
+        n_layers = sum(1 for k in params if k.startswith("h_"))
         wte = params["wte"]["embedding"]
         if n_layers != cfg.num_layers or wte.shape != (cfg.vocab_size,
                                                        cfg.d_model):
@@ -140,8 +140,9 @@ def main() -> None:
                 f"(got {args.prompt_ids!r})") from None
     else:
         # first tokens of the training examples' deterministic corpus
+        # (same draw as train_gpt2.py's base sequence)
         rng = np.random.default_rng(0)
-        ids = (rng.integers(0, args.vocab, size=4096)[:8] % args.vocab).tolist()
+        ids = rng.integers(0, args.vocab, size=4096)[:8].tolist()
     if not ids or any(not 0 <= i < args.vocab for i in ids):
         raise SystemExit(f"error: prompt ids must be in [0, {args.vocab})")
     prompt = jnp.asarray([ids], jnp.int32)
