@@ -78,6 +78,12 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.save_checkpoint:
+        # Fail fast on a missing orbax / unwritable DIR before
+        # any compute is spent (tpudp/utils/checkpoint.py).
+        from tpudp.utils.checkpoint import ensure_writable
+
+        ensure_writable(args.save_checkpoint)
     from tpudp.utils.compile_cache import enable_persistent_cache
     from tpudp.utils.device_lock import acquire_for_process
 

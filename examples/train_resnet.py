@@ -84,6 +84,9 @@ def main() -> None:
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--save-checkpoint", type=str, default=None,
+                   metavar="DIR",
+                   help="save the final TrainState to DIR/step_<steps> (orbax)")
     p.add_argument("--platform", type=str, default=None)
     p.add_argument("--imagenet-root", type=str, default=None)
     args = p.parse_args()
@@ -92,6 +95,12 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.save_checkpoint:
+        # Fail fast on a missing orbax / unwritable DIR before
+        # any compute is spent (tpudp/utils/checkpoint.py).
+        from tpudp.utils.checkpoint import ensure_writable
+
+        ensure_writable(args.save_checkpoint)
     from tpudp.utils.compile_cache import enable_persistent_cache
     from tpudp.utils.device_lock import acquire_for_process
 
@@ -174,6 +183,13 @@ def main() -> None:
             print(f"step {i}: loss {(cum - prev_cum) / args.log_every:.4f} "
                   f"({ips:,.1f} images/s)")
             prev_cum, t0 = cum, time.perf_counter()
+
+    if args.save_checkpoint:
+        from tpudp.utils.checkpoint import save_checkpoint
+
+        ckpt = save_checkpoint(
+            os.path.join(args.save_checkpoint, f"step_{args.steps}"), state)
+        print(f"[resnet] saved checkpoint {ckpt}")
 
 
 if __name__ == "__main__":

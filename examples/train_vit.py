@@ -54,6 +54,9 @@ def main() -> None:
                    default="bfloat16")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--save-checkpoint", type=str, default=None,
+                   metavar="DIR",
+                   help="save the final TrainState to DIR/step_<steps> (orbax)")
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
 
@@ -61,6 +64,12 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.save_checkpoint:
+        # Fail fast on a missing orbax / unwritable DIR before
+        # any compute is spent (tpudp/utils/checkpoint.py).
+        from tpudp.utils.checkpoint import ensure_writable
+
+        ensure_writable(args.save_checkpoint)
     from tpudp.utils.compile_cache import enable_persistent_cache
     from tpudp.utils.device_lock import acquire_for_process
 
@@ -146,6 +155,13 @@ def main() -> None:
             print(f"step {i}: loss {(cum - prev_cum) / args.log_every:.4f} "
                   f"({ips:,.1f} images/s)")
             prev_cum, t0 = cum, time.perf_counter()
+
+    if args.save_checkpoint:
+        from tpudp.utils.checkpoint import save_checkpoint
+
+        ckpt = save_checkpoint(
+            os.path.join(args.save_checkpoint, f"step_{args.steps}"), state)
+        print(f"[vit] saved checkpoint {ckpt}")
 
 
 if __name__ == "__main__":

@@ -249,6 +249,24 @@ def prune_step_dirs(root: str | os.PathLike, keep: int) -> list[str]:
     return deleted
 
 
+def ensure_writable(root: str | os.PathLike) -> str:
+    """Fail-fast probe for --save-checkpoint flags: verify orbax is
+    importable and the destination is creatable/writable BEFORE any
+    compute is spent — a save error discovered after a long training run
+    loses the run (round-4 review finding)."""
+    if not HAVE_ORBAX:
+        raise RuntimeError(
+            "orbax-checkpoint is not installed; --save-checkpoint cannot "
+            "work — aborting before training rather than after")
+    root = os.path.abspath(os.fspath(root))
+    os.makedirs(root, exist_ok=True)
+    probe = os.path.join(root, ".write_probe")
+    with open(probe, "w") as f:
+        f.write("ok")
+    os.unlink(probe)
+    return root
+
+
 def restore_params(path: str | os.PathLike):
     """Restore ONLY the ``params`` subtree of a saved TrainState.
 
