@@ -138,3 +138,20 @@ def test_restore_params_ignores_optimizer_structure(tmp_path):
     save_checkpoint(str(tmp_path / "junk"), {"not_params": 1})
     with pytest.raises(ValueError, match="params"):
         restore_params(str(tmp_path / "junk"))
+
+
+def test_ensure_writable_probe(tmp_path, monkeypatch):
+    """Fail-fast --save-checkpoint probe: creates the destination and
+    verifies writability up front; without orbax it refuses BEFORE any
+    training compute would be spent."""
+    from tpudp.utils import checkpoint as ck
+
+    root = ck.ensure_writable(tmp_path / "new" / "dir")
+    import os
+
+    assert os.path.isdir(root)
+    assert not os.listdir(root)  # the probe file was removed
+
+    monkeypatch.setattr(ck, "HAVE_ORBAX", False)
+    with pytest.raises(RuntimeError, match="orbax"):
+        ck.ensure_writable(tmp_path / "other")
