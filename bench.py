@@ -234,6 +234,10 @@ def child_main() -> None:
         "dtype": dtype_name,
         "param_dtype": param_dtype,
         "sync": sync,
+        # Which wire schedule a ring-family label measured (round-4
+        # advisor: the 'ring' label flipped bidirectional->uni, so rows
+        # must say which one ran); None for non-ring rungs.
+        "ring_direction": _ring_direction(sync),
         "sec_per_step": round(sec_per_step, 5),
         "mfu": round(step_mfu, 4) if step_mfu is not None else None,
         "model_flops_per_step": flops_per_step,
@@ -356,6 +360,14 @@ def _requested_sync() -> str:
     return sync
 
 
+def _ring_direction(sync: str) -> str | None:
+    """Wire-schedule stamp for ring-family rungs (see
+    tpudp.parallel.sync.RING_DIRECTION); None for every other rung."""
+    from tpudp.parallel.sync import RING_DIRECTION
+
+    return RING_DIRECTION.get(sync)
+
+
 def _banked_good(sync: str, param_dtype: str) -> dict | None:
     """Newest banked REAL headline measurement, or None.
 
@@ -374,9 +386,17 @@ def _banked_good(sync: str, param_dtype: str) -> dict | None:
                 and "TPU" in str(row.get("device_kind", ""))
                 # banked evidence must be for the SAME rung and the same
                 # param dtype being requested (rows predating those fields
-                # were allreduce / float32)
+                # were allreduce / float32), and for the 'ring' label the
+                # post-flip "uni" stamp: only THAT label changed meaning
+                # in round 4, so an unstamped 'ring' row measured the old
+                # bidirectional schedule, while unstamped ring_uni/
+                # ring_bidir rows stay valid (their labels always named
+                # one direction).  A present stamp must match regardless.
                 and row.get("sync", "allreduce") == sync
                 and row.get("param_dtype", "float32") == param_dtype
+                and (row.get("ring_direction") == "uni" if sync == "ring"
+                     else row.get("ring_direction")
+                     in (None, _ring_direction(sync)))
                 and isinstance(row.get("value"), (int, float))
                 and row["value"] > 0)
         ]

@@ -96,14 +96,22 @@ def main() -> None:
         dt = (time.perf_counter() - t0) / steps
         # ring all-reduce lower bound: 2(n-1)/n of the payload per device
         wire = 2 * (n - 1) / n * nbytes if n > 1 else 0
-        print(json.dumps({
+        row = {
             "strategy": name,
             "wall_time_s": round(dt, 6),
             "bytes": nbytes,
             "gbps": round(wire / dt / 1e9, 3) if dt > 0 else 0.0,
             "devices": n,
             "device_kind": kind,
-        }), flush=True)
+        }
+        # Wire-schedule stamp for ring-family strategies (round-4 advisor:
+        # the 'ring' label flipped bidirectional->uni; the resume gate
+        # refuses unstamped 'ring' rows as evidence for the renamed rung).
+        from tpudp.parallel.sync import RING_DIRECTION
+
+        if name in RING_DIRECTION:
+            row["ring_direction"] = RING_DIRECTION[name]
+        print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

@@ -153,6 +153,14 @@ def main() -> None:
         y = labels if m is None else jax.device_put(labels, data_sh)
         sec, loss = measure(step, state, (x, y), steps, warmup)
         extra = {"sync": sync, "spmd_mode": mode}
+        # Wire-schedule stamp for ring-family rungs (round-4 advisor: the
+        # 'ring' label flipped bidirectional->uni; a row must say which
+        # schedule it measured, and the matrix resume gate refuses
+        # unstamped dp_ring rows as measurements of the renamed rung).
+        from tpudp.parallel.sync import RING_DIRECTION
+
+        if sync in RING_DIRECTION:
+            extra["ring_direction"] = RING_DIRECTION[sync]
         if m is not None and n_dev > 1:
             if grad_tree is None:
                 grad_tree = jax.tree.map(jnp.zeros_like, state.params)

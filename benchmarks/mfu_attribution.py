@@ -22,7 +22,17 @@ produces that evidence two independent ways:
 
 One JSON line per variant plus one ``trace_ops`` line; the watcher
 redirects to bench_results/mfu.jsonl.  Knobs: MFU_BATCH (256), MFU_STEPS
-(30), MFU_WARMUP (3), MFU_PLATFORM (cpu smoke), MFU_TRACE=0 (skip trace).
+(30), MFU_WARMUP (3), MFU_PLATFORM (cpu smoke), MFU_TRACE=0 (skip trace),
+MFU_VARIANTS (comma-separated subset of
+``full,fwd_bwd,fwd_only,no_bn,bf16_params``; default all).
+
+MFU_VARIANTS exists for the round-5 micro battery (VERDICT r4 #1): the
+only healthy relay window ever observed lasted ~12 minutes, so the
+watcher's first pass runs just ``full,bf16_params`` — the denominator and
+the one actionable lever — and later windows fill the remaining ablations
+via tools/bench_gaps.py.  ``full`` always runs even when not listed: every
+other variant's share/speedup field is a ratio against the same-window
+``sec_full`` (cross-window ratios would mix relay conditions).
 """
 
 import json
@@ -59,6 +69,20 @@ def main() -> None:
     steps = int(os.environ.get("MFU_STEPS", 30))
     # >=1: the pre-timing fence needs at least one completed dispatch
     warmup = max(int(os.environ.get("MFU_WARMUP", 3)), 1)
+    # Single-sourced from the gap helper: the watcher pipes bench_gaps.py
+    # output straight into MFU_VARIANTS, so a variant list that drifted
+    # between the two files would make the strict validation below kill
+    # the stage on every window (bench_gaps is stdlib-only — importing it
+    # here costs nothing).
+    from tools.bench_gaps import MFU_VARIANTS as all_variants
+
+    raw = os.environ.get("MFU_VARIANTS", "")
+    selected = {v.strip() for v in raw.split(",") if v.strip()} or set(
+        all_variants)
+    unknown = selected - set(all_variants)
+    if unknown:
+        raise SystemExit(f"error: MFU_VARIANTS contains unknown variants "
+                         f"{sorted(unknown)}; choose from {all_variants}")
     kind = jax.devices()[0].device_kind
     flops = train_step_flops(vgg_fwd_flops(batch))
 
@@ -120,75 +144,84 @@ def main() -> None:
     sec_full, _ = timed(full, lambda s: s.params)
     emit("full", sec_full)
 
-    # fwd+bwd only (no optimizer update)
-    def loss_fn(params, batch_stats):
-        variables = {"params": params, "batch_stats": batch_stats}
-        logits, upd = model.apply(variables, x, train=True,
-                                  mutable=["batch_stats"])
-        one = jax.nn.one_hot(y, 10)
-        return -jnp.mean(jnp.sum(one * jax.nn.log_softmax(logits), -1)), upd
+    if {"fwd_bwd", "fwd_only"} & selected:
+        state2 = init_state(model, tx)
 
-    grad_fn = jax.jit(jax.grad(loss_fn, has_aux=True))
-    state2 = init_state(model, tx)
+    if "fwd_bwd" in selected:
+        # fwd+bwd only (no optimizer update)
+        def loss_fn(params, batch_stats):
+            variables = {"params": params, "batch_stats": batch_stats}
+            logits, upd = model.apply(variables, x, train=True,
+                                      mutable=["batch_stats"])
+            one = jax.nn.one_hot(y, 10)
+            return (-jnp.mean(jnp.sum(one * jax.nn.log_softmax(logits), -1)),
+                    upd)
 
-    def fwd_bwd():
-        return grad_fn(state2.params, state2.batch_stats)
+        grad_fn = jax.jit(jax.grad(loss_fn, has_aux=True))
 
-    sec_gb, _ = timed(fwd_bwd, lambda out: out[0])
-    emit("fwd_bwd", sec_gb,
-         {"optimizer_share_of_full": round(1 - sec_gb / sec_full, 4)})
+        def fwd_bwd():
+            return grad_fn(state2.params, state2.batch_stats)
 
-    # fwd only (train mode, batch_stats mutable — the bench's fwd path)
-    fwd = jax.jit(lambda p, b: model.apply(
-        {"params": p, "batch_stats": b}, x, train=True,
-        mutable=["batch_stats"]))
+        sec_gb, _ = timed(fwd_bwd, lambda out: out[0])
+        emit("fwd_bwd", sec_gb,
+             {"optimizer_share_of_full": round(1 - sec_gb / sec_full, 4)})
 
-    def fwd_only():
-        return fwd(state2.params, state2.batch_stats)
+    if "fwd_only" in selected:
+        # fwd only (train mode, batch_stats mutable — the bench's fwd path)
+        fwd = jax.jit(lambda p, b: model.apply(
+            {"params": p, "batch_stats": b}, x, train=True,
+            mutable=["batch_stats"]))
 
-    sec_f, _ = timed(fwd_only, lambda out: out[0])
-    emit("fwd_only", sec_f, {"share_of_full": round(sec_f / sec_full, 4)})
+        def fwd_only():
+            return fwd(state2.params, state2.batch_stats)
 
-    # BN ablated
-    nobn = VGGNoBN()
-    state3 = init_state(nobn, tx)
-    step3 = make_train_step(nobn, tx, None, "none", spmd_mode="single",
-                            donate=True)
-    st3 = state3
+        sec_f, _ = timed(fwd_only, lambda out: out[0])
+        emit("fwd_only", sec_f,
+             {"share_of_full": round(sec_f / sec_full, 4)})
 
-    def full_nobn():
-        nonlocal st3
-        st3, _ = step3(st3, x, y)
-        return st3
+    if "no_bn" in selected:
+        # BN ablated
+        nobn = VGGNoBN()
+        state3 = init_state(nobn, tx)
+        step3 = make_train_step(nobn, tx, None, "none", spmd_mode="single",
+                                donate=True)
+        st3 = state3
 
-    sec_nobn, _ = timed(full_nobn, lambda s: s.params)
-    emit("no_bn", sec_nobn,
-         {"bn_share_of_full": round(1 - sec_nobn / sec_full, 4)})
+        def full_nobn():
+            nonlocal st3
+            st3, _ = step3(st3, x, y)
+            return st3
 
-    # bf16 params + momentum: halve weight-side HBM traffic
-    state4 = init_state(model, tx)
-    state4 = state4.replace(
-        params=jax.tree.map(lambda a: a.astype(jnp.bfloat16), state4.params),
-        opt_state=jax.tree.map(
-            lambda a: (a.astype(jnp.bfloat16)
-                       if isinstance(a, jax.Array)
-                       and a.dtype == jnp.float32 else a),
-            state4.opt_state))
-    st4 = state4
+        sec_nobn, _ = timed(full_nobn, lambda s: s.params)
+        emit("no_bn", sec_nobn,
+             {"bn_share_of_full": round(1 - sec_nobn / sec_full, 4)})
 
-    def full_bf16p():
-        nonlocal st4
-        st4, _ = step(st4, x, y)
-        return st4
+    if "bf16_params" in selected:
+        # bf16 params + momentum: halve weight-side HBM traffic
+        state4 = init_state(model, tx)
+        state4 = state4.replace(
+            params=jax.tree.map(lambda a: a.astype(jnp.bfloat16),
+                                state4.params),
+            opt_state=jax.tree.map(
+                lambda a: (a.astype(jnp.bfloat16)
+                           if isinstance(a, jax.Array)
+                           and a.dtype == jnp.float32 else a),
+                state4.opt_state))
+        st4 = state4
 
-    try:
-        sec_bf16, _ = timed(full_bf16p, lambda s: s.params)
-        emit("bf16_params", sec_bf16,
-             {"speedup_vs_full": round(sec_full / sec_bf16, 4)})
-    except Exception as exc:  # noqa: BLE001 — attribution row, not critical
-        print(json.dumps({"variant": "bf16_params",
-                          "error": f"{type(exc).__name__}: {exc}"[:300]}),
-              flush=True)
+        def full_bf16p():
+            nonlocal st4
+            st4, _ = step(st4, x, y)
+            return st4
+
+        try:
+            sec_bf16, _ = timed(full_bf16p, lambda s: s.params)
+            emit("bf16_params", sec_bf16,
+                 {"speedup_vs_full": round(sec_full / sec_bf16, 4)})
+        except Exception as exc:  # noqa: BLE001 — attribution row only
+            print(json.dumps({"variant": "bf16_params",
+                              "error": f"{type(exc).__name__}: {exc}"[:300]}),
+                  flush=True)
 
     # XLA trace of the full step, parsed per-op
     if os.environ.get("MFU_TRACE", "1") != "0":

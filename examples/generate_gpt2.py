@@ -30,7 +30,12 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--d-model", type=int, default=64)
-    p.add_argument("--heads", type=int, default=None)
+    p.add_argument("--heads", type=int, default=None,
+                   help="attention heads (default d_model//64).  With "
+                        "--checkpoint-dir this MUST match the training "
+                        "run: the head count is not recoverable from the "
+                        "fused QKV params, and a wrong value reshapes "
+                        "attention silently into garbage")
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -123,6 +128,27 @@ def main() -> None:
                 f"{cfg.num_layers} layers / vocab {cfg.vocab_size} x "
                 f"d_model {cfg.d_model} — pass the training run's "
                 "--layers/--d-model/--vocab")
+        # wpe mismatch is the silent one: decoding past the trained
+        # max_seq_len clamps the position-embedding gather (JAX clamp
+        # semantics) — garbage output, no error (round-4 advisor).
+        wpe = params["wpe"]["embedding"]
+        if wpe.shape != (cfg.max_seq_len, cfg.d_model):
+            raise SystemExit(
+                f"error: checkpoint {latest} holds wpe "
+                f"{tuple(wpe.shape)}, but the flags describe max_seq_len "
+                f"{cfg.max_seq_len} x d_model {cfg.d_model} — pass the "
+                "training run's --seq-len (positions past the trained "
+                "length would silently clamp, not error)")
+        # --heads is NOT recoverable from params (attention weights are
+        # stored fused at d_model width), so a wrong value reshapes Q/K/V
+        # silently into the wrong heads.  It must match the training run;
+        # the head-dim divisibility check below is the only guard possible
+        # from params alone.
+        if cfg.d_model % cfg.num_heads:
+            raise SystemExit(
+                f"error: d_model {cfg.d_model} is not divisible by "
+                f"num_heads {cfg.num_heads} — pass the training run's "
+                "--heads")
         print(f"[generate] restored params from {latest}")
     else:
         params = model.init(jax.random.PRNGKey(0),

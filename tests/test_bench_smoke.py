@@ -71,9 +71,20 @@ def test_banked_fallback_selection(tmp_path, monkeypatch):
          "measured_at_utc": "2026-07-30T03:00:00Z",
          "source": "last_known_good"},
         # a different sync rung's measurement must never stand in for the
-        # requested one
+        # requested one.  This ring row predates the round-4 direction
+        # flip (no ring_direction stamp) — it measured the OLD
+        # bidirectional schedule and must not satisfy a 'ring' request
+        # under the new single-direction meaning (round-4 advisor).
         {"metric": bench.METRIC, "value": 400.0, "device_kind": "TPU v5",
          "measured_at_utc": "2026-07-30T06:00:00Z", "sync": "ring"},
+        # a post-flip ring row carries the stamp and DOES qualify
+        {"metric": bench.METRIC, "value": 450.0, "device_kind": "TPU v5",
+         "measured_at_utc": "2026-07-30T05:30:00Z", "sync": "ring",
+         "ring_direction": "uni"},
+        # ring_bidir's label never changed meaning, so its unstamped
+        # pre-stamp row stays valid evidence
+        {"metric": bench.METRIC, "value": 460.0, "device_kind": "TPU v5",
+         "measured_at_utc": "2026-07-30T05:40:00Z", "sync": "ring_bidir"},
         # nor may a different param dtype's (bf16-params vs fp32)
         {"metric": bench.METRIC, "value": 500.0, "device_kind": "TPU v5",
          "measured_at_utc": "2026-07-30T07:00:00Z",
@@ -90,8 +101,13 @@ def test_banked_fallback_selection(tmp_path, monkeypatch):
                         lambda: str(tmp_path / "bench.json"))
     good = bench._banked_good("allreduce", "float32")
     assert good is not None and good["value"] == 100.0
+    # newest UNSTAMPED ring row (400.0, pre-flip bidirectional capture)
+    # must lose to the older stamped single-direction row (450.0)
     ring = bench._banked_good("ring", "float32")
-    assert ring is not None and ring["value"] == 400.0
+    assert ring is not None and ring["value"] == 450.0
+    # unstamped ring_bidir evidence stays valid (label never flipped)
+    bidir = bench._banked_good("ring_bidir", "float32")
+    assert bidir is not None and bidir["value"] == 460.0
     bf16 = bench._banked_good("allreduce", "bfloat16")
     assert bf16 is not None and bf16["value"] == 500.0
 

@@ -37,6 +37,41 @@ def test_matrix_gaps_ignore_errors_and_merge_history(tmp_path):
     assert "gpt2_small" in missing
 
 
+def test_matrix_gap_refuses_unstamped_dp_ring(tmp_path):
+    """Round-4 advisor: the 'ring' label flipped bidirectional->uni, so a
+    banked dp_ring row with no ring_direction stamp (or the stamp of the
+    OTHER direction) measured a different algorithm and must not close
+    the rung's gap."""
+    d = str(tmp_path)
+    _write(os.path.join(d, "matrix.history.jsonl"), [
+        {"config": "dp_ring", "value": 90000.0, "sync": "ring"}])
+    assert "dp_ring" in matrix_missing(d)
+    _write(os.path.join(d, "matrix.jsonl"), [
+        {"config": "dp_ring", "value": 90000.0, "sync": "ring",
+         "ring_direction": "bidir"}])  # wrong-direction stamp: still owed
+    assert "dp_ring" in matrix_missing(d)
+    _write(os.path.join(d, "matrix.jsonl"), [
+        {"config": "dp_ring", "value": 90000.0, "sync": "ring",
+         "ring_direction": "uni"}])
+    assert "dp_ring" not in matrix_missing(d)
+
+
+def test_gap_gate_constants_pin_the_sync_module():
+    """bench_gaps must stay stdlib-only (the watcher polls it cheaply),
+    so its 'uni' literal and the attribution variant list are duplicated
+    from / consumed by jax-importing modules — pin them together."""
+    from tools.bench_gaps import MFU_VARIANTS
+
+    from tpudp.parallel.sync import RING_DIRECTION
+
+    assert RING_DIRECTION["ring"] == "uni"  # matrix_missing's literal
+    # every variant the gap gate can report must be one the attribution
+    # bench accepts (it validates MFU_VARIANTS strictly and single-sources
+    # this tuple, so equality here means the watcher pipe can't stall)
+    assert MFU_VARIANTS == ("full", "fwd_bwd", "fwd_only", "no_bn",
+                            "bf16_params")
+
+
 def test_flash_gaps(tmp_path):
     d = str(tmp_path)
     assert flash_missing(d) == list(FLASH_TS)
@@ -143,6 +178,22 @@ def test_mfu_gap_requires_all_variants_on_tpu(tmp_path):
     assert not mfu_missing(d)  # all measured + bf16 attempted (error row)
 
 
+def test_mfu_gap_reports_missing_variants_for_resume(tmp_path):
+    """Round-5 micro battery: the first window measures only
+    full+bf16_params; the gap list is what the full stage passes to
+    MFU_VARIANTS, so it must name exactly the remaining ablations."""
+    d = str(tmp_path)
+    assert mfu_missing(d) == ["full", "fwd_bwd", "fwd_only", "no_bn",
+                              "bf16_params"]
+    _write(os.path.join(d, "mfu.history.jsonl"), [
+        {"variant": "full", "sec_per_step": 0.003,
+         "device_kind": "TPU v5 lite"},
+        {"variant": "bf16_params", "sec_per_step": 0.002,
+         "device_kind": "TPU v5 lite"},
+    ])
+    assert mfu_missing(d) == ["fwd_bwd", "fwd_only", "no_bn"]
+
+
 def test_collective_gap_gate(tmp_path):
     """The ring-default evidence stage (VERDICT r3 #5): complete on real
     multi-device TPU rows for all three key schedules, or on a labeled
@@ -169,11 +220,23 @@ def test_collective_gap_gate(tmp_path):
         json.dump({"devices": 8, "device_kind": "TPU v4"}, f)
     assert collective_missing(d)
 
-    # real multi-device TPU rows for all three schedules close it for good
+    # real multi-device TPU rows do NOT close it while the 'ring' row is
+    # unstamped: a pre-flip capture measured the bidirectional schedule
+    # (round-4 advisor), so the renamed rung is still owed its number
     _write(os.path.join(d, "collective.history.jsonl"), [
         {"strategy": s, "wall_time_s": 0.01, "devices": 8,
          "device_kind": "TPU v4"}
         for s in ("allreduce", "ring", "ring_bidir")])
+    assert collective_missing(d)
+
+    # with the post-flip stamp on 'ring', the stage closes for good
+    _write(os.path.join(d, "collective.history.jsonl"), [
+        {"strategy": "allreduce", "wall_time_s": 0.01, "devices": 8,
+         "device_kind": "TPU v4"},
+        {"strategy": "ring", "wall_time_s": 0.01, "devices": 8,
+         "device_kind": "TPU v4", "ring_direction": "uni"},
+        {"strategy": "ring_bidir", "wall_time_s": 0.01, "devices": 8,
+         "device_kind": "TPU v4"}])
     assert not collective_missing(d)
 
     # incomplete schedule coverage keeps the gap open

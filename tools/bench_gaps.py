@@ -10,8 +10,8 @@ the missing work as arguments the benches accept:
     python tools/bench_gaps.py flash    -> space-separated t values (argv)
     python tools/bench_gaps.py epoch    -> "epoch" if the epoch-throughput
                                            row is still missing
-    python tools/bench_gaps.py mfu      -> "mfu" if the MFU-attribution
-                                           sweep is still missing
+    python tools/bench_gaps.py mfu      -> comma-separated MFU_VARIANTS
+                                           (ablations still unmeasured)
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -84,6 +84,16 @@ def matrix_missing(d: str) -> list[str]:
     done = set()
     for r in rows_with_history(os.path.join(d, "matrix.jsonl")):
         if r.get("config") in MATRIX_CONFIGS and measured(r):
+            # dp_ring rows must have measured the wire schedule the label
+            # CURRENTLY means (round-4 advisor: 'ring' flipped
+            # bidirectional -> uni, so an unstamped pre-flip row — or a
+            # stamped row for the other direction — is evidence for a
+            # different algorithm and the rung is still owed a number).
+            # "uni" is duplicated from tpudp.parallel.sync.RING_DIRECTION
+            # ["ring"] because this helper must stay stdlib-only (no jax
+            # import on the watcher's poll path); a test pins the two.
+            if r["config"] == "dp_ring" and r.get("ring_direction") != "uni":
+                continue
             done.add(r["config"])
     return [c for c in MATRIX_CONFIGS if c not in done]
 
@@ -102,13 +112,18 @@ def epoch_missing(d: str) -> bool:
         for r in rows_with_history(os.path.join(d, "epoch.json")))
 
 
-def mfu_missing(d: str) -> bool:
-    """The attribution sweep counts once every ablation variant has a real
-    TPU measurement (a CPU-smoke row must not satisfy the gate).  Gating
-    only on the FIRST emitted row would let a window that died mid-sweep
-    mark the stage complete with the attribution missing.  bf16_params may
-    legitimately fail (the bench emits an error row and continues), so for
-    it an attempt of any outcome suffices."""
+MFU_VARIANTS = ("full", "fwd_bwd", "fwd_only", "no_bn", "bf16_params")
+
+
+def mfu_missing(d: str) -> list[str]:
+    """Ablation variants that still lack a real TPU measurement (a
+    CPU-smoke row must not satisfy the gate).  Returned as a list the
+    watcher passes straight to ``MFU_VARIANTS`` so a window resumes the
+    sweep mid-way instead of restarting it (round-5 micro battery:
+    the first window runs only ``full,bf16_params``; the remaining
+    ablations are exactly this gap).  bf16_params may legitimately fail
+    (the bench emits an error row and continues), so for it an attempt of
+    any outcome suffices."""
     rows = list(rows_with_history(os.path.join(d, "mfu.jsonl")))
     have = {r["variant"] for r in rows
             if r.get("variant") and measured(r)
@@ -121,8 +136,8 @@ def mfu_missing(d: str) -> bool:
                  if r.get("variant")
                  and ("device_kind" not in r
                       or "TPU" in str(r.get("device_kind", "")))}
-    need = {"full", "fwd_bwd", "fwd_only", "no_bn"}
-    return not (need <= have and "bf16_params" in attempted)
+    return [v for v in MFU_VARIANTS
+            if (v not in attempted if v == "bf16_params" else v not in have)]
 
 
 def collective_missing(d: str) -> bool:
@@ -136,9 +151,15 @@ def collective_missing(d: str) -> bool:
     instead).  A probe that sees a multi-chip slice re-opens the stage:
     the skip row must not mask the measurement it exists to schedule."""
     rows = list(rows_with_history(os.path.join(d, "collective.jsonl")))
+    # 'ring' rows must carry the post-flip "uni" stamp (round-4 advisor:
+    # a pre-flip row measured the bidirectional schedule — the hazard the
+    # stage exists to disambiguate).  Same stdlib-only duplication of
+    # sync.RING_DIRECTION["ring"] as matrix_missing; test-pinned.
     have = {r.get("strategy") for r in rows
             if measured(r) and r.get("devices", 0) > 1
-            and "TPU" in str(r.get("device_kind", ""))}
+            and "TPU" in str(r.get("device_kind", ""))
+            and (r.get("strategy") != "ring"
+                 or r.get("ring_direction") == "uni")}
     if {"allreduce", "ring", "ring_bidir"} <= have:
         return False
     try:
@@ -162,7 +183,7 @@ def main() -> None:
     elif args.stage == "epoch":
         print("epoch" if epoch_missing(args.dir) else "", end="")
     elif args.stage == "mfu":
-        print("mfu" if mfu_missing(args.dir) else "", end="")
+        print(",".join(mfu_missing(args.dir)), end="")
     elif args.stage == "collective":
         print("collective" if collective_missing(args.dir) else "", end="")
     else:

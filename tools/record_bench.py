@@ -87,6 +87,18 @@ def main() -> None:
         (r for r in _rows(os.path.join(args.dir, "matrix.jsonl"))
          if "config" in r and "matrix" not in r), "config")
     for r in matrix.values():
+        # Same refusal as the resume gate (bench_gaps.matrix_missing): a
+        # dp_ring row without the post-flip "uni" stamp measured the OLD
+        # bidirectional schedule and must not be published as the current
+        # single-direction rung's number (round-4 advisor).
+        if (r["config"] == "dp_ring" and measured(r)
+                and r.get("ring_direction") != "uni"):
+            print(f"| dp_ring | (pre-flip ring-schedule row"
+                  f"{' from ' + str(r['measured_at_utc']) if r.get('measured_at_utc') else ''}"
+                  f" — measured the bidirectional schedule, not the "
+                  f"current single-direction 'ring'; rung still owed) | "
+                  f"`matrix_bench.py` | |")
+            continue
         if not measured(r):
             print(f"| {r['config']} | ERROR: "
                   f"{r.get('error', 'no real measurement')[:120]} | "

@@ -124,8 +124,8 @@ def hd_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     multi-hop routes on a TPU torus, where the bidirectional ring's
     neighbor-only traffic is the better fit for large payloads.
 
-    Requires a power-of-two axis size (falls back to the bidirectional
-    ring otherwise).
+    Requires a power-of-two axis size (falls back to the default
+    single-direction ring otherwise).
     """
     n = lax.axis_size(axis_name)
     if n == 1:
@@ -135,8 +135,9 @@ def hd_all_reduce(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
 
         warnings.warn(
             f"hd_all_reduce needs a power-of-two axis size (got {n}); "
-            "falling back to the bidirectional ring — timings labeled "
-            "'hd' on this mesh measure the ring schedule",
+            "falling back to the single-direction ring (the measured "
+            "ring_all_reduce default) — timings labeled 'hd' on this "
+            "mesh measure the ring schedule",
             stacklevel=2)
         return ring_all_reduce(x, axis_name)
     levels = n.bit_length() - 1

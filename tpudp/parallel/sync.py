@@ -194,6 +194,19 @@ def sync_allreduce_int8(grads, axis_name):
 # program.  Kept as a distinct name so the CLI ladder maps 1:1 to the parts.
 sync_auto = sync_allreduce
 
+# Wire-schedule provenance for evidence rows (round-4 advisor): the label
+# "ring" changed meaning in round 4 (bidirectional -> single-direction,
+# per the measured sweep in parallel/ring.py), so bench/matrix rows stamp
+# the direction the labeled rung actually ran, and banked-evidence
+# matching (bench.py::_banked_good, tools/bench_gaps.py::matrix_missing)
+# treats ring rows WITHOUT the stamp — pre-flip captures — as measuring a
+# different schedule rather than re-emitting them under the new meaning.
+RING_DIRECTION: dict[str, str] = {
+    "ring": "uni",
+    "ring_uni": "uni",
+    "ring_bidir": "bidir",
+}
+
 SYNC_STRATEGIES: dict[str, SyncFn] = {
     "none": sync_none,
     "coordinator": sync_coordinator,
