@@ -70,6 +70,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 TORCH_CPU_IMAGES_PER_SEC = 92.42
 BASELINE_4NODE_GLOO_IPS = 4 * TORCH_CPU_IMAGES_PER_SEC
 
+# Most ADVERSE defensible denominator (round-5, VERDICT r4 #6): the 92.42
+# measurement comes from a 1-core VM, so a real 4-core reference node
+# would beat it by an unknown host factor.  Arithmetic bound instead:
+# measured host SINGLE-THREAD dense-GEMM peak (139.7 GFLOP/s fp32,
+# highest of the 2026-08-01 runs of
+# `benchmarks/torch_reference_bench.py --gemm-check`) x 4 reference
+# threads with a full turbo core each and ZERO parallelization loss,
+# / analytic 916.6 MFLOP/image train cost -> <=609.7 img/s/node; x4
+# nodes with zero Gloo comm cost.  Every efficiency assumption favors
+# the reference (convs at GEMM peak, BN/ReLU free, perfect scaling), so
+# a real cluster sits strictly below this.  vs_baseline_adverse is the
+# ratio no host correction can overturn.  Kept at the HIGHEST bound ever
+# measured; the --gemm-check drift guard flags any upward divergence.
+ADVERSE_4NODE_GLOO_IPS = 2438.98
+
 METRIC = "vgg11_cifar10_images_per_sec_per_chip"
 
 
@@ -227,6 +242,8 @@ def child_main() -> None:
         "fresh": True,
         "git_rev": _git_rev(),
         "vs_baseline": round(ips / BASELINE_4NODE_GLOO_IPS, 2),
+        "vs_baseline_adverse": round(ips / ADVERSE_4NODE_GLOO_IPS, 2),
+        "baseline_adverse_4node_gloo_images_per_sec": ADVERSE_4NODE_GLOO_IPS,
         "images_per_sec_total": round(ips, 1),
         "devices": n_dev,
         "device_kind": device_kind,
@@ -450,6 +467,13 @@ def _emit_banked(banked: dict, why: str) -> None:
             "baseline_4node_gloo_images_per_sec")
         out["vs_baseline"] = round(ips / BASELINE_4NODE_GLOO_IPS, 2)
         out["baseline_4node_gloo_images_per_sec"] = BASELINE_4NODE_GLOO_IPS
+    if isinstance(ips, (int, float)) and ips > 0:
+        # Adverse arithmetic bound (VERDICT r4 #6): restated on every
+        # re-emission so even rows banked before the field existed carry
+        # the host-factor-proof ratio.
+        out["vs_baseline_adverse"] = round(ips / ADVERSE_4NODE_GLOO_IPS, 2)
+        out["baseline_adverse_4node_gloo_images_per_sec"] = (
+            ADVERSE_4NODE_GLOO_IPS)
     print(json.dumps(out))
     sys.exit(0)
 
