@@ -44,6 +44,8 @@ relay is wedged.
 
 Env knobs: BENCH_TRIES (2), BENCH_TIMEOUT (300s per attempt),
 BENCH_PROBE_TIMEOUT (90s), BENCH_PROBE=0 (skip probe),
+BENCH_COST=0 / BENCH_COLLECTIVE=0 (skip the XLA-cost cross-check /
+collective measurement — smoke-test escape hatches; TPU captures run both),
 BENCH_LOCK_TIMEOUT (240s wait for the single-client device lock),
 BENCH_STRICT=1 (disable the banked fallback), BENCH_BATCH, BENCH_STEPS,
 BENCH_WARMUP, BENCH_DTYPE, BENCH_PARAM_DTYPE (bfloat16 casts params +
@@ -197,15 +199,17 @@ def child_main() -> None:
     import threading
 
     xla_box = {"flops": None}
+    # BENCH_COST=0 skips the cross-check entirely (it recompiles the step
+    # for cost analysis — wasted work in CPU smoke tests, r4 #8).
+    if os.environ.get("BENCH_COST", "1") != "0":
+        def _xla_cost():
+            from tpudp.utils.flops import xla_cost_flops
 
-    def _xla_cost():
-        from tpudp.utils.flops import xla_cost_flops
+            xla_box["flops"] = xla_cost_flops(step, state, images, labels)
 
-        xla_box["flops"] = xla_cost_flops(step, state, images, labels)
-
-    xt = threading.Thread(target=_xla_cost, daemon=True)
-    xt.start()
-    xt.join(timeout=float(os.environ.get("BENCH_COST_TIMEOUT", 60)))
+        xt = threading.Thread(target=_xla_cost, daemon=True)
+        xt.start()
+        xt.join(timeout=float(os.environ.get("BENCH_COST_TIMEOUT", 60)))
     xla_flops = xla_box["flops"]
 
     # North-star companion metric (BASELINE.json:2): wall-time of the DP
@@ -217,7 +221,11 @@ def child_main() -> None:
     # time would measure only fence/dispatch overhead — report n/a instead
     # of a misreadable number (round-2 judge finding).
     coll = {"allreduce_wall_time_s": None, "bytes": None, "gbps": None}
-    if n_dev == 1:
+    if os.environ.get("BENCH_COLLECTIVE", "1") == "0":
+        # Smoke-test escape hatch (r4 #8): the collective measurement
+        # compiles its own program; real TPU captures always run it.
+        coll_note = "skipped (BENCH_COLLECTIVE=0)"
+    elif n_dev == 1:
         coll_note = ("n/a (1 chip: DP all-reduce compiles to a no-op; a "
                      "wall time here would be dispatch overhead only)")
     else:

@@ -59,7 +59,11 @@ def test_async_writer_roundtrip(tmp_path, mesh4):
 
 
 def test_roundtrip_resume(tmp_path, mesh4):
-    model = VGG11()
+    # SmallConv, not VGG: round-trip fidelity is model-agnostic and the
+    # VGG compile dominated the test's 35s (fast-tier margin, r4 #8).
+    from tests.small_model import SmallConv
+
+    model = SmallConv()
     tx = make_optimizer()
     state = init_state(model, tx)
     step = make_train_step(model, tx, mesh4, "allreduce", donate=False)

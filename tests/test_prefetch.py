@@ -89,16 +89,17 @@ def test_trainer_device_prefetch_matches_direct(mesh8):
     """A Prefetcher-wrapped loader (Trainer installs its device_put as the
     place hook) must produce the identical loss trajectory to the direct
     loader — placement moves threads, not math."""
+    from tests.small_model import SmallConv
     from tpudp.train import Trainer
-
-    from tpudp.models.vgg import VGG11
 
     def run(wrap):
         ds = _dataset(32, seed=7)
         loader = DataLoader(ds, 16, train=True, seed=2)
         if wrap:
             loader = Prefetcher(loader, depth=2)
-        tr = Trainer(VGG11(), mesh8, "allreduce", log_every=1)
+        # SmallConv: placement identity is model-agnostic and this test
+        # jits TWO fresh Trainers (fast-tier margin, r4 #8).
+        tr = Trainer(SmallConv(), mesh8, "allreduce", log_every=1)
         tr.train_epoch(loader, epoch=0)
         return float(tr.state.loss_sum)
 
