@@ -194,6 +194,35 @@ def test_mfu_gap_reports_missing_variants_for_resume(tmp_path):
     assert mfu_missing(d) == ["fwd_bwd", "fwd_only", "no_bn"]
 
 
+def test_lever_gap_gate(tmp_path):
+    """VERDICT r4 #2 automation: the bf16-params headline capture is owed
+    exactly when a measured TPU attribution row proves the lever wins
+    (speedup >= 1.03); a below-threshold measurement closes the stage
+    (the ablation row documents why the headline stays fp32), and a
+    fresh bf16-params headline row — in the lever file or banked in the
+    shared headline history — satisfies it."""
+    from tools.bench_gaps import lever_missing
+
+    d = str(tmp_path)
+    assert not lever_missing(d)  # no attribution evidence yet -> nothing owed
+    _write(os.path.join(d, "mfu.jsonl"), [
+        {"variant": "bf16_params", "sec_per_step": 0.002,
+         "device_kind": "TPU v5 lite", "speedup_vs_full": 1.01}])
+    assert not lever_missing(d)  # measured, but below threshold: closed
+    _write(os.path.join(d, "mfu.jsonl"), [
+        {"variant": "bf16_params", "sec_per_step": 0.002,
+         "device_kind": "cpu", "speedup_vs_full": 1.4}])
+    assert not lever_missing(d)  # smoke row never owes a TPU capture
+    _write(os.path.join(d, "mfu.jsonl"), [
+        {"variant": "bf16_params", "sec_per_step": 0.002,
+         "device_kind": "TPU v5 lite", "speedup_vs_full": 1.12}])
+    assert lever_missing(d)  # proven on-chip win, no capture yet
+    _write(os.path.join(d, "bench.history.jsonl"), [
+        {"metric": "vgg11_cifar10_images_per_sec_per_chip", "value": 99000.0,
+         "device_kind": "TPU v5 lite", "param_dtype": "bfloat16"}])
+    assert not lever_missing(d)  # banked bf16 headline row satisfies it
+
+
 def test_collective_gap_gate(tmp_path):
     """The ring-default evidence stage (VERDICT r3 #5): complete on real
     multi-device TPU rows for all three key schedules, or on a labeled

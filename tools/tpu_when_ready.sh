@@ -87,6 +87,10 @@ mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
 }
+lever_ok() {
+  local out; out=$(python tools/bench_gaps.py lever) || return 1
+  [ -z "$out" ]
+}
 collective_ok() {
   local out; out=$(python tools/bench_gaps.py collective) || return 1
   [ -z "$out" ]
@@ -250,6 +254,30 @@ while true; do
         continue
       fi
     fi
+    # LEVER stage (VERDICT r4 #2, "act on the MFU data in-round"): the
+    # moment the attribution sweep PROVES bf16-params wins on-chip
+    # (speedup >= 1.03 in a measured TPU row), capture a headline row
+    # with the lever flipped so the evidence lands the same round —
+    # without waiting for a human to read mfu.jsonl.  BENCH_PARAM_DTYPE
+    # is stamped into the row, and _banked_good keys on it, so the fp32
+    # headline's banked-fallback path is untouched.  A measured speedup
+    # below threshold closes the stage with nothing to do (the ablation
+    # row is then the documented "why the headline stays fp32").
+    if lever_ok; then
+      :
+    else
+      ensure_window
+      BENCH_STRICT=1 BENCH_PROBE=0 BENCH_TRIES=1 BENCH_TIMEOUT=240 \
+        BENCH_PARAM_DTYPE=bfloat16 \
+        timeout -k "$GRACE" "$(stage_t 320)" python bench.py \
+        > bench_results/bench_bf16.json 2> bench_results/bench_bf16.err
+      log "lever bench (bf16 params) rc=$? -> bench_results/bench_bf16.json"
+      if ! lever_ok && ! probe; then
+        log "lever bench died and relay unhealthy; re-entering wait loop"
+        sleep "$PERIOD" 9>&-
+        continue
+      fi
+    fi
     if matrix_ok; then
       log "matrix.jsonl already good; skipping matrix_bench"
     else
@@ -304,7 +332,8 @@ while true; do
     # Exit only when every stage holds a complete result; otherwise keep
     # waiting for the next window (a stage that died on a healthy relay —
     # e.g. per-stage timeout — must not end the watch with gaps).
-    if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok && collective_ok; then
+    if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
+        && lever_ok && collective_ok; then
       log "battery done"
       exit 0
     fi
