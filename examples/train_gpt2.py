@@ -42,6 +42,16 @@ def main() -> None:
                         "second axis is the strategy axis")
     p.add_argument("--microbatches", type=int, default=2,
                    help="pipeline microbatches (--strategy pp)")
+    p.add_argument("--family", default="gpt2", choices=["gpt2", "llama"],
+                   help="decoder family: gpt2 (learned positions, "
+                        "LayerNorm, GELU, tied head) or llama (RoPE, "
+                        "RMSNorm, SwiGLU, GQA via --kv-heads, untied "
+                        "head).  llama supports dp/sp/tp/fsdp/zero1; "
+                        "pp/ep, --loss-chunk and --sample are "
+                        "gpt2-family paths")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA KV-head count (llama family; default = "
+                        "--heads, i.e. MHA)")
     p.add_argument("--layers", type=int, default=12)
     p.add_argument("--d-model", type=int, default=768)
     p.add_argument("--heads", type=int, default=None)
@@ -69,8 +79,9 @@ def main() -> None:
     p.add_argument("--tokens-file", type=str, default=None)
     p.add_argument("--save-checkpoint", type=str, default=None, metavar="DIR",
                    help="save the final TrainState to DIR/step_<steps> "
-                        "(orbax; restorable by examples/generate_gpt2.py "
-                        "--checkpoint-dir DIR)")
+                        "(orbax; gpt2-family checkpoints are restorable by "
+                        "examples/generate_gpt2.py --checkpoint-dir DIR; "
+                        "llama ones via tpudp.utils.checkpoint)")
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
 
@@ -111,22 +122,52 @@ def main() -> None:
     if args.seq_parallel and args.strategy != "dp":
         raise SystemExit("error: --seq-parallel is its own rung; drop "
                          "--strategy (or use --strategy dp)")
-    moe = {}
-    if args.strategy == "ep":
-        moe = dict(mlp_impl="moe", num_experts=max(2 * s, 2),
-                   capacity_factor=2.0, expert_axis="expert")
-    cfg = GPT2Config(
-        vocab_size=args.vocab,
-        max_seq_len=args.seq_len,
-        num_layers=args.layers,
-        num_heads=args.heads or max(args.d_model // 64, 1),
-        d_model=args.d_model,
-        dtype=dtype,
-        attn_impl="ring" if args.seq_parallel else "dense",
-        seq_axis="seq" if args.seq_parallel else None,
-        **moe,
-    )
-    model = GPT2(cfg)
+    if args.family == "llama":
+        # pp drives the GPT-2 raw-param stage twins (embed_tokens/lm_head)
+        # and ep the GPT-2 MoE MLP — both family-specific by design.
+        if args.strategy in ("pp", "ep"):
+            raise SystemExit(f"error: --strategy {args.strategy} is a "
+                             "gpt2-family path (pipeline stage twins / MoE "
+                             "MLP); use --family gpt2")
+        if args.loss_chunk is not None:
+            raise SystemExit("error: --loss-chunk needs the tied-embedding "
+                             "head (gpt2 family)")
+        if args.sample:
+            raise SystemExit("error: --sample drives the GPT-2 KV-cached "
+                             "decode path; use --family gpt2")
+        from tpudp.models.llama import Llama, LlamaConfig
+
+        model = Llama(LlamaConfig(
+            vocab_size=args.vocab,
+            max_seq_len=args.seq_len,
+            num_layers=args.layers,
+            num_heads=args.heads or max(args.d_model // 64, 1),
+            num_kv_heads=args.kv_heads,
+            d_model=args.d_model,
+            dtype=dtype,
+            attn_impl="ring" if args.seq_parallel else "dense",
+            seq_axis="seq" if args.seq_parallel else None,
+        ))
+    else:
+        if args.kv_heads is not None:
+            raise SystemExit("error: --kv-heads (GQA) is a llama-family "
+                             "option")
+        moe = {}
+        if args.strategy == "ep":
+            moe = dict(mlp_impl="moe", num_experts=max(2 * s, 2),
+                       capacity_factor=2.0, expert_axis="expert")
+        cfg = GPT2Config(
+            vocab_size=args.vocab,
+            max_seq_len=args.seq_len,
+            num_layers=args.layers,
+            num_heads=args.heads or max(args.d_model // 64, 1),
+            d_model=args.d_model,
+            dtype=dtype,
+            attn_impl="ring" if args.seq_parallel else "dense",
+            seq_axis="seq" if args.seq_parallel else None,
+            **moe,
+        )
+        model = GPT2(cfg)
     if args.skip_nonfinite is not None and args.strategy not in ("dp",
                                                                  "zero1"):
         # The skip decision needs cross-device-synchronized gradients at
@@ -139,7 +180,7 @@ def main() -> None:
                         skip_nonfinite=args.skip_nonfinite)
     state = init_state(model, tx, input_shape=(1, min(args.seq_len, 16)))
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(state.params))
-    print(f"[gpt2] params={n_params/1e6:.1f}M mesh=({d}x{s}) "
+    print(f"[{args.family}] params={n_params/1e6:.1f}M mesh=({d}x{s}) "
           f"seq_parallel={args.seq_parallel} seq_len={args.seq_len} "
           f"batch={args.batch_size} dtype={args.dtype}")
 
@@ -174,9 +215,10 @@ def main() -> None:
                                  devices=devices[: d * s])
         options = {}
         if args.strategy == "tp":
-            from tpudp.parallel.tensor import gpt2_tp_rules
+            from tpudp.parallel.tensor import gpt2_tp_rules, llama_tp_rules
 
-            options["rules"] = gpt2_tp_rules()
+            options["rules"] = (llama_tp_rules() if args.family == "llama"
+                                else gpt2_tp_rules())
         if args.strategy == "pp":
             options["n_microbatches"] = args.microbatches
         built = build_strategy(args.strategy, model, tx, smesh, state,
@@ -235,7 +277,11 @@ def main() -> None:
 
         ckpt = save_checkpoint(
             os.path.join(args.save_checkpoint, f"step_{args.steps}"), state)
-        print(f"[gpt2] saved checkpoint {ckpt}")
+        print(f"[{args.family}] saved checkpoint {ckpt}")
+        if args.family == "llama":
+            print("[llama] note: examples/generate_gpt2.py restores the "
+                  "gpt2 family only; restore llama checkpoints via "
+                  "tpudp.utils.checkpoint.restore_checkpoint/restore_params")
 
     if args.sample:
         from tpudp.models.generate import generate

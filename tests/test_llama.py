@@ -1,0 +1,162 @@
+"""LLaMA-family decoder (tpudp/models/llama.py): RoPE relative-position
+property, GQA correctness, end-to-end training through the shared step
+machinery, and (slow tier) sequence-parallel + TP parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudp.models.llama import Llama, LlamaConfig, apply_rope, llama_small
+
+TINY = dict(vocab_size=64, max_seq_len=64, num_layers=2, num_heads=4,
+            d_model=32)
+
+
+def test_shapes_gqa_shrink_and_gqa_equals_mha():
+    """Logits shape contract; GQA shrinks the KV projections by the group
+    factor while q/wo stay full-width; and GQA is exactly MHA whose KV
+    heads are tied within each group — the GQA forward equals the MHA
+    forward whose wk/wv columns are the GQA ones repeated per group.
+    (One test so the tiny models compile once each — fast-tier margin.)"""
+    rng = np.random.default_rng(1)
+    tok = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    gqa = llama_small(num_kv_heads=2, **TINY)
+    p = gqa.init(jax.random.PRNGKey(3), tok)["params"]
+    out_gqa = gqa.apply({"params": p}, tok)
+    assert out_gqa.shape == (2, 16, 64)
+
+    dh = TINY["d_model"] // TINY["num_heads"]
+    assert dh % 2 == 0  # RoPE precondition
+    groups = TINY["num_heads"] // 2
+
+    # widen: (d, kv*dh) -> (d, h*dh) with each KV head's block duplicated
+    def widen(kern):
+        blocks = np.split(np.asarray(kern), 2, axis=1)
+        return jnp.asarray(np.concatenate(
+            [b for blk in blocks for b in [blk] * groups], axis=1))
+
+    p_mha = jax.tree.map(lambda a: a, p)
+    for i in range(TINY["num_layers"]):
+        attn = p_mha[f"h_{i}"]["attn"]
+        assert (attn["wk"]["kernel"].shape[1]
+                == attn["wq"]["kernel"].shape[1] // groups)  # KV shrink
+        attn["wk"] = {"kernel": widen(attn["wk"]["kernel"])}
+        attn["wv"] = {"kernel": widen(attn["wv"]["kernel"])}
+    mha = llama_small(**TINY)
+    out_mha = mha.apply({"params": p_mha}, tok)
+    assert out_mha.shape == (2, 16, 64)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        LlamaConfig(num_heads=3, num_kv_heads=2, d_model=48)
+    with pytest.raises(ValueError, match="even head dim"):
+        LlamaConfig(num_heads=16, d_model=48)  # head dim 3
+    for kv in (0, -2, 5):  # 0 would silently degrade to MHA; <0/overwide
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            LlamaConfig(num_heads=4, num_kv_heads=kv, d_model=32)
+
+
+def test_rope_is_relative():
+    """The defining RoPE property: q·k between positions (i, j) depends
+    only on i - j, so shifting every position by a constant leaves all
+    attention scores unchanged."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 6, 2, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 6, 2, 8)), jnp.float32)
+
+    def scores(shift):
+        pos = jnp.arange(6) + shift
+        qr, kr = apply_rope(q, pos), apply_rope(k, pos)
+        return jnp.einsum("bqhd,bkhd->bhqk", qr, kr)
+
+    np.testing.assert_allclose(np.asarray(scores(0)),
+                               np.asarray(scores(17)), rtol=1e-5, atol=1e-5)
+    # and rotation by position 0 is the identity
+    np.testing.assert_allclose(
+        np.asarray(apply_rope(q[:, :1], jnp.arange(1))), np.asarray(q[:, :1]),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_trains_and_loss_decreases():
+    """End to end through the shared step machinery (make_train_step,
+    sync='none', single device): overfit a tiny batch."""
+    from tpudp.train import init_state, make_optimizer, make_train_step
+
+    model = llama_small(num_kv_heads=2, **TINY)
+    tx = make_optimizer(learning_rate=0.05)
+    state = init_state(model, tx, input_shape=(1, 8))
+    step = make_train_step(model, tx, None, "none", spmd_mode="single",
+                           donate=False)
+    rng = np.random.default_rng(2)
+    tok = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+    tgt = jnp.roll(tok, -1, axis=1)
+    state, first = step(state, tok, tgt)
+    for _ in range(12):
+        state, loss = step(state, tok, tgt)
+    assert np.isfinite(float(loss))
+    assert float(loss) < float(first), (float(first), float(loss))
+
+
+@pytest.mark.slow
+def test_seq_parallel_ring_matches_single_device(mesh8):
+    """DPxSP: ring-attention Llama over a (data, seq) mesh must reproduce
+    the single-device dense trajectory — RoPE's global-position offsets
+    across sequence shards are exactly what this pins."""
+    from tpudp.mesh import make_mesh_nd
+    from tpudp.train import (init_state, make_optimizer,
+                             make_seq_parallel_train_step, make_train_step)
+
+    tx = make_optimizer(learning_rate=0.05)
+    rng = np.random.default_rng(5)
+    tok = jnp.asarray(rng.integers(0, 64, (4, 32)), jnp.int32)
+    tgt = jnp.roll(tok, -1, axis=1)
+
+    dense = llama_small(**TINY)
+    st = init_state(dense, tx, input_shape=(1, 8), seed=0)
+    dense_step = make_train_step(dense, tx, None, "none",
+                                 spmd_mode="single", donate=False)
+    st, dense_loss = dense_step(st, tok, tgt)
+
+    mesh2d = make_mesh_nd({"data": 2, "seq": 2},
+                          devices=jax.devices()[:4])
+    ring = llama_small(attn_impl="ring", seq_axis="seq", **TINY)
+    st2 = init_state(ring, tx, input_shape=(1, 8), seed=0)
+    sp_step = make_seq_parallel_train_step(ring, tx, mesh2d, donate=False)
+    st2, sp_loss = sp_step(st2, tok, tgt)
+    np.testing.assert_allclose(float(sp_loss), float(dense_loss),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_tp_matches_single_device(mesh8):
+    """DPxTP via llama_tp_rules: GSPMD-sharded params must reproduce the
+    single-device loss (XLA inserts the row-parallel psums)."""
+    from tpudp.mesh import make_mesh_nd
+    from tpudp.parallel.tensor import llama_tp_rules
+    from tpudp.train import (init_state, make_optimizer, make_tp_train_step,
+                             make_train_step)
+
+    tx = make_optimizer(learning_rate=0.05)
+    rng = np.random.default_rng(6)
+    tok = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+    tgt = jnp.roll(tok, -1, axis=1)
+
+    model = llama_small(num_kv_heads=2, **TINY)
+    st = init_state(model, tx, input_shape=(1, 8), seed=0)
+    dense_step = make_train_step(model, tx, None, "none",
+                                 spmd_mode="single", donate=False)
+    _, ref_loss = dense_step(st, tok, tgt)
+
+    mesh_tp = make_mesh_nd({"data": 2, "model": 2},
+                           devices=jax.devices()[:4])
+    tp_state, tp_step = make_tp_train_step(
+        model, tx, mesh_tp, init_state(model, tx, input_shape=(1, 8),
+                                       seed=0),
+        llama_tp_rules(), donate=False)
+    _, tp_loss = tp_step(tp_state, tok, tgt)
+    np.testing.assert_allclose(float(tp_loss), float(ref_loss),
+                               rtol=1e-4, atol=1e-4)

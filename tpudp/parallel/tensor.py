@@ -66,6 +66,29 @@ def gpt2_tp_rules(model_axis: str = MODEL_AXIS) -> Rules:
     )
 
 
+def llama_tp_rules(model_axis: str = MODEL_AXIS) -> Rules:
+    """Megatron-style partition rules for tpudp.models.llama.Llama.
+
+    q/gate/up are column-parallel, the wo/down projections row-parallel
+    (their output psum inserted by XLA), the untied head column-parallel
+    over vocab.  GQA note: wk/wv output dim is ``kv_heads * head_dim`` —
+    it must divide by the model-axis size, so shard KV-light configs
+    (e.g. kv_heads=1) on a correspondingly small TP degree or widen
+    kv_heads.
+    """
+    col = P(None, model_axis)  # split output features
+    row = P(model_axis, None)  # split input features (psum'd by XLA)
+    return (
+        (r"attn/w[qkv]/kernel", col),
+        (r"attn/wo/kernel", row),
+        (r"(gate|up)/kernel", col),
+        (r"down/kernel", row),
+        (r"wte/embedding", P(model_axis, None)),  # vocab-split
+        (r"lm_head/kernel", col),
+        (r"rms_\w+/scale", P()),
+    )
+
+
 def vgg_tp_rules(model_axis: str = MODEL_AXIS) -> Rules:
     """Channel-split rules for the conv models: conv kernels are HWIO, the
     output-channel axis (last) splits across ``model``; BatchNorm runs on
