@@ -9,6 +9,8 @@ Covers (BASELINE.json configs[0-4] + the GSPMD/coordinator rungs):
   dp_gspmd       VGG-11 DP, XLA-partitioned (Part 3 analogue)
   resnet50       ResNet-50 at ImageNet geometry, synthetic data, DP psum
   gpt2_small     GPT-2-small (124M) DP, tokens/sec/chip
+  gpt2_flash     GPT-2 with the owned Pallas flash kernel at t=2048
+  llama_gqa      LLaMA family (RoPE/RMSNorm/SwiGLU, 4:1 GQA), tokens/sec/chip
 
 Prints one JSON line per config (machine-readable) and a final summary
 line.  Steps donate their state buffers (in-place param/momentum update on
@@ -41,8 +43,8 @@ VGG_LADDER = (
 # (tools.bench_gaps); a config added on one side but not the other would
 # silently never be measured.  Checked at import time, before any jax/TPU
 # work, and raising (not assert) so `python -O` can't strip it.
-if [n for n, *_ in VGG_LADDER] + ["resnet50", "gpt2_small",
-                                  "gpt2_flash"] != list(MATRIX_CONFIGS):
+if [n for n, *_ in VGG_LADDER] + ["resnet50", "gpt2_small", "gpt2_flash",
+                                  "llama_gqa"] != list(MATRIX_CONFIGS):
     raise ValueError("matrix configs out of sync with tools.bench_gaps")
 
 
@@ -201,11 +203,15 @@ def main() -> None:
     if only is None or "resnet50" in only:
         run_config("resnet50", run_resnet)
 
-    # ---- GPT-2-small ---------------------------------------------------
-    def run_gpt2():
-        g_batch = int(os.environ.get("MATRIX_GPT2_BATCH", 8))
-        seq = int(os.environ.get("MATRIX_GPT2_SEQ", 1024))
-        model = gpt2_small(dtype=jnp.bfloat16)
+    # ---- LM configs: one harness, three model builds -------------------
+    # Dispatch order MUST follow MATRIX_CONFIGS: the shared ``rng`` stream
+    # is consumed per config in order, so reordering would silently train
+    # existing configs on different random tokens than their banked rows.
+    def run_lm(name, batch_env, seq_env, default_batch, default_seq,
+               build, flops_fn, extra_fn):
+        g_batch = int(os.environ.get(batch_env, default_batch))
+        seq = int(os.environ.get(seq_env, default_seq))
+        model = build(seq)
         cfg = model.config
         tx = make_optimizer(learning_rate=0.01)
         state = init_state(model, tx, input_shape=(1, seq))
@@ -215,49 +221,57 @@ def main() -> None:
                         jnp.int32), data_sh)
         tgts = jax.device_put(jnp.roll(toks, -1, axis=1), data_sh)
         sec, loss = measure(step, state, (toks, tgts), steps, warmup)
-        emit("gpt2_small", sec, loss, unit="tokens/sec/chip",
+        emit(name, sec, loss, unit="tokens/sec/chip",
              per_sec=g_batch * seq / sec,
-             flops=train_step_flops(gpt2_fwd_flops(
-                 g_batch, seq, num_layers=cfg.num_layers,
-                 d_model=cfg.d_model, vocab_size=cfg.vocab_size,
-                 mlp_ratio=cfg.mlp_ratio)),
-             extra={"global_batch": g_batch, "seq_len": seq})
-
-    if only is None or "gpt2_small" in only:
-        run_config("gpt2_small", run_gpt2)
-
-    # ---- GPT-2 with the owned Pallas flash kernel, long context --------
-    def run_gpt2_flash():
-        """The flash kernel inside a real training step (not a micro-bench):
-        GPT-2-small geometry at t=2048 where the dense (t, t) score tensor
-        starts to hurt; tokens/sec/chip comparable against gpt2_small."""
-        g_batch = int(os.environ.get("MATRIX_GPT2FLASH_BATCH", 4))
-        seq = int(os.environ.get("MATRIX_GPT2FLASH_SEQ", 2048))
-        layers = int(os.environ.get("MATRIX_GPT2FLASH_LAYERS", 12))
-        d_model = int(os.environ.get("MATRIX_GPT2FLASH_DMODEL", 768))
-        model = gpt2_small(dtype=jnp.bfloat16, attn_impl="flash",
-                           max_seq_len=seq, num_layers=layers,
-                           d_model=d_model, num_heads=d_model // 64)
-        cfg = model.config
-        tx = make_optimizer(learning_rate=0.01)
-        state = init_state(model, tx, input_shape=(1, seq))
-        step = make_train_step(model, tx, mesh, "allreduce", donate=True)
-        toks = jax.device_put(
-            jnp.asarray(rng.integers(0, cfg.vocab_size, size=(g_batch, seq)),
-                        jnp.int32), data_sh)
-        tgts = jax.device_put(jnp.roll(toks, -1, axis=1), data_sh)
-        sec, loss = measure(step, state, (toks, tgts), steps, warmup)
-        emit("gpt2_flash", sec, loss, unit="tokens/sec/chip",
-             per_sec=g_batch * seq / sec,
-             flops=train_step_flops(gpt2_fwd_flops(
-                 g_batch, seq, num_layers=cfg.num_layers,
-                 d_model=cfg.d_model, vocab_size=cfg.vocab_size,
-                 mlp_ratio=cfg.mlp_ratio)),
+             flops=train_step_flops(flops_fn(cfg, g_batch, seq)),
              extra={"global_batch": g_batch, "seq_len": seq,
-                    "attn_impl": "flash"})
+                    **extra_fn(cfg)})
 
+    def gpt2_flops(cfg, b, t):
+        return gpt2_fwd_flops(b, t, num_layers=cfg.num_layers,
+                              d_model=cfg.d_model,
+                              vocab_size=cfg.vocab_size,
+                              mlp_ratio=cfg.mlp_ratio)
+
+    # GPT-2-small (124M) DP
+    if only is None or "gpt2_small" in only:
+        run_config("gpt2_small", lambda: run_lm(
+            "gpt2_small", "MATRIX_GPT2_BATCH", "MATRIX_GPT2_SEQ", 8, 1024,
+            lambda seq: gpt2_small(dtype=jnp.bfloat16),
+            gpt2_flops, lambda cfg: {}))
+
+    # GPT-2 with the owned Pallas flash kernel inside a real training step
+    # (not a micro-bench) at t=2048 where the dense (t, t) score tensor
+    # starts to hurt; tokens/sec/chip comparable against gpt2_small.
     if only is None or "gpt2_flash" in only:
-        run_config("gpt2_flash", run_gpt2_flash)
+        run_config("gpt2_flash", lambda: run_lm(
+            "gpt2_flash", "MATRIX_GPT2FLASH_BATCH", "MATRIX_GPT2FLASH_SEQ",
+            4, 2048,
+            lambda seq: gpt2_small(
+                dtype=jnp.bfloat16, attn_impl="flash", max_seq_len=seq,
+                num_layers=int(os.environ.get("MATRIX_GPT2FLASH_LAYERS",
+                                              12)),
+                d_model=(dm := int(os.environ.get(
+                    "MATRIX_GPT2FLASH_DMODEL", 768))),
+                num_heads=dm // 64),
+            gpt2_flops, lambda cfg: {"attn_impl": "flash"}))
+
+    # LLaMA family (round 5: RoPE/RMSNorm/SwiGLU, 4:1 GQA) in the same DP
+    # harness — tokens/sec/chip comparable against gpt2_small.
+    if only is None or "llama_gqa" in only:
+        from tpudp.models.llama import llama_small
+        from tpudp.utils.flops import llama_fwd_flops
+
+        run_config("llama_gqa", lambda: run_lm(
+            "llama_gqa", "MATRIX_LLAMA_BATCH", "MATRIX_LLAMA_SEQ", 8, 1024,
+            lambda seq: llama_small(dtype=jnp.bfloat16, max_seq_len=seq,
+                                    num_layers=12, d_model=768,
+                                    num_heads=12, num_kv_heads=3),
+            lambda cfg, b, t: llama_fwd_flops(
+                b, t, num_layers=cfg.num_layers, d_model=cfg.d_model,
+                vocab_size=cfg.vocab_size, hidden=cfg.hidden,
+                num_heads=cfg.num_heads, kv_heads=cfg.kv_heads),
+            lambda cfg: {"num_kv_heads": cfg.kv_heads}))
 
     print(json.dumps({"matrix": results}))
 

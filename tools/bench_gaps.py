@@ -24,7 +24,8 @@ import json
 import os
 
 MATRIX_CONFIGS = ("part1_single", "dp_psum", "dp_ring", "dp_coordinator",
-                  "dp_gspmd", "resnet50", "gpt2_small", "gpt2_flash")
+                  "dp_gspmd", "resnet50", "gpt2_small", "gpt2_flash",
+                  "llama_gqa")
 FLASH_TS = (4096, 8192, 16384)
 
 

@@ -118,6 +118,22 @@ def gpt2_fwd_flops(batch: int, seq_len: int, *, num_layers: int = 12,
     return num_layers * per_layer + dense_flops(tokens, d_model, vocab_size)
 
 
+def llama_fwd_flops(batch: int, seq_len: int, *, num_layers: int,
+                    d_model: int, vocab_size: int, hidden: int,
+                    num_heads: int, kv_heads: int) -> int:
+    """LLaMA-family analytic MACs: q/wo at d^2, k/v shrunk by the GQA
+    ratio, SwiGLU's three d*hidden matmuls, quadratic attention, and the
+    untied LM head (tpudp/models/llama.py)."""
+    tokens = batch * seq_len
+    kv_dim = d_model * kv_heads // num_heads
+    per_layer = dense_flops(tokens, d_model, d_model)       # wq
+    per_layer += 2 * dense_flops(tokens, d_model, kv_dim)   # wk, wv
+    per_layer += dense_flops(tokens, d_model, d_model)      # wo
+    per_layer += 3 * dense_flops(tokens, d_model, hidden)   # gate, up, down
+    per_layer += 2 * 2 * batch * seq_len * seq_len * d_model  # QK^T + AV
+    return num_layers * per_layer + dense_flops(tokens, d_model, vocab_size)
+
+
 def train_step_flops(fwd_flops: int) -> int:
     """Backward is ~2x forward (grad wrt activations + grad wrt weights)."""
     return 3 * fwd_flops
