@@ -1,4 +1,5 @@
-"""Autoregressive generation for the GPT-2 family — KV-cached decode.
+"""Autoregressive generation for the GPT-2 and LLaMA families — KV-cached
+decode.
 
 The reference has no inference path at all (it is a CNN training
 assignment, SURVEY.md §0); a complete LM framework needs one.  TPU-first
@@ -6,7 +7,7 @@ design:
 
   * ONE jitted program: prompt prefill + ``max_new_tokens`` decode steps
     under ``lax.scan`` — static shapes throughout (the cache is a fixed
-    ``(layers, batch, max_len, heads, head_dim)`` buffer written with
+    ``(layers, batch, max_len, kv_heads, head_dim)`` buffer written with
     ``dynamic_update_slice``; attention masks by position instead of
     growing the sequence), so XLA compiles it once and the MXU sees fixed
     matmul shapes every step.
@@ -17,9 +18,14 @@ design:
     drift.
   * Greedy (``temperature=0``) or temperature sampling with a JAX PRNG key.
 
-Dense-MLP, dense-attention configs (the GPT-2 default).  Cache memory is
-``2 * L * B * max_len * d_model`` — for generation lengths where that's
-the constraint, raise ``max_len`` only as far as needed (static shape).
+Dense-MLP, dense-attention configs.  Both decoder families dispatch here:
+GPT-2 (learned positions, LayerNorm/GELU, tied head) and LLaMA (RoPE,
+RMSNorm/SwiGLU, untied head — ``tpudp.models.llama``'s raw-param twins).
+Cache memory is ``2 * L * B * max_len * d_model * kv_heads / num_heads``
+— GQA configs shrink it by the group factor, and the grouped attention
+in ``llama.block_decode`` never widens it back; for generation lengths
+where the cache is the constraint, raise ``max_len`` only as far as
+needed (static shape).
 """
 
 from __future__ import annotations
@@ -35,12 +41,16 @@ from tpudp.models.gpt2 import GPT2Config, embed_tokens, lm_head
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray  # (layers, batch, max_len, heads, head_dim)
+    k: jnp.ndarray  # (layers, batch, max_len, kv_heads, head_dim)
     v: jnp.ndarray
 
     @classmethod
-    def zeros(cls, cfg: GPT2Config, batch: int, max_len: int) -> "KVCache":
-        shape = (cfg.num_layers, batch, max_len, cfg.num_heads,
+    def zeros(cls, cfg, batch: int, max_len: int) -> "KVCache":
+        # GQA configs (LlamaConfig.kv_heads < num_heads) allocate the
+        # cache at KV width — the group factor is exactly the decode
+        # memory GQA exists to save; MHA configs (GPT-2) are unchanged.
+        kv_heads = getattr(cfg, "kv_heads", cfg.num_heads)
+        shape = (cfg.num_layers, batch, max_len, kv_heads,
                  cfg.d_model // cfg.num_heads)
         return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
@@ -103,29 +113,43 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     return x, k_cache, v_cache
 
 
-def _forward_cached(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
+def _forward_cached(cfg, params: dict, tokens: jnp.ndarray,
                     cache: KVCache, pos) -> tuple[jnp.ndarray, KVCache]:
     """Token ids ``(batch, cur)`` at absolute position ``pos`` ->
-    ``(batch, cur, vocab)`` fp32 logits + updated cache."""
-    # Raw-param twins from models.gpt2 (kept in lockstep with
-    # GPT2.__call__ and pinned by the pipeline + generate parity tests).
-    x = embed_tokens(cfg, params, tokens, pos + jnp.arange(tokens.shape[1]))
+    ``(batch, cur, vocab)`` fp32 logits + updated cache.
+
+    Dispatches on the config family: GPT-2 (learned positions in the
+    embedding, LayerNorm/GELU blocks, tied head) or LLaMA (RoPE inside
+    the blocks, RMSNorm/SwiGLU, GQA-width cache, untied head) — both via
+    raw-param twins kept in lockstep with their training ``__call__`` and
+    pinned by the greedy-parity tests."""
+    from tpudp.models import llama as _llama
+
+    if isinstance(cfg, _llama.LlamaConfig):
+        x = _llama.embed_tokens(cfg, params, tokens)
+        block = lambda p, x, k, v: _llama.block_decode(cfg, p, x, k, v, pos)
+        head = _llama.lm_head
+    else:
+        x = embed_tokens(cfg, params, tokens,
+                         pos + jnp.arange(tokens.shape[1]))
+        block = lambda p, x, k, v: _block_decode(cfg, p, x, k, v, pos)
+        head = lm_head
     new_k, new_v = [], []
     for i in range(cfg.num_layers):
-        x, k_i, v_i = _block_decode(cfg, params[f"h_{i}"], x,
-                                    cache.k[i], cache.v[i], pos)
+        x, k_i, v_i = block(params[f"h_{i}"], x, cache.k[i], cache.v[i])
         new_k.append(k_i)
         new_v.append(v_i)
-    logits = lm_head(cfg, params, x)
+    logits = head(cfg, params, x)
     return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
 
 
 def _validate_decode(cfg, prompt, max_new_tokens: int, fn_name: str) -> int:
     """Shared decode-entry checks; returns the total sequence length."""
-    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
+    mlp_impl = getattr(cfg, "mlp_impl", "dense")  # LlamaConfig: dense only
+    if cfg.attn_impl == "ring" or mlp_impl != "dense":
         raise ValueError(
-            f"{fn_name} supports dense-attention/dense-MLP GPT-2 configs; "
-            f"got attn_impl={cfg.attn_impl!r} mlp_impl={cfg.mlp_impl!r}")
+            f"{fn_name} supports dense-attention/dense-MLP configs; "
+            f"got attn_impl={cfg.attn_impl!r} mlp_impl={mlp_impl!r}")
     prompt_len = prompt.shape[1]
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
@@ -148,7 +172,7 @@ def generate(
 ) -> jnp.ndarray:
     """Generate ``(batch, prompt_len + max_new_tokens)`` token ids.
 
-    ``model`` is a tpudp GPT2 (dense attention/MLP); ``prompt`` is
+    ``model`` is a tpudp GPT2 or Llama (dense attention/MLP); ``prompt`` is
     ``(batch, prompt_len)`` int32.  ``temperature=0`` is greedy argmax;
     otherwise softmax sampling at that temperature using ``key``, optionally
     truncated to the ``top_k`` highest-probability tokens and/or the

@@ -33,6 +33,7 @@ from dataclasses import dataclass
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from tpudp.mesh import axis_is_bound as _axis_is_bound
@@ -163,6 +164,86 @@ class LlamaBlock(nn.Module):
         down = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         name="down")(nn.silu(gate) * up)
         return x + down
+
+
+def _rms(p: dict, x: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Exactly the training model's RMSNorm (flax apply on the raw
+    subtree) so decode can never drift numerically from LlamaBlock's."""
+    return nn.RMSNorm(epsilon=eps, dtype=jnp.float32).apply(
+        {"params": p}, x)
+
+
+def _dense_nb(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
+    return x.astype(dtype) @ p["kernel"].astype(dtype)
+
+
+def embed_tokens(cfg: LlamaConfig, params: dict,
+                 tokens: jnp.ndarray) -> jnp.ndarray:
+    """Raw-param twin of the embedding stage of :meth:`Llama.__call__`
+    (wte lookup only — positions enter via RoPE inside the blocks)."""
+    return params["wte"]["embedding"].astype(cfg.dtype)[tokens]
+
+
+def lm_head(cfg: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Raw-param twin of the output stage (final RMSNorm + untied head)."""
+    x = _rms(params["rms_f"], x, cfg.rms_eps)
+    return _dense_nb(params["lm_head"], x.astype(cfg.dtype),
+                     cfg.dtype).astype(jnp.float32)
+
+
+def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
+                 k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                 pos: jnp.ndarray):
+    """One LLaMA block on ``(batch, cur, d)`` new tokens at absolute
+    positions ``pos .. pos+cur-1``, reading/writing a GQA-width KV cache
+    ``(batch, max_len, kv_heads, head_dim)`` — the cache is ``kv_heads /
+    num_heads`` the size of an MHA cache, GQA's whole point at decode
+    time.  Mirrors LlamaBlock exactly (the greedy-parity test referees)."""
+    b, cur, d = x.shape
+    h, kv = cfg.num_heads, cfg.kv_heads
+    dh = d // h
+    max_len = k_cache.shape[1]
+    positions = pos + jnp.arange(cur)
+
+    hN = _rms(p["rms_attn"], x, cfg.rms_eps)
+    attn = p["attn"]
+    q = apply_rope(_dense_nb(attn["wq"], hN, cfg.dtype).reshape(b, cur, h,
+                                                                dh),
+                   positions, cfg.rope_theta)
+    k = apply_rope(_dense_nb(attn["wk"], hN, cfg.dtype).reshape(b, cur, kv,
+                                                                dh),
+                   positions, cfg.rope_theta)
+    v = _dense_nb(attn["wv"], hN, cfg.dtype).reshape(b, cur, kv, dh)
+    from jax import lax
+
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    # Grouped attention over the KV-width cache: query head j attends KV
+    # head j // group (exactly the training path's jnp.repeat semantics —
+    # q's head axis reshaped (kv, group) keeps that mapping) WITHOUT
+    # materializing an MHA-width copy of the cache, so the GQA memory
+    # saving holds during attention too, not just in the cache buffer.
+    # Same op/dtype sequence as ops.attention's dense path (einsum in
+    # cfg.dtype, fp32 softmax) so bf16 rounding matches training exactly;
+    # the per-pair dot products are identical to the repeat formulation.
+    g = h // kv
+    qg = q.reshape(b, cur, kv, g, dh)
+    logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg, k_cache) * dh ** -0.5
+    visible = (jnp.arange(max_len)[None, :]
+               <= positions[:, None])  # (cur, max_len)
+    logits = jnp.where(visible[None, None, None], logits,
+                       jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
+    x = x + _dense_nb(attn["wo"], out.reshape(b, cur, d), cfg.dtype)
+
+    hN = _rms(p["rms_mlp"], x, cfg.rms_eps)
+    gate = nn.silu(_dense_nb(p["gate"], hN, cfg.dtype))
+    x = x + _dense_nb(p["down"],
+                      gate * _dense_nb(p["up"], hN, cfg.dtype), cfg.dtype)
+    return x, k_cache, v_cache
 
 
 class Llama(nn.Module):
