@@ -28,6 +28,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 def main() -> None:
     p = argparse.ArgumentParser()
+    p.add_argument("--family", default="gpt2", choices=["gpt2", "llama"],
+                   help="decoder family of the (checkpointed) model; must "
+                        "match the training run's --family")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA KV-head count (llama family; default = "
+                        "--heads).  With --checkpoint-dir it is validated "
+                        "against the checkpoint's wk projection width")
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--d-model", type=int, default=64)
     p.add_argument("--heads", type=int, default=None,
@@ -93,15 +100,37 @@ def main() -> None:
     from tpudp.models.gpt2 import GPT2, GPT2Config
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    cfg = GPT2Config(
-        vocab_size=args.vocab,
-        max_seq_len=args.seq_len,
-        num_layers=args.layers,
-        num_heads=args.heads or max(args.d_model // 64, 1),
-        d_model=args.d_model,
-        dtype=dtype,
-    )
-    model = GPT2(cfg)
+    if args.family == "llama":
+        from tpudp.models.llama import Llama, LlamaConfig
+
+        try:
+            cfg = LlamaConfig(
+                vocab_size=args.vocab,
+                max_seq_len=args.seq_len,
+                num_layers=args.layers,
+                num_heads=args.heads or max(args.d_model // 64, 1),
+                num_kv_heads=args.kv_heads,
+                d_model=args.d_model,
+                dtype=dtype,
+            )
+        except ValueError as e:
+            # LlamaConfig validates head/GQA geometry itself; surface it
+            # as the CLI's error UX, not a traceback.
+            raise SystemExit(f"error: {e}") from None
+        model = Llama(cfg)
+    else:
+        if args.kv_heads is not None:
+            raise SystemExit("error: --kv-heads (GQA) is a llama-family "
+                             "option")
+        cfg = GPT2Config(
+            vocab_size=args.vocab,
+            max_seq_len=args.seq_len,
+            num_layers=args.layers,
+            num_heads=args.heads or max(args.d_model // 64, 1),
+            d_model=args.d_model,
+            dtype=dtype,
+        )
+        model = GPT2(cfg)
     if args.checkpoint_dir:
         # Params-only restore: no knowledge of the training run's
         # optimizer config needed (clip/skip wrappers change the
@@ -118,6 +147,17 @@ def main() -> None:
         # The restore is target-free, so a config/checkpoint mismatch
         # would otherwise decode silently with half the layers or a
         # clamped vocab — validate the structure against the CLI flags.
+        # Family first: it IS recoverable (gpt2 has a wpe position table,
+        # llama has none), and a mismatch would otherwise die on a raw
+        # KeyError deep in the family-specific checks below.
+        is_llama_ckpt = "wpe" not in params
+        if (args.family == "llama") != is_llama_ckpt:
+            raise SystemExit(
+                f"error: checkpoint {latest} is a "
+                f"{'llama' if is_llama_ckpt else 'gpt2'}-family checkpoint "
+                f"(position table {'absent' if is_llama_ckpt else 'present'}"
+                f"), but --family {args.family} was passed — pass the "
+                "training run's --family")
         n_layers = sum(1 for k in params if k.startswith("h_"))
         wte = params["wte"]["embedding"]
         if n_layers != cfg.num_layers or wte.shape != (cfg.vocab_size,
@@ -128,17 +168,36 @@ def main() -> None:
                 f"{cfg.num_layers} layers / vocab {cfg.vocab_size} x "
                 f"d_model {cfg.d_model} — pass the training run's "
                 "--layers/--d-model/--vocab")
-        # wpe mismatch is the silent one: decoding past the trained
-        # max_seq_len clamps the position-embedding gather (JAX clamp
-        # semantics) — garbage output, no error (round-4 advisor).
-        wpe = params["wpe"]["embedding"]
-        if wpe.shape != (cfg.max_seq_len, cfg.d_model):
-            raise SystemExit(
-                f"error: checkpoint {latest} holds wpe "
-                f"{tuple(wpe.shape)}, but the flags describe max_seq_len "
-                f"{cfg.max_seq_len} x d_model {cfg.d_model} — pass the "
-                "training run's --seq-len (positions past the trained "
-                "length would silently clamp, not error)")
+        if args.family == "llama":
+            # RoPE has no position table, so --seq-len only bounds decode
+            # length here.  The llama-specific silent hazard is GQA
+            # width: wk's output dim IS recoverable from the params, so a
+            # wrong --kv-heads is catchable — catch it.
+            dh = cfg.d_model // cfg.num_heads
+            wk = params["h_0"]["attn"]["wk"]["kernel"]
+            if wk.shape[1] != cfg.kv_heads * dh:
+                raise SystemExit(
+                    f"error: checkpoint {latest} holds wk width "
+                    f"{wk.shape[1]} (= {wk.shape[1] // dh} KV heads at "
+                    f"head dim {dh}), but the flags describe "
+                    f"{cfg.kv_heads} KV heads — pass the training run's "
+                    "--kv-heads/--heads")
+            # (lm_head shape needs no separate check: any checkpoint this
+            # CLI restores was written from one LlamaConfig, so the wte
+            # check above already pinned d_model and vocab.)
+        else:
+            # wpe mismatch is the silent one: decoding past the trained
+            # max_seq_len clamps the position-embedding gather (JAX clamp
+            # semantics) — garbage output, no error (round-4 advisor).
+            wpe = params["wpe"]["embedding"]
+            if wpe.shape != (cfg.max_seq_len, cfg.d_model):
+                raise SystemExit(
+                    f"error: checkpoint {latest} holds wpe "
+                    f"{tuple(wpe.shape)}, but the flags describe "
+                    f"max_seq_len {cfg.max_seq_len} x d_model "
+                    f"{cfg.d_model} — pass the training run's --seq-len "
+                    "(positions past the trained length would silently "
+                    "clamp, not error)")
         # --heads is NOT recoverable from params (attention weights are
         # stored fused at d_model width), so a wrong value reshapes Q/K/V
         # silently into the wrong heads.  It must match the training run;

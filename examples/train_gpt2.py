@@ -79,9 +79,8 @@ def main() -> None:
     p.add_argument("--tokens-file", type=str, default=None)
     p.add_argument("--save-checkpoint", type=str, default=None, metavar="DIR",
                    help="save the final TrainState to DIR/step_<steps> "
-                        "(orbax; gpt2-family checkpoints are restorable by "
-                        "examples/generate_gpt2.py --checkpoint-dir DIR; "
-                        "llama ones via tpudp.utils.checkpoint)")
+                        "(orbax; restorable by examples/generate_gpt2.py "
+                        "--checkpoint-dir DIR with the matching --family)")
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
 
@@ -278,10 +277,6 @@ def main() -> None:
         ckpt = save_checkpoint(
             os.path.join(args.save_checkpoint, f"step_{args.steps}"), state)
         print(f"[{args.family}] saved checkpoint {ckpt}")
-        if args.family == "llama":
-            print("[llama] note: examples/generate_gpt2.py restores the "
-                  "gpt2 family only; restore llama checkpoints via "
-                  "tpudp.utils.checkpoint.restore_checkpoint/restore_params")
 
     if args.sample:
         from tpudp.models.generate import generate
