@@ -45,18 +45,27 @@ def main() -> None:
     # Newest measured headline row wins (history yields oldest-first and
     # now includes bench.history.jsonl, so next() would pick the OLDEST;
     # _dedupe's later-measured-wins semantics pick the freshest real one).
-    heads = _dedupe((r for r in _rows(os.path.join(args.dir, "bench.json"))
-                     if r.get("metric")), "metric")
+    # fp32-params rows only: the bf16-params lever capture shares the
+    # metric name and the history file but renders as its OWN row below —
+    # a later lever row must not displace the fp32 headline here.
+    bench_rows = _rows(os.path.join(args.dir, "bench.json"))
+    heads = _dedupe((r for r in bench_rows
+                     if r.get("metric")
+                     and r.get("param_dtype", "float32") == "float32"),
+                    "metric")
     head = next(iter(heads.values()), None)
     if head:
         if head.get("source") == "last_known_good":
             print(f"| (headline row is a banked last-known-good re-emission "
                   f"from {head.get('measured_at_utc')}) | | | |")
         if head.get("value", 0) > 0:
-            print(f"| tpudp fused DP step ({head['device_kind']}, "
-                  f"{head['dtype']}, batch {head['global_batch']}, donated) "
+            sec = head.get("sec_per_step")
+            sec_s = f"{sec * 1e3:.2f} ms/step, " if sec is not None else ""
+            print(f"| tpudp fused DP step ({head.get('device_kind')}, "
+                  f"{head.get('dtype')}, batch {head.get('global_batch')}, "
+                  f"donated) "
                   f"| **{head['value']:,} images/sec/chip** "
-                  f"({head['sec_per_step'] * 1e3:.2f} ms/step, "
+                  f"({sec_s}"
                   f"MFU {head.get('mfu')}, "
                   f"{head.get('vs_baseline')}x the 4-node Gloo bound) "
                   f"| `bench.py` | |")
@@ -67,6 +76,34 @@ def main() -> None:
                       f"{head.get('grad_bytes')} bytes) | `bench.py` | |")
         else:
             print(f"| bench.py | FAILED: {head.get('error')} | | |")
+
+    # bf16-params lever capture (VERDICT r4 #2): a second headline row
+    # measured with BENCH_PARAM_DTYPE=bfloat16 once the attribution sweep
+    # proved the win — render it next to the fp32 headline.
+    # Same sources AND criteria as bench_gaps.lever_missing — bench.py
+    # banks every fresh headline into bench.history.jsonl regardless of
+    # the stdout redirect, smoke (non-TPU) rows are never evidence, and
+    # the newest row is picked by timestamp, not file order (a committed
+    # stale bench.json must not displace a fresher banked row) — so the
+    # recorder and the gate can never disagree about the lever capture.
+    lever_cands = [
+        r for r in (_rows(os.path.join(args.dir, "bench_bf16.json"))
+                    + bench_rows)
+        if r.get("metric") == "vgg11_cifar10_images_per_sec_per_chip"
+        and r.get("param_dtype") == "bfloat16"
+        and r.get("source") != "last_known_good"
+        and "TPU" in str(r.get("device_kind", ""))
+        and measured(r)]
+    lever = max(lever_cands,
+                key=lambda r: str(r.get("measured_at_utc", "")),
+                default=None)
+    if lever:
+        lsec = lever.get("sec_per_step")
+        lsec_s = f"{lsec * 1e3:.2f} ms/step, " if lsec is not None else ""
+        print(f"| tpudp fused DP step, bf16 PARAMS+momentum (the measured "
+              f"mfu-attribution lever) | **{lever['value']:,} "
+              f"images/sec/chip** ({lsec_s}MFU {lever.get('mfu')}) "
+              f"| `bench.py` BENCH_PARAM_DTYPE=bfloat16 | |")
 
     ep = _dedupe((r for r in _rows(os.path.join(args.dir, "epoch.json"))
                   if r.get("metric")), "metric")
