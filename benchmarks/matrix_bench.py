@@ -94,6 +94,21 @@ def main() -> None:
     mesh = make_mesh()
     n_dev = mesh.size
     kind = jax.devices()[0].device_kind
+
+    def config_rng(name):
+        """Per-config seeded stream (round-5 advisor): a MATRIX_CONFIGS
+        subset run (the watcher's gap-resume path) must train each config
+        on the SAME tokens as a full sweep, so no config's draws may
+        depend on which other configs ran before it.  crc32, not hash():
+        str hash is salted per interpreter, which would reshuffle every
+        config's data on every relaunch."""
+        import zlib
+
+        return np.random.default_rng(zlib.crc32(name.encode()))
+
+    # The VGG ladder's shared batch keeps its historical seed-0 stream
+    # (drawn unconditionally before any config runs, so it never depended
+    # on subset selection — banked VGG loss rows stay comparable).
     rng = np.random.default_rng(0)
     results = []
 
@@ -187,11 +202,13 @@ def main() -> None:
         state = init_state(model, tx,
                            input_shape=(1, image_size, image_size, 3))
         step = make_train_step(model, tx, mesh, "allreduce", donate=True)
+        rrng = config_rng("resnet50")
         x = jax.device_put(
-            jnp.asarray(rng.normal(size=(rn_batch, image_size, image_size, 3)),
+            jnp.asarray(rrng.normal(size=(rn_batch, image_size, image_size,
+                                          3)),
                         jnp.float32), data_sh)
         y = jax.device_put(
-            jnp.asarray(rng.integers(0, 1000, size=rn_batch), jnp.int32),
+            jnp.asarray(rrng.integers(0, 1000, size=rn_batch), jnp.int32),
             data_sh)
         sec, loss = measure(step, state, (x, y), steps, warmup)
         emit("resnet50", sec, loss, unit="images/sec/chip",
@@ -204,9 +221,10 @@ def main() -> None:
         run_config("resnet50", run_resnet)
 
     # ---- LM configs: one harness, three model builds -------------------
-    # Dispatch order MUST follow MATRIX_CONFIGS: the shared ``rng`` stream
-    # is consumed per config in order, so reordering would silently train
-    # existing configs on different random tokens than their banked rows.
+    # Each config draws its tokens from its OWN config_rng(name) stream,
+    # so dispatch order and MATRIX_CONFIGS subsets cannot change what any
+    # config trains on (round-5 advisor: the old shared stream made
+    # subset-run loss values incomparable with full-sweep banked rows).
     def run_lm(name, batch_env, seq_env, default_batch, default_seq,
                build, flops_fn, extra_fn):
         g_batch = int(os.environ.get(batch_env, default_batch))
@@ -217,7 +235,8 @@ def main() -> None:
         state = init_state(model, tx, input_shape=(1, seq))
         step = make_train_step(model, tx, mesh, "allreduce", donate=True)
         toks = jax.device_put(
-            jnp.asarray(rng.integers(0, cfg.vocab_size, size=(g_batch, seq)),
+            jnp.asarray(config_rng(name).integers(0, cfg.vocab_size,
+                                                  size=(g_batch, seq)),
                         jnp.int32), data_sh)
         tgts = jax.device_put(jnp.roll(toks, -1, axis=1), data_sh)
         sec, loss = measure(step, state, (toks, tgts), steps, warmup)

@@ -1,0 +1,213 @@
+"""Serving throughput/latency: continuous batching vs sequential decode.
+
+The tpudp.serve engine multiplexes many generation requests through one
+jitted fixed-shape decode step (slot KV arena + chunked prefill); this
+bench quantifies what that buys over the one-request-at-a-time
+``generate()`` baseline the repo previously offered.  Workload: N
+requests with a shared small-GPT-2 config arriving as a POISSON process
+(exponential inter-arrival times at an offered load of ``SERVE_LOAD``
+times the sequential service rate per slot — saturating by default, so
+the number measures the engine, not the arrival gaps), swept over
+several concurrency levels (``num_slots``).
+
+One JSON line per concurrency level (machine-readable, same style as
+matrix_bench) plus a final summary line:
+
+  value                 aggregate NEW tokens/sec, first submit -> last token
+  p50/p99_token_latency_ms   per-token latency (submit->first token, then
+                        inter-token gaps — the streaming user experience)
+  mean_slot_occupancy   active slots / num_slots per decode step
+  speedup_vs_sequential value / the sequential generate() baseline
+
+Greedy decode, so every emitted token is bit-identical to what the
+sequential baseline produces for the same request (pinned by
+tests/test_serve.py) — the two columns measure the SAME work.
+
+Runs on whatever device is attached; SERVE_PLATFORM=cpu pins the CPU
+smoke mode (tier-1 runs it at a trimmed geometry).  Knobs: SERVE_CONCURRENCY
+(comma-separated subset of the registered levels — the watcher's
+gap-resume path), SERVE_REQUESTS, SERVE_PROMPT_LEN, SERVE_MAX_NEW,
+SERVE_LAYERS, SERVE_DMODEL, SERVE_VOCAB, SERVE_CHUNK, SERVE_LOAD,
+SERVE_SEED, SERVE_STRICT_LEVELS=1 (reject unregistered levels).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.bench_gaps import SERVE_CONCURRENCIES  # noqa: E402 (stdlib-only)
+
+METRIC = "serve_tokens_per_sec"
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    i = min(int(round(q / 100.0 * (len(xs) - 1))), len(xs) - 1)
+    return xs[i]
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("SERVE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["SERVE_PLATFORM"])
+    from tpudp.utils.device_lock import acquire_for_process
+
+    acquire_for_process()  # self-skips when cpu-pinned
+    from tpudp.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudp.models.generate import generate
+    from tpudp.models.gpt2 import GPT2, GPT2Config
+    from tpudp.serve import Engine
+
+    levels_env = os.environ.get("SERVE_CONCURRENCY")
+    levels = ([int(x) for x in levels_env.split(",") if x]
+              if levels_env else list(SERVE_CONCURRENCIES))
+    if os.environ.get("SERVE_STRICT_LEVELS") == "1":
+        bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
+        if bad:
+            raise SystemExit(f"error: unregistered concurrency levels {bad} "
+                             f"(registry: {list(SERVE_CONCURRENCIES)})")
+    n_requests = int(os.environ.get("SERVE_REQUESTS", 24))
+    prompt_len = int(os.environ.get("SERVE_PROMPT_LEN", 16))
+    max_new = int(os.environ.get("SERVE_MAX_NEW", 32))
+    chunk = int(os.environ.get("SERVE_CHUNK", 16))
+    load = float(os.environ.get("SERVE_LOAD", 8.0))
+    seed = int(os.environ.get("SERVE_SEED", 0))
+
+    # Default geometry: small GPT-2 family but with the weights (~93 MB
+    # fp32) well past any cache, so the decode step is weight-STREAM
+    # bound — the regime continuous batching exists for (a config whose
+    # weights fit in cache is FLOP-bound at decode and batching buys
+    # little; measured on the 2-core host: 17M params -> 2.8x batch-8
+    # scan gain, 4M params -> 2.0x).
+    dm = int(os.environ.get("SERVE_DMODEL", 512))
+    cfg = GPT2Config(
+        vocab_size=int(os.environ.get("SERVE_VOCAB", 8192)),
+        max_seq_len=((prompt_len + max_new + chunk - 1) // chunk) * chunk,
+        num_layers=int(os.environ.get("SERVE_LAYERS", 6)),
+        num_heads=max(dm // 64, 1),
+        d_model=dm,
+    )
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    kind = jax.devices()[0].device_kind
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len)
+               .astype(np.int32) for _ in range(n_requests)]
+
+    # ---- sequential generate() baseline (one request at a time) --------
+    # Warmup compiles the prefill+decode program; every request shares the
+    # (prompt_len, max_new) geometry, so the timed loop never recompiles.
+    np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
+                        max_new))
+    t0 = time.perf_counter()
+    seq_latencies = []
+    for p in prompts:
+        r0 = time.perf_counter()
+        np.asarray(generate(model, params, jnp.asarray(p[None]), max_new))
+        seq_latencies.append(time.perf_counter() - r0)
+    seq_elapsed = time.perf_counter() - t0
+    seq_tps = n_requests * max_new / seq_elapsed
+    per_req_s = seq_elapsed / n_requests
+
+    results = []
+
+    def run_level(c: int) -> None:
+        engine = Engine(model, params, num_slots=c,
+                        max_len=cfg.max_seq_len, prefill_chunk=chunk)
+        # Warmup: compile prefill/decode/sample for THIS geometry off the
+        # clock (the persistent cache makes relaunches cheap on TPU).
+        engine.generate_many(prompts[:2], 2)
+        base_stats = dict(engine.stats)
+
+        # Poisson arrivals: offered load = `load` x the sequential service
+        # rate per slot -> saturating for load >= 1.
+        lam = load * c / per_req_s  # requests/sec
+        arrival_rng = np.random.default_rng(seed + 1)
+        gaps = arrival_rng.exponential(1.0 / lam, size=n_requests)
+        offsets = np.cumsum(gaps) - gaps[0]  # first request at t=0
+
+        start = time.perf_counter()
+        handles = []
+        nxt = 0
+        latencies = []
+        last_emit = start
+        while nxt < n_requests or engine.slots_in_use or engine.queue_depth:
+            now = time.perf_counter()
+            while nxt < n_requests and now - start >= offsets[nxt]:
+                handles.append(engine.submit(prompts[nxt], max_new,
+                                             seed=seed + nxt))
+                nxt += 1
+                now = time.perf_counter()
+            if engine.slots_in_use or engine.queue_depth:
+                for req, _tok in engine.step():
+                    t = req.token_times[-1]
+                    prev = (req.token_times[-2] if len(req.token_times) > 1
+                            else req.submit_time)
+                    latencies.append(t - prev)
+                    last_emit = t
+            elif nxt < n_requests:
+                time.sleep(min(0.001, max(offsets[nxt] - (now - start), 0)))
+        elapsed = last_emit - start
+        tps = n_requests * max_new / elapsed if elapsed > 0 else 0.0
+        dec = engine.stats["decode_steps"] - base_stats.get("decode_steps", 0)
+        act = (engine.stats["active_slot_steps"]
+               - base_stats.get("active_slot_steps", 0))
+        occupancy = act / (dec * c) if dec else None
+        row = {
+            "metric": METRIC,
+            "concurrency": c,
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "speedup_vs_sequential": round(tps / seq_tps, 2) if seq_tps
+            else None,
+            "p50_token_latency_ms": round(
+                _percentile(latencies, 50) * 1e3, 3),
+            "p99_token_latency_ms": round(
+                _percentile(latencies, 99) * 1e3, 3),
+            "seq_p50_request_latency_ms": round(
+                _percentile(seq_latencies, 50) * 1e3, 1),
+            "mean_slot_occupancy": (round(occupancy, 3)
+                                    if occupancy is not None else None),
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new,
+            "prefill_chunk": chunk,
+            "offered_load": load,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    for c in levels:
+        # One level crashing (OOM, transient backend fault) must not cost
+        # the remaining rows — same isolation contract as matrix_bench.
+        try:
+            run_level(c)
+        except Exception as exc:  # noqa: BLE001
+            row = {"metric": METRIC, "concurrency": c,
+                   "error": f"{type(exc).__name__}: {exc}"[:500]}
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
+    print(json.dumps({"serve": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -141,6 +141,15 @@ def test_record_bench_renders_freshest_rows(tmp_path):
         {"variant": "no_bn", "sec_per_step": 0.0023,
          "bn_share_of_full": 0.17, "device_kind": "TPU v5 lite"},
     ])
+    _write(os.path.join(d, "serve.jsonl"), [
+        {"metric": "serve_tokens_per_sec", "concurrency": 8,
+         "value": 5120.5, "unit": "tokens/sec",
+         "speedup_vs_sequential": 3.8, "p50_token_latency_ms": 4.2,
+         "p99_token_latency_ms": 11.0, "mean_slot_occupancy": 0.93,
+         "device_kind": "TPU v5 lite"},
+        {"metric": "serve_tokens_per_sec", "concurrency": 4,
+         "error": "relay wedged"},
+    ])
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
         [sys.executable, os.path.join(repo, "tools", "record_bench.py"),
@@ -149,6 +158,8 @@ def test_record_bench_renders_freshest_rows(tmp_path):
     assert "last-known-good" in out   # re-emission annotated
     assert "88,000.0" in out          # epoch row renders
     assert "BatchNorm 17.0%" in out   # MFU attribution row renders
+    assert "5,120.5 tokens/sec" in out  # serving row renders
+    assert "serve c=4 | ERROR" in out   # serving error row surfaces
     assert "None%" not in out         # missing gap never prints literally
 
 

@@ -69,6 +69,13 @@ def test_validation():
         mlp_impl="moe", num_experts=2, capacity_factor=4.0)
     with pytest.raises(ValueError, match="dense"):
         generate(moe_model, moe_params, prompt, 1)
+    # flash-trained configs are rejected too (round-5 advisor): decode
+    # runs dense math, so exact greedy train/decode parity would be lost
+    # silently for a Pallas-online-softmax-trained model.  (Validation
+    # fires before params are touched, so the dense params stand in.)
+    flash_model = gpt2_small(**{**TINY, "attn_impl": "flash"})
+    with pytest.raises(ValueError, match="attn_impl='dense'"):
+        generate(flash_model, params, jnp.zeros((1, 4), jnp.int32), 1)
 
 
 def test_top_k_and_top_p_sampling():

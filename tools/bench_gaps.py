@@ -12,6 +12,8 @@ the missing work as arguments the benches accept:
                                            row is still missing
     python tools/bench_gaps.py mfu      -> comma-separated MFU_VARIANTS
                                            (ablations still unmeasured)
+    python tools/bench_gaps.py serve    -> comma-separated concurrency
+                                           levels (serving rows missing)
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -27,6 +29,11 @@ MATRIX_CONFIGS = ("part1_single", "dp_psum", "dp_ring", "dp_coordinator",
                   "dp_gspmd", "resnet50", "gpt2_small", "gpt2_flash",
                   "llama_gqa")
 FLASH_TS = (4096, 8192, 16384)
+# Concurrency levels the serving bench (benchmarks/serve_bench.py) must
+# measure — the canonical registry the bench imports, same contract as
+# MATRIX_CONFIGS (a level added on one side but not the other would
+# silently never be measured).
+SERVE_CONCURRENCIES = (1, 4, 8)
 
 
 def history_path(path: str) -> str:
@@ -105,6 +112,22 @@ def flash_missing(d: str) -> list[int]:
         if r.get("t") in FLASH_TS and measured(r):
             done.add(r["t"])
     return [t for t in FLASH_TS if t not in done]
+
+
+def serve_missing(d: str) -> list[int]:
+    """Serving-bench concurrency levels still lacking a real TPU
+    measurement (CPU smoke rows — the tier-1 regression run — must not
+    satisfy the gate, same rule as mfu_missing).  Returned comma-ready
+    so the watcher passes the gaps straight to SERVE_CONCURRENCY and a
+    window resumes the sweep mid-way."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve.jsonl")):
+        if (r.get("metric") == "serve_tokens_per_sec"
+                and r.get("concurrency") in SERVE_CONCURRENCIES
+                and measured(r)
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["concurrency"])
+    return [c for c in SERVE_CONCURRENCIES if c not in done]
 
 
 def epoch_missing(d: str) -> bool:
@@ -207,7 +230,7 @@ def collective_missing(d: str) -> bool:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
-                                     "collective", "lever"])
+                                     "collective", "lever", "serve"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -216,6 +239,8 @@ def main() -> None:
         print("epoch" if epoch_missing(args.dir) else "", end="")
     elif args.stage == "mfu":
         print(",".join(mfu_missing(args.dir)), end="")
+    elif args.stage == "serve":
+        print(",".join(str(c) for c in serve_missing(args.dir)), end="")
     elif args.stage == "collective":
         print("collective" if collective_missing(args.dir) else "", end="")
     elif args.stage == "lever":

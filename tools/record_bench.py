@@ -174,6 +174,23 @@ def main() -> None:
               f"{', '.join(shares) or 'shares pending'}{trace_s} | "
               f"`mfu_attribution.py` | |")
 
+    serve = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve.jsonl"))
+         if "concurrency" in r and "serve" not in r), "concurrency")
+    for r in sorted(serve.values(), key=lambda r: r.get("concurrency", 0)):
+        if not measured(r):
+            print(f"| serve c={r.get('concurrency')} | ERROR: "
+                  f"{r.get('error', 'no real measurement')[:120]} | "
+                  f"`serve_bench.py` | |")
+        else:
+            print(f"| serving throughput, concurrency "
+                  f"{r['concurrency']} | **{r['value']:,} tokens/sec** "
+                  f"({r.get('speedup_vs_sequential')}x sequential "
+                  f"generate(), p50/p99 token latency "
+                  f"{r.get('p50_token_latency_ms')}/"
+                  f"{r.get('p99_token_latency_ms')} ms, occupancy "
+                  f"{r.get('mean_slot_occupancy')}) | `serve_bench.py` | |")
+
     flash = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
          if "t" in r), "t")

@@ -83,6 +83,10 @@ epoch_ok() {
   local out; out=$(python tools/bench_gaps.py epoch) || return 1
   [ -z "$out" ]
 }
+serve_ok() {
+  local out; out=$(python tools/bench_gaps.py serve) || return 1
+  [ -z "$out" ]
+}
 mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
@@ -305,6 +309,19 @@ while true; do
         > bench_results/epoch.json 2> bench_results/epoch.err
       log "epoch_bench rc=$? -> bench_results/epoch.json"
     fi
+    if serve_ok; then
+      log "serve.jsonl already good; skipping serve bench"
+    else
+      # Serving throughput/latency (continuous batching vs sequential
+      # generate(); tpudp.serve) — resumes at concurrency-level
+      # granularity via bench_gaps, like the matrix stage.
+      bank bench_results/serve.jsonl
+      ensure_window
+      SERVE_CONCURRENCY="$(python tools/bench_gaps.py serve)" \
+        timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
+        > bench_results/serve.jsonl 2> bench_results/serve.err
+      log "serve_bench rc=$? -> bench_results/serve.jsonl"
+    fi
     if flash_ok; then
       log "flash.jsonl already good; skipping flash bench"
     else
@@ -333,7 +350,7 @@ while true; do
     # waiting for the next window (a stage that died on a healthy relay —
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
-        && lever_ok && collective_ok; then
+        && lever_ok && collective_ok && serve_ok; then
       log "battery done"
       exit 0
     fi

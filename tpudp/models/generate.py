@@ -69,11 +69,30 @@ def _dense(p: dict, x: jnp.ndarray, dtype) -> jnp.ndarray:
     return x.astype(dtype) @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)
 
 
+def update_cache_rows(cache: jnp.ndarray, new: jnp.ndarray,
+                      pos: jnp.ndarray) -> jnp.ndarray:
+    """Write ``new`` ``(b, cur, heads, dh)`` into ``cache``
+    ``(b, max_len, heads, dh)`` starting at PER-ROW positions ``pos``
+    ``(b,)`` — the serve engine's slot arena, where every slot sits at a
+    different depth.  A vmapped ``dynamic_update_slice`` so shapes stay
+    static regardless of the position values (no recompiles across
+    admission/retirement churn)."""
+    return jax.vmap(
+        lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0, 0)))(
+            cache, new, pos)
+
+
 def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
                   k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                   pos: jnp.ndarray):
     """One pre-LN block on ``(batch, cur, d)`` new tokens at absolute
     positions ``pos .. pos+cur-1``, reading/writing the KV cache.
+
+    ``pos`` is either a scalar shared by the whole batch (generate /
+    beam_search, where every row is at the same depth) or a ``(batch,)``
+    vector of per-row positions (the serve engine's slot arena).  The
+    scalar path compiles to exactly the program it always did; the vector
+    path scatters each row's KV at its own depth and masks per row.
 
     Mirrors tpudp.models.gpt2.Block exactly (the parity test referee);
     attention spans the cache up to ``pos`` plus a causal mask within the
@@ -89,8 +108,13 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     q = q.reshape(b, cur, h, dh)
     k = k.reshape(b, cur, h, dh)
     v = v.reshape(b, cur, h, dh)
-    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    pos = jnp.asarray(pos)
+    if pos.ndim:  # per-row slot positions (serve engine)
+        k_cache = update_cache_rows(k_cache, k, pos)
+        v_cache = update_cache_rows(v_cache, v, pos)
+    else:
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
     # Same op/dtype sequence as ops.attention.multihead_attention's dense
     # path (einsum in cfg.dtype, fp32 softmax) — in bf16, rounding QK^T
@@ -98,10 +122,17 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     scale = dh ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
     # Key j visible to new-token query i iff j <= pos + i.
-    q_pos = pos + jnp.arange(cur)[:, None]
-    visible = jnp.arange(max_len)[None, :] <= q_pos  # (cur, max_len)
-    logits = jnp.where(visible[None, None], logits,
-                       jnp.finfo(logits.dtype).min)
+    if pos.ndim:
+        q_pos = pos[:, None] + jnp.arange(cur)  # (b, cur)
+        visible = (jnp.arange(max_len)[None, None, :]
+                   <= q_pos[:, :, None])  # (b, cur, max_len)
+        logits = jnp.where(visible[:, None], logits,
+                           jnp.finfo(logits.dtype).min)
+    else:
+        q_pos = pos + jnp.arange(cur)[:, None]
+        visible = jnp.arange(max_len)[None, :] <= q_pos  # (cur, max_len)
+        logits = jnp.where(visible[None, None], logits,
+                           jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32),
                            axis=-1).astype(cfg.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
@@ -118,6 +149,10 @@ def _forward_cached(cfg, params: dict, tokens: jnp.ndarray,
     """Token ids ``(batch, cur)`` at absolute position ``pos`` ->
     ``(batch, cur, vocab)`` fp32 logits + updated cache.
 
+    ``pos`` is a scalar (whole batch at the same depth — generate /
+    beam_search) or a ``(batch,)`` vector of per-row depths (the serve
+    engine's slot-masked decode step; see tpudp.serve).
+
     Dispatches on the config family: GPT-2 (learned positions in the
     embedding, LayerNorm/GELU blocks, tied head) or LLaMA (RoPE inside
     the blocks, RMSNorm/SwiGLU, GQA-width cache, untied head) — both via
@@ -125,13 +160,15 @@ def _forward_cached(cfg, params: dict, tokens: jnp.ndarray,
     pinned by the greedy-parity tests."""
     from tpudp.models import llama as _llama
 
+    pos = jnp.asarray(pos)
     if isinstance(cfg, _llama.LlamaConfig):
         x = _llama.embed_tokens(cfg, params, tokens)
         block = lambda p, x, k, v: _llama.block_decode(cfg, p, x, k, v, pos)
         head = _llama.lm_head
     else:
-        x = embed_tokens(cfg, params, tokens,
-                         pos + jnp.arange(tokens.shape[1]))
+        offsets = jnp.arange(tokens.shape[1])
+        positions = (pos[:, None] + offsets) if pos.ndim else pos + offsets
+        x = embed_tokens(cfg, params, tokens, positions)
         block = lambda p, x, k, v: _block_decode(cfg, p, x, k, v, pos)
         head = lm_head
     new_k, new_v = [], []
@@ -143,13 +180,30 @@ def _forward_cached(cfg, params: dict, tokens: jnp.ndarray,
     return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
 
 
+def validate_decode_config(cfg, fn_name: str) -> None:
+    """Reject configs the raw-param decode twins cannot serve faithfully.
+
+    ``attn_impl='flash'`` is rejected alongside 'ring' (round-5 advisor):
+    decode always runs the dense-math raw-param twins, and the Pallas
+    online-softmax rounds bf16 differently from the XLA dense chain, so a
+    flash-trained config would silently lose the documented EXACT greedy
+    train/decode parity.  The weights themselves are fine — rebuild the
+    config with ``attn_impl='dense'`` to decode them.  Shared by the
+    generate()/beam_search() entry points and tpudp.serve.Engine."""
+    mlp_impl = getattr(cfg, "mlp_impl", "dense")  # LlamaConfig: dense only
+    if cfg.attn_impl != "dense" or mlp_impl != "dense":
+        raise ValueError(
+            f"{fn_name} supports dense-attention/dense-MLP configs "
+            f"(decode runs the dense-math twins; a flash/ring-trained "
+            f"config would decode with different rounding than it trained "
+            f"with — rebuild the config with attn_impl='dense' to decode "
+            f"its weights); got attn_impl={cfg.attn_impl!r} "
+            f"mlp_impl={mlp_impl!r}")
+
+
 def _validate_decode(cfg, prompt, max_new_tokens: int, fn_name: str) -> int:
     """Shared decode-entry checks; returns the total sequence length."""
-    mlp_impl = getattr(cfg, "mlp_impl", "dense")  # LlamaConfig: dense only
-    if cfg.attn_impl == "ring" or mlp_impl != "dense":
-        raise ValueError(
-            f"{fn_name} supports dense-attention/dense-MLP configs; "
-            f"got attn_impl={cfg.attn_impl!r} mlp_impl={mlp_impl!r}")
+    validate_decode_config(cfg, fn_name)
     prompt_len = prompt.shape[1]
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:

@@ -98,17 +98,21 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
                theta: float = 10_000.0) -> jnp.ndarray:
     """Rotate ``x`` (B, T, H, Dh) by position-dependent angles.
 
-    Rotate-half convention: the head dim is split in two halves that form
-    the (real, imag) parts of Dh/2 complex pairs; pair ``i`` turns by
-    ``positions / theta**(2i/Dh)``.  Computed in fp32 (angles at large
-    positions lose precision in bf16) and cast back to ``x.dtype``.
+    ``positions`` is ``(T,)`` (every batch row at the same positions —
+    training and the generate/beam decode) or ``(B, T)`` (per-row
+    positions — the serve engine's slot arena, where each slot sits at a
+    different depth).  Rotate-half convention: the head dim is split in
+    two halves that form the (real, imag) parts of Dh/2 complex pairs;
+    pair ``i`` turns by ``positions / theta**(2i/Dh)``.  Computed in fp32
+    (angles at large positions lose precision in bf16) and cast back to
+    ``x.dtype``.
     """
     half = x.shape[-1] // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32)
                                 * 2.0 / x.shape[-1]))
-    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]  # (1, T, 1, half)
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    cos = jnp.cos(angles)[..., None, :]  # (T, 1, half) or (B, T, 1, half)
+    sin = jnp.sin(angles)[..., None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin,
@@ -198,12 +202,20 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     positions ``pos .. pos+cur-1``, reading/writing a GQA-width KV cache
     ``(batch, max_len, kv_heads, head_dim)`` — the cache is ``kv_heads /
     num_heads`` the size of an MHA cache, GQA's whole point at decode
-    time.  Mirrors LlamaBlock exactly (the greedy-parity test referees)."""
+    time.  ``pos`` is a scalar (whole batch at one depth) or a
+    ``(batch,)`` vector of per-row depths (tpudp.serve's slot arena);
+    the scalar path compiles to the program it always did.  Mirrors
+    LlamaBlock exactly (the greedy-parity test referees)."""
     b, cur, d = x.shape
     h, kv = cfg.num_heads, cfg.kv_heads
     dh = d // h
     max_len = k_cache.shape[1]
-    positions = pos + jnp.arange(cur)
+    pos = jnp.asarray(pos)
+    per_row = bool(pos.ndim)
+    # (cur,) shared positions, or (b, cur) per-row — apply_rope and the
+    # visibility mask below broadcast either shape.
+    positions = (pos[:, None] + jnp.arange(cur)) if per_row \
+        else pos + jnp.arange(cur)
 
     hN = _rms(p["rms_attn"], x, cfg.rms_eps)
     attn = p["attn"]
@@ -216,8 +228,14 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     v = _dense_nb(attn["wv"], hN, cfg.dtype).reshape(b, cur, kv, dh)
     from jax import lax
 
-    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    if per_row:
+        from tpudp.models.generate import update_cache_rows
+
+        k_cache = update_cache_rows(k_cache, k, pos)
+        v_cache = update_cache_rows(v_cache, v, pos)
+    else:
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
     # Grouped attention over the KV-width cache: query head j attends KV
     # head j // group (exactly the training path's jnp.repeat semantics —
@@ -230,10 +248,10 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     g = h // kv
     qg = q.reshape(b, cur, kv, g, dh)
     logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg, k_cache) * dh ** -0.5
-    visible = (jnp.arange(max_len)[None, :]
-               <= positions[:, None])  # (cur, max_len)
-    logits = jnp.where(visible[None, None, None], logits,
-                       jnp.finfo(logits.dtype).min)
+    # (cur, max_len) shared mask, or (b, cur, max_len) per-row.
+    visible = jnp.arange(max_len) <= positions[..., None]
+    vis_b = visible[:, None, None] if per_row else visible[None, None, None]
+    logits = jnp.where(vis_b, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32),
                            axis=-1).astype(cfg.dtype)
     out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)

@@ -1,3 +1,4 @@
 from tpudp.ops.flash_attention import flash_attention
+from tpudp.ops.sampling import sample_tokens, split_keys
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "sample_tokens", "split_keys"]

@@ -1,0 +1,116 @@
+"""Per-row masked token sampling — the serve engine's sampling op.
+
+``tpudp.models.generate._truncate_logits`` bakes one ``(temperature,
+top_k, top_p)`` combination into the compiled program as Python statics —
+right for ``generate()``, where the whole batch shares one request's
+params.  A continuous-batching engine multiplexes requests with
+DIFFERENT sampling params through one fixed-shape decode step, so here
+they are TRACED ``(n,)`` arrays: admitting a request with a new
+temperature or top-k must never recompile the step (the static-shape
+invariant of tpudp.serve).
+
+Per-row semantics match the static op row-wise:
+
+  * ``temperature[i] == 0``  -> greedy argmax (top_k/top_p ignored);
+  * ``top_k[i] == 0``        -> top-k disabled (keep the whole vocab);
+  * ``top_p[i] == 1``        -> nucleus disabled;
+  * the nucleus always keeps the highest-probability token, and
+    truncation applies AFTER temperature scaling — both exactly like
+    ``_truncate_logits``.
+
+The dynamic top-k cannot use ``lax.top_k`` (its k is a static shape
+parameter), so it is a rank mask off a descending sort of the vocab
+axis; the nucleus then runs the static op's prefix-mass scan over the
+top-k-MASKED distribution (the same composition order as
+``_truncate_logits``: k-truncate, renormalize, then p-truncate).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
+                  top_k: jnp.ndarray, top_p: jnp.ndarray,
+                  keys: jnp.ndarray) -> jnp.ndarray:
+    """Sample one token per row from ``logits`` ``(n, vocab)`` fp32.
+
+    ``temperature`` ``(n,)`` >= 0 (0 = greedy), ``top_k`` ``(n,)`` int32
+    (0 = disabled), ``top_p`` ``(n,)`` in (0, 1] (1 = disabled), ``keys``
+    ``(n, 2)`` uint32 — one PRNG key per row, so each row's draw stream
+    is independent of its neighbours (a serve slot's sampled tokens must
+    not depend on which other requests are co-resident).
+
+    Returns ``(n,)`` int32 token ids.  All params are traced values —
+    any combination runs through one compiled program.
+    """
+    n, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Scale first (like generate(): logits/T, THEN truncate).  Greedy rows
+    # divide by 1 — their value never reaches the output anyway.
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+
+    def _truncate(scaled):
+        # Top-k FIRST, then the nucleus over the top-k-RENORMALIZED
+        # distribution — the same composition order as _truncate_logits
+        # (which masks to -inf before the nucleus softmax), so the two
+        # ops keep identical token sets.  One descending sort serves
+        # both: the k-masked -infs sink to the tail and contribute
+        # exactly 0 nucleus mass.
+        sorted_scaled = jnp.sort(scaled, axis=-1)[..., ::-1]
+
+        # Dynamic top-k: keep rows' logits >= their k-th largest value.
+        kth_idx = jnp.clip(top_k[:, None] - 1, 0, v - 1)
+        kth = jnp.take_along_axis(sorted_scaled, kth_idx, axis=-1)
+        keep_k = (top_k[:, None] <= 0) | (scaled >= kth)
+        masked_k = jnp.where(keep_k, scaled, -jnp.inf)
+
+        # Nucleus: keep ranks whose PRECEDING cumulative mass is < top_p
+        # (so the argmax is always kept); cutoff = worst kept sorted
+        # logit.  sorted_k re-sorts the MASKED array rather than rank-
+        # masking sorted_scaled: `scaled >= kth` keeps ties at the k-th
+        # value just like _truncate_logits, and only a sort of the
+        # tie-inclusive mask reproduces its nucleus mass exactly.  Both
+        # sorts sit behind the any_trunc cond — untruncated steps pay
+        # neither.
+        sorted_k = jnp.sort(masked_k, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_k, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        preceding = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1)
+        in_nucleus = preceding < top_p[:, None]
+        cutoff = jnp.min(jnp.where(in_nucleus, sorted_k, jnp.inf),
+                         axis=-1, keepdims=True)
+        keep_p = (top_p[:, None] >= 1.0) | (masked_k >= cutoff)
+        return jnp.where(keep_p, masked_k, -jnp.inf)
+
+    def _with_sampling(scaled):
+        # The vocab sort is the expensive piece (XLA CPU sorts are slow,
+        # and even on TPU it is pure overhead for untruncated rows), so
+        # it runs only when some sampled row actually truncates.
+        any_trunc = jnp.any((temperature > 0)
+                            & ((top_k > 0) | (top_p < 1.0)))
+        masked = lax.cond(any_trunc, _truncate, lambda s: s, scaled)
+        sampled = jax.vmap(
+            lambda key, row: jax.random.categorical(key, row))(keys, masked)
+        return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)
+
+    # Both gates are DATA (traced), not statics: one compiled program
+    # serves every mix, but an all-greedy step pays argmax only.
+    return lax.cond(jnp.any(temperature > 0), _with_sampling,
+                    lambda scaled: greedy, scaled)
+
+
+def split_keys(keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Split ``(n, 2)`` uint32 keys row-wise into (carry, subkey) pairs.
+
+    The serve decode step draws with the subkeys and commits the carries
+    only for rows that actually sampled this step, so a request's key
+    chain advances once per OWN token — its draws are reproducible
+    regardless of admission order or co-resident requests."""
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return split[:, 0], split[:, 1]

@@ -1,0 +1,6 @@
+"""tpudp.serve — continuous-batching inference (slot scheduler, chunked
+prefill, streaming decode).  See docs/SERVING.md."""
+
+from tpudp.serve.engine import TRACE_COUNTS, Engine, Request
+
+__all__ = ["Engine", "Request", "TRACE_COUNTS"]

@@ -1,0 +1,403 @@
+"""Continuous-batching inference engine — many requests, ONE compiled step.
+
+``tpudp.models.generate`` decodes one request at a time: a second request
+waits for the first's entire ``lax.scan`` to finish, so TPU utilization
+collapses under concurrency.  But the decode step's cost is dominated by
+WEIGHT reads (every parameter crosses HBM once per step regardless of
+batch), so batching concurrent requests into one step multiplies
+tokens/sec nearly for free — the serving analogue of the training
+lesson that throughput comes from letting one compiled program amortize
+work across the batch.
+
+Design (static shapes everywhere — the TPU rule that shapes are compile
+-time constants holds for serving too):
+
+  * **Slot-based KV arena** — ONE preallocated ``(layers, num_slots,
+    max_len, kv_heads, head_dim)`` KVCache.  A request is admitted by
+    picking a free slot index and retired by freeing it; array shapes
+    never change, so the jitted decode step compiles exactly once per
+    ``(config, num_slots, max_len)`` and admission/retirement churn never
+    recompiles (``TRACE_COUNTS`` observes this; a test pins it).
+  * **Slot-masked decode step** — all ``num_slots`` rows run every step
+    with PER-ROW positions (``models.generate._forward_cached``'s vector
+    -``pos`` path).  Inactive rows compute garbage that is never read:
+    each row is independent, and any garbage KV a masked row writes at
+    its current depth is overwritten by the write of whichever token is
+    actually processed at that depth before any query can attend to it
+    (writes happen before the attention read inside the same forward).
+  * **Chunked prefill** — prompts enter through the same cached forward
+    in fixed ``prefill_chunk``-token chunks (one chunk per engine step,
+    single slot, batch 1, the scalar-``pos`` path sliced to that slot's
+    arena row), so a long prompt never stalls in-flight decodes for more
+    than one chunk.  Chunk starts are multiples of ``prefill_chunk`` and
+    ``max_len`` is rounded to a chunk multiple, so the fixed-size chunk
+    write can never be clamped into clobbering earlier positions.
+  * **Per-request sampling** — temperature/top-k/top-p/PRNG key live in
+    per-slot ARRAYS (``tpudp.ops.sampling``), traced not static, so any
+    mix of sampling params shares the one compiled step.  Each slot's
+    key chain advances once per OWN sampled token, making a request's
+    sampled output reproducible regardless of admission order or which
+    requests are co-resident — greedy requests are bit-identical to
+    standalone ``generate()`` (the parity tests referee).
+
+Host-side scheduling (admission, retirement, chunk bookkeeping) is plain
+Python between device steps — the same split as the training stack
+(host data pipeline around a jitted step).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpudp.models.generate import (KVCache, _forward_cached,
+                                   validate_decode_config)
+from tpudp.ops.sampling import sample_tokens, split_keys
+
+# Trace-time side-effect counters: each jitted step body bumps its entry
+# when (and only when) XLA traces it, so tests can assert the decode step
+# compiles ONCE per engine geometry no matter how many requests churn
+# through the slots.
+TRACE_COUNTS = collections.Counter()
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _decode_step(cfg, params, cache, last_tokens, lengths, active, temps,
+                 top_k, top_p, keys):
+    """One token for every slot: feed each row's last token at its own
+    depth, sample per-row.  All sampling params and positions are traced
+    arrays — the ONLY static is the config, so this compiles once per
+    (cfg, num_slots, max_len).  The cache is donated: XLA updates the
+    arena in place instead of copying it every step."""
+    TRACE_COUNTS["decode_step"] += 1
+    logits, cache = _forward_cached(cfg, params, last_tokens[:, None],
+                                    cache, lengths)
+    carry, sub = split_keys(keys)
+    toks = sample_tokens(logits[:, 0], temps, top_k, top_p, sub)
+    # Only rows that actually sampled advance their key chain — a
+    # request's draw stream must not depend on co-resident requests.
+    new_keys = jnp.where(active[:, None], carry, keys)
+    return cache, toks, new_keys
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _prefill_step(cfg, params, cache, slot, tokens, pos, last):
+    """One fixed-size prompt chunk for one slot: slice the slot's arena
+    row, run the scalar-pos cached forward (batch 1), write the row back.
+    ``slot``/``pos``/``last`` are traced scalars — chunk number, slot
+    index, and prompt length never recompile.  Returns the logits at the
+    chunk's LAST VALID token (index ``last``; the tail of a final partial
+    chunk is padding) and the updated arena."""
+    TRACE_COUNTS["prefill_chunk"] += 1
+    k = lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
+    v = lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
+    logits, row = _forward_cached(cfg, params, tokens, KVCache(k, v), pos)
+    last_logits = lax.dynamic_index_in_dim(logits, last, axis=1,
+                                           keepdims=False)  # (1, vocab)
+    return last_logits, KVCache(
+        lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1),
+        lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1))
+
+
+@jax.jit
+def _sample_row(logits, temp, top_k, top_p, key):
+    """First-token sample after a finished prefill: one row through the
+    same masked-sampling op the decode step uses, advancing the slot's
+    key chain exactly once."""
+    carry, sub = split_keys(key[None])
+    tok = sample_tokens(logits, temp[None], top_k[None], top_p[None], sub)
+    return tok[0], carry[0]
+
+
+class Request:
+    """Handle returned by :meth:`Engine.submit`.
+
+    ``tokens`` grows as the engine steps; iterate the handle to stream
+    them (iteration drives the engine), or call :meth:`result` for the
+    full prompt+completion sequence.  ``token_times`` records a
+    ``time.perf_counter()`` stamp per emitted token (the serve bench's
+    per-token latency source)."""
+
+    def __init__(self, engine: "Engine", rid: int, prompt: np.ndarray,
+                 max_new_tokens: int, temperature: float, top_k: int,
+                 top_p: float, seed: int, eos_id: int | None):
+        self._engine = engine
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k  # 0 = disabled
+        self.top_p = top_p  # 1.0 = disabled
+        self.seed = seed
+        self.eos_id = eos_id
+        self.tokens: list[int] = []
+        self.token_times: list[float] = []
+        self.submit_time = time.perf_counter()
+        self.done = False
+        self._slot: int | None = None
+        self._nfill = 0  # prompt tokens already in the cache
+        self._order = 0  # admission order (prefill FIFO tiebreak)
+
+    def __iter__(self):
+        i = 0
+        while True:
+            while i >= len(self.tokens) and not self.done:
+                self._engine.step()
+            if i < len(self.tokens):
+                yield self.tokens[i]
+                i += 1
+            else:
+                return
+
+    def result(self) -> np.ndarray:
+        """Drive the engine until this request completes; return the full
+        ``prompt + generated`` int32 sequence."""
+        while not self.done:
+            self._engine.step()
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+
+class Engine:
+    """Continuous-batching engine over a slot-based KV arena.
+
+    ``model`` is a tpudp GPT2 or Llama (dense attention/MLP — the same
+    family contract as ``generate()``); ``num_slots`` bounds concurrent
+    in-flight requests (queued requests wait for a free slot);
+    ``max_len`` bounds ``prompt + max_new_tokens`` per request (default:
+    the model's ``max_seq_len``, rounded down to a ``prefill_chunk``
+    multiple).  One engine = one arena = one compiled decode step.
+    """
+
+    def __init__(self, model, params: dict, *, num_slots: int = 8,
+                 max_len: int | None = None, prefill_chunk: int = 16):
+        cfg = model.config
+        validate_decode_config(cfg, "Engine")
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        max_len = cfg.max_seq_len if max_len is None else max_len
+        if max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_len ({max_len}) exceeds the model's max_seq_len "
+                f"({cfg.max_seq_len})")
+        # Chunk writes start at multiples of prefill_chunk; a max_len that
+        # is not a multiple would let the final chunk's fixed-size write
+        # be CLAMPED backwards by dynamic_update_slice, silently
+        # clobbering earlier positions.  Round down (never up: the
+        # position table bound above must hold).
+        self.max_len = (max_len // prefill_chunk) * prefill_chunk
+        if self.max_len < prefill_chunk:
+            raise ValueError(
+                f"max_len ({max_len}) must fit at least one prefill "
+                f"chunk ({prefill_chunk})")
+        self.model = model
+        self.config = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.prefill_chunk = prefill_chunk
+
+        self._cache = KVCache.zeros(cfg, num_slots, self.max_len)
+        self._keys = jnp.zeros((num_slots, 2), jnp.uint32)
+        # Host-authoritative per-slot state, uploaded each step (tiny
+        # arrays; values are data, never shapes).
+        self._len = np.zeros(num_slots, np.int32)
+        self._last = np.zeros(num_slots, np.int32)
+        self._temps = np.zeros(num_slots, np.float32)
+        self._topk = np.zeros(num_slots, np.int32)
+        self._topp = np.ones(num_slots, np.float32)
+        self._slots: list[Request | None] = [None] * num_slots
+        self._queue: collections.deque[Request] = collections.deque()
+        self._next_id = 0
+        self._admitted = 0
+        self.stats = collections.Counter()
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int | None = None,
+               top_p: float | None = None, seed: int = 0,
+               eos_id: int | None = None) -> Request:
+        """Queue one generation request; returns its streaming handle.
+
+        Same sampling contract as ``generate()``: ``temperature=0`` is
+        greedy (``top_k``/``top_p`` rejected), otherwise softmax sampling
+        truncated to top-k and/or the top-p nucleus, seeded per request
+        (draws are independent of co-resident requests).  ``eos_id``
+        retires the request early when sampled (the eos token is
+        included in ``tokens``)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must hold at least one token")
+        vocab = self.config.vocab_size
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            raise ValueError(f"prompt ids must be in [0, {vocab})")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        total = prompt.size + max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the arena max_len "
+                f"({self.max_len})")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if (top_k is not None or top_p is not None) and temperature == 0.0:
+            raise ValueError("top_k/top_p require temperature > 0 (greedy "
+                             "decoding ignores them)")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if eos_id is not None and not 0 <= eos_id < vocab:
+            raise ValueError(f"eos_id must be in [0, {vocab})")
+        r = Request(self, self._next_id, prompt, max_new_tokens,
+                    float(temperature), int(top_k or 0),
+                    float(1.0 if top_p is None else top_p), seed, eos_id)
+        self._next_id += 1
+        self._queue.append(r)
+        self.stats["submitted"] += 1
+        return r
+
+    def generate_many(self, prompts, max_new_tokens: int, *,
+                      temperature: float = 0.0, top_k: int | None = None,
+                      top_p: float | None = None, seed: int = 0,
+                      eos_id: int | None = None) -> list[np.ndarray]:
+        """Batched convenience wrapper: submit every prompt (request i is
+        seeded ``seed + i``), run to completion, return the full
+        sequences in submission order."""
+        handles = [self.submit(p, max_new_tokens, temperature=temperature,
+                               top_k=top_k, top_p=top_p, seed=seed + i,
+                               eos_id=eos_id)
+                   for i, p in enumerate(prompts)]
+        self.run_until_complete()
+        return [np.concatenate([h.prompt, np.asarray(h.tokens, np.int32)])
+                for h in handles]
+
+    # -- scheduling ----------------------------------------------------
+
+    def step(self) -> list[tuple[Request, int]]:
+        """One scheduler iteration: admit queued requests into free
+        slots, run at most one prefill chunk (the oldest admitted request
+        still prefilling), then one batched decode step for every
+        decoding slot.  Returns the ``(request, token)`` pairs emitted."""
+        emitted: list[tuple[Request, int]] = []
+        self._admit()
+        slot = self._next_prefill_slot()
+        if slot is not None:
+            self._run_prefill_chunk(slot, emitted)
+        if any(r is not None and r._nfill == r.prompt.size
+               for r in self._slots):
+            self._run_decode(emitted)
+        self.stats["steps"] += 1
+        return emitted
+
+    def run_until_complete(self) -> None:
+        """Drive the engine until the queue and every slot are empty."""
+        while self._queue or any(r is not None for r in self._slots):
+            self.step()
+
+    @property
+    def slots_in_use(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests submitted but not yet admitted to a slot."""
+        return len(self._queue)
+
+    # -- internals -----------------------------------------------------
+
+    def _admit(self) -> None:
+        for s in range(self.num_slots):
+            if not self._queue:
+                break
+            if self._slots[s] is not None:
+                continue
+            r = self._queue.popleft()
+            r._slot = s
+            r._order = self._admitted
+            self._admitted += 1
+            self._slots[s] = r
+            self._len[s] = 0
+            self._temps[s] = r.temperature
+            self._topk[s] = r.top_k
+            self._topp[s] = r.top_p
+            self._keys = self._keys.at[s].set(jax.random.PRNGKey(r.seed))
+            self.stats["admitted"] += 1
+
+    def _next_prefill_slot(self) -> int | None:
+        pending = [(r._order, s) for s, r in enumerate(self._slots)
+                   if r is not None and r._nfill < r.prompt.size]
+        return min(pending)[1] if pending else None
+
+    def _run_prefill_chunk(self, s: int, emitted) -> None:
+        r = self._slots[s]
+        start = r._nfill
+        end = min(start + self.prefill_chunk, r.prompt.size)
+        buf = np.zeros((1, self.prefill_chunk), np.int32)
+        buf[0, :end - start] = r.prompt[start:end]
+        last_logits, self._cache = _prefill_step(
+            self.config, self.params, self._cache, np.int32(s), buf,
+            np.int32(start), np.int32(end - start - 1))
+        r._nfill = end
+        self._len[s] = end
+        self.stats["prefill_chunks"] += 1
+        if end == r.prompt.size:
+            # Prompt fully cached: the chunk's last-token logits are the
+            # request's FIRST sampling event (exactly generate()'s
+            # prefill-then-sample order).
+            tok, carry = _sample_row(
+                last_logits, self._temps[s], self._topk[s], self._topp[s],
+                self._keys[s])
+            self._keys = self._keys.at[s].set(carry)
+            self._commit(s, int(tok), emitted)
+
+    def _run_decode(self, emitted) -> None:
+        active = np.array(
+            [r is not None and r._nfill == r.prompt.size
+             for r in self._slots])
+        self._cache, toks, self._keys = _decode_step(
+            self.config, self.params, self._cache, self._last, self._len,
+            active, self._temps, self._topk, self._topp, self._keys)
+        toks = np.asarray(toks)
+        self.stats["decode_steps"] += 1
+        self.stats["active_slot_steps"] += int(active.sum())
+        for s in np.nonzero(active)[0]:
+            self._len[s] += 1  # the fed token's KV landed this step
+            self._commit(int(s), int(toks[s]), emitted)
+
+    def _commit(self, s: int, tok: int, emitted) -> None:
+        r = self._slots[s]
+        r.tokens.append(tok)
+        r.token_times.append(time.perf_counter())
+        self._last[s] = tok
+        emitted.append((r, tok))
+        self.stats["tokens"] += 1
+        if (len(r.tokens) >= r.max_new_tokens
+                or (r.eos_id is not None and tok == r.eos_id)):
+            self._retire(s)
+
+    def _retire(self, s: int) -> None:
+        r = self._slots[s]
+        r.done = True
+        r._slot = None
+        self._slots[s] = None
+        self._len[s] = 0  # slot recycled; the next prefill overwrites from 0
+        # Reset sampling params too: a stale temperature/top-k on an
+        # EMPTY slot would keep tripping the sampling op's any-sampled /
+        # any-truncated lax.cond gates, making every later all-greedy
+        # step pay the RNG + vocab-sort cost the gates exist to skip.
+        self._temps[s] = 0.0
+        self._topk[s] = 0
+        self._topp[s] = 1.0
+        self.stats["completed"] += 1
