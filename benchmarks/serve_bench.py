@@ -1,4 +1,5 @@
-"""Serving throughput/latency: continuous batching vs sequential decode.
+"""Serving throughput/latency: continuous batching vs sequential decode,
+and speculative decoding vs the plain engine.
 
 The tpudp.serve engine multiplexes many generation requests through one
 jitted fixed-shape decode step (slot KV arena + chunked prefill); this
@@ -16,21 +17,37 @@ matrix_bench) plus a final summary line:
   value                 aggregate NEW tokens/sec, first submit -> last token
   p50/p99_token_latency_ms   per-token latency (submit->first token, then
                         inter-token gaps — the streaming user experience)
+  ttft_p50/p99_ms       time to FIRST token per request (submit -> first
+                        emission: queueing + prefill + first sample)
   mean_slot_occupancy   active slots / num_slots per decode step
   speedup_vs_sequential value / the sequential generate() baseline
 
+With ``--speculate-k K1,K2`` (or SERVE_SPECULATE_K) the bench instead
+emits one ``serve_spec_tokens_per_sec`` row per k: the speculative
+engine (n-gram prompt-lookup drafting, ``tpudp.serve.speculate``) vs a
+non-speculative engine on the IDENTICAL repetitive greedy workload, at
+``SERVE_SPEC_CONCURRENCY`` (default 1 — speculation is the LOW-occupancy
+latency lever; at high occupancy the batch already amortizes the weight
+read).  The workload is the deterministic speculation ceiling (see
+``run_spec``): same forwards, same weight streaming, acceptance ~1; the
+measured acceptance_rate column is what scales the row to real
+workloads.
+
 Greedy decode, so every emitted token is bit-identical to what the
 sequential baseline produces for the same request (pinned by
-tests/test_serve.py) — the two columns measure the SAME work.
+tests/test_serve.py and tests/test_speculate.py) — all columns measure
+the SAME work.
 
 Runs on whatever device is attached; SERVE_PLATFORM=cpu pins the CPU
 smoke mode (tier-1 runs it at a trimmed geometry).  Knobs: SERVE_CONCURRENCY
 (comma-separated subset of the registered levels — the watcher's
-gap-resume path), SERVE_REQUESTS, SERVE_PROMPT_LEN, SERVE_MAX_NEW,
+gap-resume path), SERVE_SPECULATE_K (same, for the spec rows),
+SERVE_SPEC_CONCURRENCY, SERVE_REQUESTS, SERVE_PROMPT_LEN, SERVE_MAX_NEW,
 SERVE_LAYERS, SERVE_DMODEL, SERVE_VOCAB, SERVE_CHUNK, SERVE_LOAD,
 SERVE_SEED, SERVE_STRICT_LEVELS=1 (reject unregistered levels).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -38,9 +55,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tools.bench_gaps import SERVE_CONCURRENCIES  # noqa: E402 (stdlib-only)
+from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
+                              SERVE_SPEC_KS)
 
 METRIC = "serve_tokens_per_sec"
+SPEC_METRIC = "serve_spec_tokens_per_sec"
 
 
 def _percentile(xs, q):
@@ -51,7 +70,18 @@ def _percentile(xs, q):
     return xs[i]
 
 
+def _parse_levels(value):
+    return [int(x) for x in value.split(",") if x]
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--speculate-k", default=None,
+                    help="comma-separated speculation depths; emits "
+                         "speculative-vs-baseline rows instead of the "
+                         "concurrency sweep (env: SERVE_SPECULATE_K)")
+    args = ap.parse_args()
+
     import jax
 
     if os.environ.get("SERVE_PLATFORM"):
@@ -67,22 +97,35 @@ def main() -> None:
 
     from tpudp.models.generate import generate
     from tpudp.models.gpt2 import GPT2, GPT2Config
-    from tpudp.serve import Engine
+    from tpudp.serve import Engine, NgramDrafter
 
+    spec_env = args.speculate_k or os.environ.get("SERVE_SPECULATE_K")
+    spec_ks = _parse_levels(spec_env) if spec_env else []
     levels_env = os.environ.get("SERVE_CONCURRENCY")
-    levels = ([int(x) for x in levels_env.split(",") if x]
+    levels = (_parse_levels(levels_env)
               if levels_env else list(SERVE_CONCURRENCIES))
     if os.environ.get("SERVE_STRICT_LEVELS") == "1":
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
-        if bad:
+        if not spec_ks and bad:
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
+        bad_k = [k for k in spec_ks if k not in SERVE_SPEC_KS]
+        if bad_k:
+            raise SystemExit(f"error: unregistered speculate_k values "
+                             f"{bad_k} (registry: {list(SERVE_SPEC_KS)})")
     n_requests = int(os.environ.get("SERVE_REQUESTS", 24))
     prompt_len = int(os.environ.get("SERVE_PROMPT_LEN", 16))
     max_new = int(os.environ.get("SERVE_MAX_NEW", 32))
     chunk = int(os.environ.get("SERVE_CHUNK", 16))
     load = float(os.environ.get("SERVE_LOAD", 8.0))
     seed = int(os.environ.get("SERVE_SEED", 0))
+    # Speculation's home regime is LOW occupancy: at high concurrency the
+    # batch already amortizes the weight read (the two levers compete),
+    # so the spec rows default to one in-flight request — the latency
+    # story — and to longer generations, where the repetitive phase an
+    # untrained greedy LM collapses into dominates the run.
+    spec_conc = int(os.environ.get("SERVE_SPEC_CONCURRENCY", 1))
+    spec_max_new = int(os.environ.get("SERVE_SPEC_MAX_NEW", 64))
 
     # Default geometry: small GPT-2 family but with the weights (~93 MB
     # fp32) well past any cache, so the decode step is weight-STREAM
@@ -91,9 +134,12 @@ def main() -> None:
     # little; measured on the 2-core host: 17M params -> 2.8x batch-8
     # scan gain, 4M params -> 2.0x).
     dm = int(os.environ.get("SERVE_DMODEL", 512))
+    slack = max(spec_ks, default=0)  # speculative windows need k scratch
+    need = prompt_len + (max(max_new, spec_max_new) + slack
+                         if spec_ks else max_new)
     cfg = GPT2Config(
         vocab_size=int(os.environ.get("SERVE_VOCAB", 8192)),
-        max_seq_len=((prompt_len + max_new + chunk - 1) // chunk) * chunk,
+        max_seq_len=((need + chunk - 1) // chunk) * chunk,
         num_layers=int(os.environ.get("SERVE_LAYERS", 6)),
         num_heads=max(dm // 64, 1),
         d_model=dm,
@@ -107,22 +153,80 @@ def main() -> None:
     prompts = [rng.integers(0, cfg.vocab_size, size=prompt_len)
                .astype(np.int32) for _ in range(n_requests)]
 
+    def drive(engine, offsets, reqs, new_tokens):
+        """Submit ``reqs`` at ``offsets`` (seconds from start), step the
+        engine to completion; return aggregate timing."""
+        n = len(reqs)
+        start = time.perf_counter()
+        handles = []
+        nxt = 0
+        latencies = []
+        consumed = {}  # request id -> tokens already accounted
+        last_emit = start
+        while nxt < n or engine.slots_in_use or engine.queue_depth:
+            now = time.perf_counter()
+            while nxt < n and now - start >= offsets[nxt]:
+                handles.append(engine.submit(reqs[nxt], new_tokens,
+                                             seed=seed + nxt))
+                nxt += 1
+                now = time.perf_counter()
+            if engine.slots_in_use or engine.queue_depth:
+                for req, _tok in engine.step():
+                    # Index per request, not [-1]/[-2]: a speculative
+                    # window lands several tokens at once, and each
+                    # pair must charge ITS token's gap (first token of
+                    # a window carries the inter-window forward time,
+                    # the rest of the burst ~0 — the client-visible
+                    # streaming distribution).
+                    j = consumed.get(req.id, 0)
+                    consumed[req.id] = j + 1
+                    t = req.token_times[j]
+                    prev = (req.token_times[j - 1] if j
+                            else req.submit_time)
+                    latencies.append(t - prev)
+                    last_emit = max(last_emit, t)
+            elif nxt < n:
+                time.sleep(min(0.001, max(offsets[nxt] - (now - start), 0)))
+        elapsed = last_emit - start
+        ttfts = [h.token_times[0] - h.submit_time for h in handles
+                 if h.token_times]
+        return elapsed, latencies, ttfts
+
+    def latency_fields(latencies, ttfts):
+        return {
+            "p50_token_latency_ms": round(
+                _percentile(latencies, 50) * 1e3, 3),
+            "p99_token_latency_ms": round(
+                _percentile(latencies, 99) * 1e3, 3),
+            "ttft_p50_ms": round(_percentile(ttfts, 50) * 1e3, 3),
+            "ttft_p99_ms": round(_percentile(ttfts, 99) * 1e3, 3),
+        }
+
+    results = []
+
+    def emit(row):
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
     # ---- sequential generate() baseline (one request at a time) --------
     # Warmup compiles the prefill+decode program; every request shares the
     # (prompt_len, max_new) geometry, so the timed loop never recompiles.
-    np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
-                        max_new))
-    t0 = time.perf_counter()
+    # Skipped in spec mode: its rows compare against a PLAIN ENGINE at
+    # the same concurrency instead (the honest baseline for speculation).
+    seq_tps = per_req_s = None
     seq_latencies = []
-    for p in prompts:
-        r0 = time.perf_counter()
-        np.asarray(generate(model, params, jnp.asarray(p[None]), max_new))
-        seq_latencies.append(time.perf_counter() - r0)
-    seq_elapsed = time.perf_counter() - t0
-    seq_tps = n_requests * max_new / seq_elapsed
-    per_req_s = seq_elapsed / n_requests
-
-    results = []
+    if not spec_ks:
+        np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
+                            max_new))
+        t0 = time.perf_counter()
+        for p in prompts:
+            r0 = time.perf_counter()
+            np.asarray(generate(model, params, jnp.asarray(p[None]),
+                                max_new))
+            seq_latencies.append(time.perf_counter() - r0)
+        seq_elapsed = time.perf_counter() - t0
+        seq_tps = n_requests * max_new / seq_elapsed
+        per_req_s = seq_elapsed / n_requests
 
     def run_level(c: int) -> None:
         engine = Engine(model, params, num_slots=c,
@@ -139,34 +243,13 @@ def main() -> None:
         gaps = arrival_rng.exponential(1.0 / lam, size=n_requests)
         offsets = np.cumsum(gaps) - gaps[0]  # first request at t=0
 
-        start = time.perf_counter()
-        handles = []
-        nxt = 0
-        latencies = []
-        last_emit = start
-        while nxt < n_requests or engine.slots_in_use or engine.queue_depth:
-            now = time.perf_counter()
-            while nxt < n_requests and now - start >= offsets[nxt]:
-                handles.append(engine.submit(prompts[nxt], max_new,
-                                             seed=seed + nxt))
-                nxt += 1
-                now = time.perf_counter()
-            if engine.slots_in_use or engine.queue_depth:
-                for req, _tok in engine.step():
-                    t = req.token_times[-1]
-                    prev = (req.token_times[-2] if len(req.token_times) > 1
-                            else req.submit_time)
-                    latencies.append(t - prev)
-                    last_emit = t
-            elif nxt < n_requests:
-                time.sleep(min(0.001, max(offsets[nxt] - (now - start), 0)))
-        elapsed = last_emit - start
+        elapsed, latencies, ttfts = drive(engine, offsets, prompts, max_new)
         tps = n_requests * max_new / elapsed if elapsed > 0 else 0.0
         dec = engine.stats["decode_steps"] - base_stats.get("decode_steps", 0)
         act = (engine.stats["active_slot_steps"]
                - base_stats.get("active_slot_steps", 0))
         occupancy = act / (dec * c) if dec else None
-        row = {
+        emit({
             "metric": METRIC,
             "concurrency": c,
             "value": round(tps, 1),
@@ -174,10 +257,7 @@ def main() -> None:
             "sequential_tokens_per_sec": round(seq_tps, 1),
             "speedup_vs_sequential": round(tps / seq_tps, 2) if seq_tps
             else None,
-            "p50_token_latency_ms": round(
-                _percentile(latencies, 50) * 1e3, 3),
-            "p99_token_latency_ms": round(
-                _percentile(latencies, 99) * 1e3, 3),
+            **latency_fields(latencies, ttfts),
             "seq_p50_request_latency_ms": round(
                 _percentile(seq_latencies, 50) * 1e3, 1),
             "mean_slot_occupancy": (round(occupancy, 3)
@@ -191,21 +271,104 @@ def main() -> None:
             "d_model": cfg.d_model,
             "vocab_size": cfg.vocab_size,
             "device_kind": kind,
-        }
-        results.append(row)
-        print(json.dumps(row), flush=True)
+        })
 
+    def run_spec(k: int) -> None:
+        """Speculative vs plain engine, identical repetitive greedy
+        workload (all requests at t=0; the column measures decode
+        mechanics, not arrival luck).
+
+        The workload is the speculation CEILING, made deterministic:
+        both engines decode the same zero-scaled weight tree, whose
+        greedy output is provably constant — every forward streams the
+        same 93 MB of weights through the same gemms (cost identical to
+        real weights; only the VALUES are zero), and the n-gram drafter
+        locks on after two tokens, so acceptance ~1 and the speedup is
+        the engine's mechanical best case, not prompt luck.  A real
+        workload interpolates between the baseline and this row by its
+        own acceptance rate — which is why acceptance_rate is a
+        first-class column.  (Random-init weights loop too, but WHICH
+        loop each prompt falls into swings acceptance 0.3-0.7 between
+        seeds — a regression gate can't sit on that.)"""
+        spec_rng = np.random.default_rng(seed + 2)
+        spec_prompts = [
+            np.tile(spec_rng.integers(0, cfg.vocab_size, size=4),
+                    (prompt_len + 3) // 4)[:prompt_len].astype(np.int32)
+            for _ in range(n_requests)]
+        offsets = np.zeros(n_requests)
+        warm = np.tile(spec_rng.integers(0, cfg.vocab_size, size=2),
+                       chunk // 2 + 1)[:chunk].astype(np.int32)
+
+        plain = Engine(model, zero_params, num_slots=spec_conc,
+                       max_len=cfg.max_seq_len, prefill_chunk=chunk)
+        plain.generate_many([warm], 2)  # warmup: prefill+decode programs
+        base_elapsed, _base_lat, base_ttft = drive(
+            plain, offsets, spec_prompts, spec_max_new)
+        base_tps = (n_requests * spec_max_new / base_elapsed
+                    if base_elapsed > 0 else 0.0)
+
+        # min_ngram=2: a single-token match is mostly noise, and every
+        # wrong proposal costs a full-width verify forward.
+        engine = Engine(model, zero_params, num_slots=spec_conc,
+                        max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                        speculate_k=k,
+                        drafter=NgramDrafter(max_ngram=3, min_ngram=2))
+        # Repetitive warmup prompt: guarantees drafted steps, so the
+        # VERIFY program compiles off the clock too.
+        engine.generate_many([warm], 8)
+        elapsed, latencies, ttfts = drive(
+            engine, offsets, spec_prompts, spec_max_new)
+        tps = (n_requests * spec_max_new / elapsed if elapsed > 0 else 0.0)
+        emit({
+            "metric": SPEC_METRIC,
+            "speculate_k": k,
+            "concurrency": spec_conc,
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            "drafter": "ngram(max=3,min=2)",
+            "acceptance_rate": (round(engine.acceptance_rate, 3)
+                                if engine.acceptance_rate is not None
+                                else None),
+            "verify_steps": engine.stats["verify_steps"],
+            "draft_tokens": engine.stats["draft_tokens"],
+            "baseline_tokens_per_sec": round(base_tps, 1),
+            "speedup_vs_baseline": (round(tps / base_tps, 2)
+                                    if base_tps else None),
+            "baseline_ttft_p50_ms": round(
+                _percentile(base_ttft, 50) * 1e3, 3),
+            **latency_fields(latencies, ttfts),
+            "workload": "repetitive-ceiling",
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "max_new_tokens": spec_max_new,
+            "prefill_chunk": chunk,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        })
+
+    # One level crashing (OOM, transient backend fault) must not cost
+    # the remaining rows — same isolation contract as matrix_bench.
+    if spec_ks:
+        # One zero tree for the whole sweep: a fresh tree per k would
+        # miss the engine's (cfg, params-identity) program cache and
+        # re-freeze/re-compile identical decode/prefill programs.
+        zero_params = jax.tree_util.tree_map(lambda x: x * 0, params)
+        for k in spec_ks:
+            try:
+                run_spec(k)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": SPEC_METRIC, "speculate_k": k,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        print(json.dumps({"serve_spec": results}))
+        return
     for c in levels:
-        # One level crashing (OOM, transient backend fault) must not cost
-        # the remaining rows — same isolation contract as matrix_bench.
         try:
             run_level(c)
         except Exception as exc:  # noqa: BLE001
-            row = {"metric": METRIC, "concurrency": c,
-                   "error": f"{type(exc).__name__}: {exc}"[:500]}
-            results.append(row)
-            print(json.dumps(row), flush=True)
-
+            emit({"metric": METRIC, "concurrency": c,
+                  "error": f"{type(exc).__name__}: {exc}"[:500]})
     print(json.dumps({"serve": results}))
 
 

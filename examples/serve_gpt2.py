@@ -12,6 +12,10 @@ this demo is about throughput and interleaving, not different text.
   python examples/serve_gpt2.py --layers 2 --d-model 64 --vocab 256 \
       --seq-len 128 --requests 6 --num-slots 3 --platform cpu
 
+  # Speculative decoding: n-gram prompt-lookup drafting, up to N+1
+  # tokens per forward, outputs bit-identical (greedy) either way:
+  python examples/serve_gpt2.py --speculate-k 4 --platform cpu
+
   # Restore a train_gpt2.py checkpoint (params-only, like generate_gpt2):
   python examples/serve_gpt2.py --checkpoint-dir ckpt --layers 4 ...
 
@@ -50,6 +54,11 @@ def main() -> None:
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy; >0 samples (per-request seeds)")
+    p.add_argument("--speculate-k", type=int, default=0,
+                   help="speculative decoding: draft up to K tokens per "
+                        "step via n-gram prompt lookup and verify them "
+                        "in one forward (0 = off; output is identical "
+                        "either way for greedy decoding)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
@@ -59,6 +68,9 @@ def main() -> None:
                          f"{args.temperature})")
     if args.requests < 1:
         raise SystemExit("error: --requests must be >= 1")
+    if args.speculate_k < 0:
+        raise SystemExit(f"error: --speculate-k must be >= 0 (got "
+                         f"{args.speculate_k})")
 
     if args.platform:
         import jax
@@ -111,7 +123,8 @@ def main() -> None:
     # generate_gpt2.py --concurrent).
     engine = Engine(model, params, num_slots=args.num_slots,
                     prefill_chunk=math.gcd(args.prefill_chunk,
-                                           args.seq_len))
+                                           args.seq_len),
+                    speculate_k=args.speculate_k)
 
     # Mixed-length prompts from the training examples' deterministic
     # corpus draw (same generator family as train_gpt2.py).
@@ -138,13 +151,21 @@ def main() -> None:
         print(f"[serve] request {i} (prompt {h.prompt.size} toks): "
               f"{h.tokens}")
     total = sum(len(h.tokens) for h in handles)
+    batched_steps = (engine.stats["decode_steps"]
+                     + engine.stats["verify_steps"])
     occ = (engine.stats["active_slot_steps"]
-           / max(engine.stats["decode_steps"] * args.num_slots, 1))
+           / max(batched_steps * args.num_slots, 1))
+    spec = ""
+    if args.speculate_k:
+        rate = engine.acceptance_rate
+        spec = (f" | verify steps={engine.stats['verify_steps']} "
+                f"draft acceptance="
+                f"{'n/a' if rate is None else f'{rate:.2f}'}")
     print(f"[serve] {args.requests} requests, {total} tokens in {dt:.3f}s "
           f"({total / dt:.1f} tokens/sec incl. compile) | "
           f"decode steps={engine.stats['decode_steps']} "
           f"prefill chunks={engine.stats['prefill_chunks']} "
-          f"slot occupancy={occ:.2f}")
+          f"slot occupancy={occ:.2f}{spec}")
 
 
 if __name__ == "__main__":

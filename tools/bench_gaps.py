@@ -14,6 +14,9 @@ the missing work as arguments the benches accept:
                                            (ablations still unmeasured)
     python tools/bench_gaps.py serve    -> comma-separated concurrency
                                            levels (serving rows missing)
+    python tools/bench_gaps.py serve_spec -> comma-separated speculate_k
+                                           values (speculative-serving
+                                           rows missing)
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -34,6 +37,10 @@ FLASH_TS = (4096, 8192, 16384)
 # MATRIX_CONFIGS (a level added on one side but not the other would
 # silently never be measured).
 SERVE_CONCURRENCIES = (1, 4, 8)
+# Speculation depths the speculative-serving rows (serve_bench.py
+# --speculate-k, n-gram drafting vs the non-speculative baseline) must
+# measure on the TPU; same registry contract.
+SERVE_SPEC_KS = (2, 4, 8)
 
 
 def history_path(path: str) -> str:
@@ -128,6 +135,21 @@ def serve_missing(d: str) -> list[int]:
                 and "TPU" in str(r.get("device_kind", ""))):
             done.add(r["concurrency"])
     return [c for c in SERVE_CONCURRENCIES if c not in done]
+
+
+def serve_spec_missing(d: str) -> list[int]:
+    """Speculation depths still lacking a real TPU measurement (CPU
+    smoke and error rows never close a level — same rules as
+    serve_missing).  Comma-ready for SERVE_SPECULATE_K so a window
+    resumes the sweep mid-way."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_spec.jsonl")):
+        if (r.get("metric") == "serve_spec_tokens_per_sec"
+                and r.get("speculate_k") in SERVE_SPEC_KS
+                and measured(r)
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["speculate_k"])
+    return [k for k in SERVE_SPEC_KS if k not in done]
 
 
 def epoch_missing(d: str) -> bool:
@@ -230,7 +252,8 @@ def collective_missing(d: str) -> bool:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
-                                     "collective", "lever", "serve"])
+                                     "collective", "lever", "serve",
+                                     "serve_spec"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -241,6 +264,9 @@ def main() -> None:
         print(",".join(mfu_missing(args.dir)), end="")
     elif args.stage == "serve":
         print(",".join(str(c) for c in serve_missing(args.dir)), end="")
+    elif args.stage == "serve_spec":
+        print(",".join(str(k) for k in serve_spec_missing(args.dir)),
+              end="")
     elif args.stage == "collective":
         print("collective" if collective_missing(args.dir) else "", end="")
     elif args.stage == "lever":

@@ -191,6 +191,23 @@ def main() -> None:
                   f"{r.get('p99_token_latency_ms')} ms, occupancy "
                   f"{r.get('mean_slot_occupancy')}) | `serve_bench.py` | |")
 
+    spec = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_spec.jsonl"))
+         if "speculate_k" in r and "serve_spec" not in r), "speculate_k")
+    for r in sorted(spec.values(), key=lambda r: r.get("speculate_k", 0)):
+        if not measured(r):
+            print(f"| serve_spec k={r.get('speculate_k')} | ERROR: "
+                  f"{r.get('error', 'no real measurement')[:120]} | "
+                  f"`serve_bench.py --speculate-k` | |")
+        else:
+            print(f"| speculative serving k={r['speculate_k']} "
+                  f"(ceiling workload, c={r.get('concurrency')}) | "
+                  f"**{r['value']:,} tokens/sec** "
+                  f"({r.get('speedup_vs_baseline')}x the non-speculative "
+                  f"engine, acceptance {r.get('acceptance_rate')}, TTFT "
+                  f"p50 {r.get('ttft_p50_ms')} ms) | "
+                  f"`serve_bench.py --speculate-k` | |")
+
     flash = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
          if "t" in r), "t")

@@ -87,6 +87,10 @@ serve_ok() {
   local out; out=$(python tools/bench_gaps.py serve) || return 1
   [ -z "$out" ]
 }
+serve_spec_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_spec) || return 1
+  [ -z "$out" ]
+}
 mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
@@ -322,6 +326,19 @@ while true; do
         > bench_results/serve.jsonl 2> bench_results/serve.err
       log "serve_bench rc=$? -> bench_results/serve.jsonl"
     fi
+    if serve_spec_ok; then
+      log "serve_spec.jsonl already good; skipping speculative serve bench"
+    else
+      # Speculative decoding vs the plain engine (n-gram drafting,
+      # tpudp.serve.speculate) — resumes at speculate_k granularity via
+      # bench_gaps, like the serve stage.
+      bank bench_results/serve_spec.jsonl
+      ensure_window
+      SERVE_SPECULATE_K="$(python tools/bench_gaps.py serve_spec)" \
+        timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
+        > bench_results/serve_spec.jsonl 2> bench_results/serve_spec.err
+      log "serve_spec_bench rc=$? -> bench_results/serve_spec.jsonl"
+    fi
     if flash_ok; then
       log "flash.jsonl already good; skipping flash bench"
     else
@@ -350,7 +367,7 @@ while true; do
     # waiting for the next window (a stage that died on a healthy relay —
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
-        && lever_ok && collective_ok && serve_ok; then
+        && lever_ok && collective_ok && serve_ok && serve_spec_ok; then
       log "battery done"
       exit 0
     fi

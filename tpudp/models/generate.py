@@ -92,7 +92,14 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     beam_search, where every row is at the same depth) or a ``(batch,)``
     vector of per-row positions (the serve engine's slot arena).  The
     scalar path compiles to exactly the program it always did; the vector
-    path scatters each row's KV at its own depth and masks per row.
+    path scatters each row's KV at its own depth and masks per row, and
+    runs attention PER WINDOW POSITION (a vmap over ``cur``): XLA lowers
+    a width-1 and a width-W contraction to different gemv/gemm reduction
+    blockings, so the batched einsum is bitwise-stable only across equal
+    widths — the vmapped form makes a speculative k+1-token verify
+    window bit-identical to feeding one token at a time (the engine's
+    exact-greedy-parity contract), while the weight matmuls (the decode
+    bottleneck) stay batched over the window.
 
     Mirrors tpudp.models.gpt2.Block exactly (the parity test referee);
     attention spans the cache up to ``pos`` plus a causal mask within the
@@ -120,22 +127,31 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     # path (einsum in cfg.dtype, fp32 softmax) — in bf16, rounding QK^T
     # differently would break exact argmax parity with the training model.
     scale = dh ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
-    # Key j visible to new-token query i iff j <= pos + i.
     if pos.ndim:
+        # Key j visible to new-token query i iff j <= pos + i, per row.
+        # One attention per window position (see docstring): each slice
+        # is exactly the 1-token step's contraction, so a k+1 verify
+        # window is bit-identical to k+1 single-token decodes.
         q_pos = pos[:, None] + jnp.arange(cur)  # (b, cur)
-        visible = (jnp.arange(max_len)[None, None, :]
-                   <= q_pos[:, :, None])  # (b, cur, max_len)
-        logits = jnp.where(visible[:, None], logits,
-                           jnp.finfo(logits.dtype).min)
+
+        def _attend(qj, pj):  # qj (b, h, dh), pj (b,)
+            lg = jnp.einsum("bhd,bkhd->bhk", qj, k_cache) * scale
+            vis = jnp.arange(max_len)[None, None, :] <= pj[:, None, None]
+            lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
+            pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                axis=-1).astype(cfg.dtype)
+            return jnp.einsum("bhk,bkhd->bhd", pr, v_cache)
+
+        out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(q, q_pos)
     else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
         q_pos = pos + jnp.arange(cur)[:, None]
         visible = jnp.arange(max_len)[None, :] <= q_pos  # (cur, max_len)
         logits = jnp.where(visible[None, None], logits,
                            jnp.finfo(logits.dtype).min)
-    probs = jax.nn.softmax(logits.astype(jnp.float32),
-                           axis=-1).astype(cfg.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
     x = x + _dense(p["attn"]["proj"], out.reshape(b, cur, d), cfg.dtype)
 
     hN = _layer_norm(p["ln_2"], x, cfg.ln_eps)
@@ -258,23 +274,32 @@ def generate(
 def _truncate_logits(logits, top_k, top_p):
     """Mask logits outside the top-k set / the top-p nucleus to -inf.
     The nucleus always includes the highest-probability token even when
-    ``top_p`` is smaller than its probability.  top_k uses lax.top_k (no
-    full vocab sort); the top-p nucleus reuses one descending sort."""
-    if top_k is not None and top_k < logits.shape[-1]:
+    ``top_p`` is smaller than its probability.
+
+    Thin static wrapper over ``tpudp.ops.sampling.truncate_logits`` —
+    the ONE truncation implementation, shared with the serve engine's
+    per-row sampling and the speculative verify op, so the static and
+    traced paths cannot drift (a parity test pins them bitwise).
+    ``None`` statics broadcast to the op's disabled sentinels (k=0,
+    p=1); fully disabled truncation skips the call (and its vocab
+    sorts) entirely, and a top-k-only static keeps ``lax.top_k``'s
+    partial selection instead of paying the traced op's full-vocab
+    sorts — the mask rule (``>= kth``, ties kept) is the shared op's,
+    and the parity test asserts the shortcut bitwise-equal to it.
+    """
+    if top_k is None and top_p is None:
+        return logits
+    if top_p is None:
+        if top_k >= logits.shape[-1]:
+            return logits
         kth = lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits >= kth, logits, -jnp.inf)
-    if top_p is not None and top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # Keep ranks whose PRECEDING cumulative mass is < top_p (so the
-        # first token is always kept); find the worst kept logit.
-        keep = jnp.concatenate(
-            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1) < top_p
-        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
-                         axis=-1, keepdims=True)
-        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
-    return logits
+        return jnp.where(logits >= kth, logits, -jnp.inf)
+    from tpudp.ops.sampling import truncate_logits
+
+    lead = logits.shape[:-1]
+    k_arr = jnp.full(lead, 0 if top_k is None else top_k, jnp.int32)
+    p_arr = jnp.full(lead, 1.0 if top_p is None else top_p, jnp.float32)
+    return truncate_logits(logits, k_arr, p_arr)
 
 
 # Module-level jit keyed on (cfg, shapes, statics): repeated generate()

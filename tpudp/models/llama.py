@@ -247,14 +247,31 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     # the per-pair dot products are identical to the repeat formulation.
     g = h // kv
     qg = q.reshape(b, cur, kv, g, dh)
-    logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg, k_cache) * dh ** -0.5
-    # (cur, max_len) shared mask, or (b, cur, max_len) per-row.
-    visible = jnp.arange(max_len) <= positions[..., None]
-    vis_b = visible[:, None, None] if per_row else visible[None, None, None]
-    logits = jnp.where(vis_b, logits, jnp.finfo(logits.dtype).min)
-    probs = jax.nn.softmax(logits.astype(jnp.float32),
-                           axis=-1).astype(cfg.dtype)
-    out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
+    scale = dh ** -0.5
+    if per_row:
+        # One attention per window position (same rationale as the GPT-2
+        # twin): XLA's width-1 and width-W contractions reduce in
+        # different blockings, so only the vmapped per-position form
+        # keeps a speculative k+1-token verify window bit-identical to
+        # k+1 single-token decodes (tpudp.serve's exact-parity contract).
+        def _attend(qj, pj):  # qj (b, kv, g, dh), pj (b,)
+            lg = jnp.einsum("bkgd,bmkd->bkgm", qj, k_cache) * scale
+            vis = jnp.arange(max_len)[None, None, None, :] \
+                <= pj[:, None, None, None]
+            lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
+            pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                axis=-1).astype(cfg.dtype)
+            return jnp.einsum("bkgm,bmkd->bkgd", pr, v_cache)
+
+        out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(qg, positions)
+    else:
+        logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg, k_cache) * scale
+        visible = jnp.arange(max_len) <= positions[..., None]
+        logits = jnp.where(visible[None, None, None], logits,
+                           jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
     x = x + _dense_nb(attn["wo"], out.reshape(b, cur, d), cfg.dtype)
 
     hN = _rms(p["rms_mlp"], x, cfg.rms_eps)

@@ -1,28 +1,37 @@
-"""Per-row masked token sampling — the serve engine's sampling op.
+"""Per-row masked token sampling + speculative-window verification — the
+serve engine's sampling ops.
 
 ``tpudp.models.generate._truncate_logits`` bakes one ``(temperature,
 top_k, top_p)`` combination into the compiled program as Python statics —
 right for ``generate()``, where the whole batch shares one request's
 params.  A continuous-batching engine multiplexes requests with
 DIFFERENT sampling params through one fixed-shape decode step, so here
-they are TRACED ``(n,)`` arrays: admitting a request with a new
-temperature or top-k must never recompile the step (the static-shape
-invariant of tpudp.serve).
+they are TRACED arrays: admitting a request with a new temperature or
+top-k must never recompile the step (the static-shape invariant of
+tpudp.serve).
 
-Per-row semantics match the static op row-wise:
+:func:`truncate_logits` is the ONE implementation of top-k/top-p
+truncation — ``generate()``'s static wrapper broadcasts its Python ints
+into arrays and calls it, so the static and per-row paths cannot drift
+(a parity test pins them).  Row-wise semantics:
 
   * ``temperature[i] == 0``  -> greedy argmax (top_k/top_p ignored);
   * ``top_k[i] == 0``        -> top-k disabled (keep the whole vocab);
   * ``top_p[i] == 1``        -> nucleus disabled;
   * the nucleus always keeps the highest-probability token, and
-    truncation applies AFTER temperature scaling — both exactly like
-    ``_truncate_logits``.
+    truncation applies AFTER temperature scaling.
 
 The dynamic top-k cannot use ``lax.top_k`` (its k is a static shape
 parameter), so it is a rank mask off a descending sort of the vocab
-axis; the nucleus then runs the static op's prefix-mass scan over the
-top-k-MASKED distribution (the same composition order as
-``_truncate_logits``: k-truncate, renormalize, then p-truncate).
+axis; the nucleus then runs the prefix-mass scan over the top-k-MASKED
+distribution (k-truncate, renormalize, then p-truncate).
+
+:func:`verify_tokens` is the speculative-decoding acceptance rule over a
+``k+1``-token window (tpudp.serve.speculate): greedy rows accept the
+longest draft prefix matching the target argmax — bit-identical to
+non-speculative decode — and sampled rows run standard rejection
+sampling against the truncated target distribution, which preserves the
+per-token output distribution exactly for deterministic drafters.
 """
 
 from __future__ import annotations
@@ -30,6 +39,44 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def truncate_logits(scaled: jnp.ndarray, top_k: jnp.ndarray,
+                    top_p: jnp.ndarray) -> jnp.ndarray:
+    """Mask ``scaled`` ``(..., vocab)`` outside the per-row top-k set /
+    top-p nucleus to -inf.  ``top_k``/``top_p`` are traced arrays shaped
+    like the leading dims (``top_k <= 0`` / ``top_p >= 1`` disable that
+    truncation for the row).
+
+    Top-k FIRST, then the nucleus over the top-k-RENORMALIZED
+    distribution.  One descending sort serves the k rank mask; the
+    k-masked -infs sink to the tail of the second sort and contribute
+    exactly 0 nucleus mass.  ``scaled >= kth`` keeps ties at the k-th
+    value, and only a sort of the tie-inclusive mask reproduces the
+    nucleus mass over that exact token set, which is why the masked
+    array is re-sorted rather than rank-masked.
+    """
+    v = scaled.shape[-1]
+    sorted_scaled = jnp.sort(scaled, axis=-1)[..., ::-1]
+
+    # Dynamic top-k: keep rows' logits >= their k-th largest value.
+    kth_idx = jnp.clip(top_k[..., None] - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_scaled, kth_idx, axis=-1)
+    keep_k = (top_k[..., None] <= 0) | (scaled >= kth)
+    masked_k = jnp.where(keep_k, scaled, -jnp.inf)
+
+    # Nucleus: keep ranks whose PRECEDING cumulative mass is < top_p (so
+    # the argmax is always kept); cutoff = worst kept sorted logit.
+    sorted_k = jnp.sort(masked_k, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_k, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    preceding = jnp.concatenate(
+        [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1)
+    in_nucleus = preceding < top_p[..., None]
+    cutoff = jnp.min(jnp.where(in_nucleus, sorted_k, jnp.inf),
+                     axis=-1, keepdims=True)
+    keep_p = (top_p[..., None] >= 1.0) | (masked_k >= cutoff)
+    return jnp.where(keep_p, masked_k, -jnp.inf)
 
 
 def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
@@ -46,7 +93,6 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
     Returns ``(n,)`` int32 token ids.  All params are traced values —
     any combination runs through one compiled program.
     """
-    n, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # Scale first (like generate(): logits/T, THEN truncate).  Greedy rows
@@ -54,47 +100,15 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
 
-    def _truncate(scaled):
-        # Top-k FIRST, then the nucleus over the top-k-RENORMALIZED
-        # distribution — the same composition order as _truncate_logits
-        # (which masks to -inf before the nucleus softmax), so the two
-        # ops keep identical token sets.  One descending sort serves
-        # both: the k-masked -infs sink to the tail and contribute
-        # exactly 0 nucleus mass.
-        sorted_scaled = jnp.sort(scaled, axis=-1)[..., ::-1]
-
-        # Dynamic top-k: keep rows' logits >= their k-th largest value.
-        kth_idx = jnp.clip(top_k[:, None] - 1, 0, v - 1)
-        kth = jnp.take_along_axis(sorted_scaled, kth_idx, axis=-1)
-        keep_k = (top_k[:, None] <= 0) | (scaled >= kth)
-        masked_k = jnp.where(keep_k, scaled, -jnp.inf)
-
-        # Nucleus: keep ranks whose PRECEDING cumulative mass is < top_p
-        # (so the argmax is always kept); cutoff = worst kept sorted
-        # logit.  sorted_k re-sorts the MASKED array rather than rank-
-        # masking sorted_scaled: `scaled >= kth` keeps ties at the k-th
-        # value just like _truncate_logits, and only a sort of the
-        # tie-inclusive mask reproduces its nucleus mass exactly.  Both
-        # sorts sit behind the any_trunc cond — untruncated steps pay
-        # neither.
-        sorted_k = jnp.sort(masked_k, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_k, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        preceding = jnp.concatenate(
-            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], -1)
-        in_nucleus = preceding < top_p[:, None]
-        cutoff = jnp.min(jnp.where(in_nucleus, sorted_k, jnp.inf),
-                         axis=-1, keepdims=True)
-        keep_p = (top_p[:, None] >= 1.0) | (masked_k >= cutoff)
-        return jnp.where(keep_p, masked_k, -jnp.inf)
-
     def _with_sampling(scaled):
-        # The vocab sort is the expensive piece (XLA CPU sorts are slow,
-        # and even on TPU it is pure overhead for untruncated rows), so
-        # it runs only when some sampled row actually truncates.
+        # The vocab sorts are the expensive piece (XLA CPU sorts are
+        # slow, and even on TPU they are pure overhead for untruncated
+        # rows), so they run only when some sampled row truncates.
         any_trunc = jnp.any((temperature > 0)
                             & ((top_k > 0) | (top_p < 1.0)))
-        masked = lax.cond(any_trunc, _truncate, lambda s: s, scaled)
+        masked = lax.cond(any_trunc,
+                          lambda s: truncate_logits(s, top_k, top_p),
+                          lambda s: s, scaled)
         sampled = jax.vmap(
             lambda key, row: jax.random.categorical(key, row))(keys, masked)
         return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)
@@ -105,12 +119,111 @@ def sample_tokens(logits: jnp.ndarray, temperature: jnp.ndarray,
                     lambda scaled: greedy, scaled)
 
 
+def verify_tokens(logits: jnp.ndarray, draft: jnp.ndarray,
+                  n_draft: jnp.ndarray, temperature: jnp.ndarray,
+                  top_k: jnp.ndarray, top_p: jnp.ndarray,
+                  keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Accept/reject a speculative window per row; emit its tokens.
+
+    ``logits`` ``(n, W, vocab)`` fp32 are the target model's logits for a
+    window of ``W = k+1`` fed tokens ``[last, d_0 .. d_{k-1}]``, so window
+    slot ``j`` predicts the token AFTER draft ``j``'s position.  ``draft``
+    ``(n, k)`` int32 holds the proposed tokens, ``n_draft`` ``(n,)`` how
+    many are real for the row (0 = plain decode: the row just emits one
+    token from slot 0).  Sampling params are per-row like
+    :func:`sample_tokens`; ``keys`` ``(n, 2)`` are THIS window's subkeys
+    (the caller owns the carry chain, advancing it once per verify step).
+
+    Returns ``(tokens (n, W) int32, n_emitted (n,) int32)`` — the row's
+    emitted tokens are ``tokens[:n_emitted]``; ``n_emitted - 1`` of the
+    drafts were accepted and the final token is the free correction/bonus
+    token from the rejecting (or last) window slot.
+
+    Greedy rows accept the longest draft prefix equal to the target
+    argmax and emit the argmax tokens themselves — bit-identical to
+    feeding one token at a time.  Sampled rows use standard speculative
+    rejection sampling with the drafter as a DETERMINISTIC (point-mass)
+    proposal: accept ``d_j`` with probability ``p_j(d_j)``; on rejection
+    resample from ``p_j`` with ``d_j`` masked out (the renormalized
+    residual ``max(p - q, 0)``), which preserves the per-token target
+    distribution exactly.  A draft outside the row's truncation set has
+    ``p = 0`` and is always rejected.
+    """
+    n, W, v = logits.shape
+    k = W - 1
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (n, W)
+    draft_w = jnp.concatenate(
+        [draft, jnp.zeros((n, 1), jnp.int32)], axis=1)       # (n, W)
+    jidx = jnp.arange(k)[None, :]
+
+    def _finish(accept):
+        """Longest accepted prefix -> (a, out-template); the final token
+        is filled in by the caller branch."""
+        ok = accept & (jidx < n_draft[:, None])
+        a = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+        return a
+
+    def _emit(a, final):
+        out = jnp.where(jnp.arange(W)[None, :] < a[:, None], draft_w,
+                        final[:, None])
+        return out.astype(jnp.int32), (a + 1).astype(jnp.int32)
+
+    def _all_greedy(_):
+        a = _finish(draft == targets[:, :k])
+        final = jnp.take_along_axis(targets, a[:, None], axis=1)[:, 0]
+        return _emit(a, final)
+
+    def _with_sampling(_):
+        safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None, None]
+        scaled = logits / safe_t
+        # Same truncation gate as sample_tokens: the window-shaped vocab
+        # sorts run only when some sampled row actually truncates.
+        any_trunc = jnp.any((temperature > 0)
+                            & ((top_k > 0) | (top_p < 1.0)))
+        kw = jnp.broadcast_to(top_k[:, None], (n, W))
+        pw = jnp.broadcast_to(top_p[:, None], (n, W))
+        masked = lax.cond(any_trunc,
+                          lambda s: truncate_logits(s, kw, pw),
+                          lambda s: s, scaled)
+        probs = jax.nn.softmax(masked, axis=-1)
+        p_draft = jnp.take_along_axis(
+            probs[:, :k], draft[..., None], axis=-1)[..., 0]  # (n, k)
+        # Acceptance uniforms come from the row subkey's split children;
+        # they only influence rows that actually drafted (j < n_draft).
+        subs = jax.vmap(lambda key: jax.random.split(key, max(k, 1)))(keys)
+        u = jax.vmap(jax.vmap(jax.random.uniform))(subs[:, :k])
+        accept = jnp.where(temperature[:, None] > 0, u < p_draft,
+                           draft == targets[:, :k])
+        a = _finish(accept)
+        # Correction (rejection at slot a: resample with the rejected
+        # draft masked out) or bonus (all accepted: slot n_draft as-is).
+        row = jnp.take_along_axis(masked, a[:, None, None], axis=1)[:, 0]
+        d_a = jnp.take_along_axis(draft_w, a[:, None], axis=1)[:, 0]
+        rejected = a < n_draft
+        corr = jnp.where(rejected[:, None]
+                         & (jnp.arange(v)[None, :] == d_a[:, None]),
+                         -jnp.inf, row)
+        # The final draw uses the row's window subkey ITSELF — the exact
+        # key sample_tokens would use in the decode step — so a row with
+        # no drafts samples bit-identically whether the scheduler
+        # dispatched a verify or a decode program this step (a request's
+        # draw stream must never depend on co-residents' drafting).
+        drawn = jax.vmap(jax.random.categorical)(keys, corr)
+        final = jnp.where(temperature > 0, drawn.astype(jnp.int32),
+                          jnp.take_along_axis(targets, a[:, None],
+                                              axis=1)[:, 0])
+        return _emit(a, final)
+
+    return lax.cond(jnp.any(temperature > 0), _with_sampling, _all_greedy,
+                    None)
+
+
 def split_keys(keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Split ``(n, 2)`` uint32 keys row-wise into (carry, subkey) pairs.
 
-    The serve decode step draws with the subkeys and commits the carries
-    only for rows that actually sampled this step, so a request's key
-    chain advances once per OWN token — its draws are reproducible
-    regardless of admission order or co-resident requests."""
+    The serve decode/verify steps draw with the subkeys and commit the
+    carries only for rows that actually sampled this step, so a request's
+    key chain advances once per OWN sampling event — its draws are
+    reproducible regardless of admission order or co-resident requests."""
     split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
     return split[:, 0], split[:, 1]

@@ -1,6 +1,8 @@
 """tpudp.serve — continuous-batching inference (slot scheduler, chunked
-prefill, streaming decode).  See docs/SERVING.md."""
+prefill, streaming decode, speculative decoding).  See docs/SERVING.md."""
 
 from tpudp.serve.engine import TRACE_COUNTS, Engine, Request
+from tpudp.serve.speculate import Drafter, DraftModelDrafter, NgramDrafter
 
-__all__ = ["Engine", "Request", "TRACE_COUNTS"]
+__all__ = ["Engine", "Request", "TRACE_COUNTS", "Drafter",
+           "DraftModelDrafter", "NgramDrafter"]

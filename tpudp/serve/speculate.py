@@ -1,0 +1,172 @@
+"""Drafters for speculative decoding — propose cheap tokens, let the
+engine's batched verify step accept them (tpudp.serve.engine).
+
+Decode is weight-read bound: one forward costs the same whether it
+scores 1 token or a k+1-token window, so k cheap DRAFT tokens that the
+target model then verifies in ONE forward convert the amortize-the-
+weight-read lever from throughput (batching requests) into latency
+(batching a single request's future tokens).  The engine feeds
+``[last, d_0 .. d_{k-1}]`` through the same per-row-position cached
+forward the decode step uses and accepts the longest draft prefix that
+matches what it would have emitted anyway — greedy outputs are
+bit-identical to non-speculative decode, and rejected tokens cost
+nothing but the already-paid window slots
+(``tpudp.ops.sampling.verify_tokens`` is the acceptance rule).
+
+A drafter is anything with ``propose(context, k) -> up to k int32
+tokens`` (host-side, between device steps — the same host/device split
+as the scheduler).  Drafts are PURE HINTS: a wrong, short, or empty
+proposal can never change the output, only the speedup, so drafters are
+free to be heuristic.  Two are provided:
+
+  * :class:`NgramDrafter` — prompt-lookup decoding: match the last n
+    generated/prompt tokens against the request's OWN earlier context
+    and propose the continuation of the most recent match.  Zero extra
+    weights, so it runs everywhere (including CI's tiny configs) and
+    shines exactly where speculation pays most: repetitive or
+    input-grounded generation (quoting, code edits, summaries).
+  * :class:`DraftModelDrafter` — a smaller compatible model (same
+    tokenizer/vocab) greedily decodes k tokens through its own cached
+    forward; the target model keeps its quality, the draft model sets
+    the pace.  Context length is bucketed to powers of two so the
+    drafting program compiles once per (config, bucket, k), not per
+    request length.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Protocol, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpudp.models.generate import (KVCache, _forward_cached,
+                                   validate_decode_config)
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """Anything that proposes up to ``k`` continuation tokens for a
+    request's current ``context`` (prompt + tokens emitted so far,
+    1-D int32).  Called host-side once per engine verify step per
+    decoding slot.  Proposals are hints, never promises: the verify
+    step rejects anything the target model disagrees with."""
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        ...
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting: the request's own context is the draft
+    model.  The last ``n`` tokens (longest match wins, ``n`` from
+    ``max_ngram`` down to ``min_ngram``) are searched in the earlier
+    context; the continuation of the MOST RECENT match is proposed.
+    Free (no weights, no device work) and exact where generation
+    repeats its own context — which untrained and trained LMs both do
+    constantly (loops, quotes, copied spans)."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1:
+            raise ValueError(f"min_ngram must be >= 1, got {min_ngram}")
+        if max_ngram < min_ngram:
+            raise ValueError(
+                f"max_ngram ({max_ngram}) must be >= min_ngram "
+                f"({min_ngram})")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        context = np.asarray(context, np.int32).reshape(-1)
+        size = context.size
+        best = np.zeros(0, np.int32)
+        if k < 1 or size < self.min_ngram + 1:
+            return best
+        for n in range(min(self.max_ngram, size - 1),
+                       self.min_ngram - 1, -1):
+            pattern = context[size - n:]
+            # Candidate starts 0..size-n-1: excludes the suffix itself
+            # and guarantees at least one continuation token.
+            windows = np.lib.stride_tricks.sliding_window_view(context, n)
+            hits = np.nonzero((windows[:size - n] == pattern).all(1))[0]
+            if not hits.size:
+                continue
+            # Most recent match with a FULL k-token continuation, else
+            # the one with the most tokens available: in a short-period
+            # loop (the drafter's bread and butter) the newest match
+            # hugs the suffix and would cap the proposal at one token.
+            avail = size - (hits + n)
+            full = hits[avail >= k]
+            i = int(full[-1]) if full.size else int(hits[np.argmax(avail)])
+            cand = context[i + n:i + n + k]
+            if cand.size == k:
+                return cand.astype(np.int32)
+            if cand.size > best.size:
+                best = cand.astype(np.int32)
+        return best
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "k"))
+def _draft_greedy(cfg, params, tokens, length, k):
+    """``k`` greedy tokens from the draft model: one uncached prefill of
+    the padded ``(1, bucket)`` context (the last VALID token's logits are
+    read at traced index ``length - 1`` — pad tokens sit behind the
+    causal mask), then ``k`` cached decode steps on the per-row-position
+    path.  ``length`` is traced, so every context length in a bucket
+    shares one compiled program; pad/garbage KV beyond ``length`` is
+    overwritten by each decode step before its position becomes visible
+    (the serve arena's overwrite-before-visible rule)."""
+    from tpudp.serve.engine import TRACE_COUNTS
+
+    TRACE_COUNTS["draft_model"] += 1
+    bucket = tokens.shape[1]
+    cache = KVCache.zeros(cfg, 1, bucket + k)
+    logits, cache = _forward_cached(cfg, params, tokens, cache, 0)
+    last = lax.dynamic_index_in_dim(logits, length - 1, axis=1,
+                                    keepdims=False)  # (1, vocab)
+
+    def step(carry, i):
+        cache, last = carry
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)  # (1,)
+        logits, cache = _forward_cached(cfg, params, tok[:, None], cache,
+                                        (length + i)[None])
+        return (cache, logits[:, 0]), tok[0]
+
+    _, drafts = lax.scan(step, (cache, last), jnp.arange(k))
+    return drafts  # (k,) int32
+
+
+class DraftModelDrafter:
+    """Greedy k-token drafting with a smaller compatible model (any
+    dense GPT-2/LLaMA config sharing the target's tokenizer — the
+    engine checks the vocab matches).  Deterministic given the context,
+    so the verify step's point-mass rejection rule applies unchanged at
+    any temperature."""
+
+    def __init__(self, model, params: dict):
+        validate_decode_config(model.config, "DraftModelDrafter")
+        self.model = model
+        self.config = model.config
+        self.params = params
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        context = np.asarray(context, np.int32).reshape(-1)
+        if k < 1 or context.size == 0:
+            return np.zeros(0, np.int32)
+        # Bucket the context to a power of two (clamped so the window
+        # still fits the draft model's position budget): one compiled
+        # program per (config, bucket, k) instead of per length.
+        cap = max(self.config.max_seq_len - k, 1)
+        length = min(context.size, cap)
+        context = context[-length:]
+        bucket = 1
+        while bucket < length:
+            bucket *= 2
+        bucket = min(bucket, cap)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :length] = context
+        drafts = _draft_greedy(self.config, self.params, padded,
+                               jnp.int32(length), int(k))
+        return np.asarray(drafts, np.int32)
