@@ -38,13 +38,36 @@ sequential baseline produces for the same request (pinned by
 tests/test_serve.py and tests/test_speculate.py) — all columns measure
 the SAME work.
 
+With ``--queue-limit N`` (or SERVE_QUEUE_LIMIT) the sweep also exercises
+the robustness layer's bounded admission: submits past the limit are
+shed with a typed ``QueueFull`` (counted per row in ``shed``) instead of
+growing the host queue, and the throughput/latency columns then measure
+only the ADMITTED work — the overload story is "p99 TTFT of survivors
+stays bounded while sheds absorb the burst".  Every row also carries
+``shed``/``deadline_expired`` counters (0 when the knobs are off);
+SERVE_DEADLINE_S / SERVE_TTFT_DEADLINE_S attach per-request budgets.
+
+With ``--soak SEED1,SEED2`` (or SERVE_SOAK) the bench instead runs the
+fault-injection SOAK harness (one ``serve_soak`` row per seed): a
+deterministic per-seed mix of random cancels, impossible and tight
+deadlines, queue-limit sheds, a drafter that dies mid-run, and injected
+device-step faults (``tpudp.serve.faults``) against a small engine.  A
+seed PASSES only if the run never wedges (bounded step count), the
+engine ends empty (``no_leak`` — no slot or queue entry stranded), and
+every surviving completed request's greedy output is bit-identical to
+``generate()`` (``parity_ok``).  The gap gate
+(tools/bench_gaps.serve_soak_missing) retries anything less.
+
 Runs on whatever device is attached; SERVE_PLATFORM=cpu pins the CPU
 smoke mode (tier-1 runs it at a trimmed geometry).  Knobs: SERVE_CONCURRENCY
 (comma-separated subset of the registered levels — the watcher's
 gap-resume path), SERVE_SPECULATE_K (same, for the spec rows),
-SERVE_SPEC_CONCURRENCY, SERVE_REQUESTS, SERVE_PROMPT_LEN, SERVE_MAX_NEW,
-SERVE_LAYERS, SERVE_DMODEL, SERVE_VOCAB, SERVE_CHUNK, SERVE_LOAD,
-SERVE_SEED, SERVE_STRICT_LEVELS=1 (reject unregistered levels).
+SERVE_SOAK (same, for the soak rows), SERVE_SPEC_CONCURRENCY,
+SERVE_REQUESTS, SERVE_PROMPT_LEN, SERVE_MAX_NEW, SERVE_LAYERS,
+SERVE_DMODEL, SERVE_VOCAB, SERVE_CHUNK, SERVE_LOAD, SERVE_SEED,
+SERVE_QUEUE_LIMIT, SERVE_DEADLINE_S, SERVE_TTFT_DEADLINE_S,
+SOAK_REQUESTS, SOAK_LAYERS, SOAK_DMODEL, SOAK_VOCAB,
+SERVE_STRICT_LEVELS=1 (reject unregistered levels/seeds).
 """
 
 import argparse
@@ -56,10 +79,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
-                              SERVE_SPEC_KS)
+                              SERVE_SOAK_SEEDS, SERVE_SPEC_KS)
 
 METRIC = "serve_tokens_per_sec"
 SPEC_METRIC = "serve_spec_tokens_per_sec"
+SOAK_METRIC = "serve_soak"
 
 
 def _percentile(xs, q):
@@ -80,6 +104,14 @@ def main() -> None:
                     help="comma-separated speculation depths; emits "
                          "speculative-vs-baseline rows instead of the "
                          "concurrency sweep (env: SERVE_SPECULATE_K)")
+    ap.add_argument("--soak", default=None,
+                    help="comma-separated soak seeds; runs the "
+                         "fault-injection soak harness instead of the "
+                         "concurrency sweep (env: SERVE_SOAK)")
+    ap.add_argument("--queue-limit", default=None,
+                    help="bound the engine queue in the concurrency "
+                         "sweep; overload sheds with QueueFull and rows "
+                         "record the shed count (env: SERVE_QUEUE_LIMIT)")
     args = ap.parse_args()
 
     import jax
@@ -97,22 +129,28 @@ def main() -> None:
 
     from tpudp.models.generate import generate
     from tpudp.models.gpt2 import GPT2, GPT2Config
-    from tpudp.serve import Engine, NgramDrafter
+    from tpudp.serve import Engine, NgramDrafter, QueueFull
 
     spec_env = args.speculate_k or os.environ.get("SERVE_SPECULATE_K")
     spec_ks = _parse_levels(spec_env) if spec_env else []
+    soak_env = args.soak or os.environ.get("SERVE_SOAK")
+    soak_seeds = _parse_levels(soak_env) if soak_env else []
     levels_env = os.environ.get("SERVE_CONCURRENCY")
     levels = (_parse_levels(levels_env)
               if levels_env else list(SERVE_CONCURRENCIES))
     if os.environ.get("SERVE_STRICT_LEVELS") == "1":
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
-        if not spec_ks and bad:
+        if not spec_ks and not soak_seeds and bad:
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
         bad_k = [k for k in spec_ks if k not in SERVE_SPEC_KS]
         if bad_k:
             raise SystemExit(f"error: unregistered speculate_k values "
                              f"{bad_k} (registry: {list(SERVE_SPEC_KS)})")
+        bad_s = [s for s in soak_seeds if s not in SERVE_SOAK_SEEDS]
+        if bad_s:
+            raise SystemExit(f"error: unregistered soak seeds {bad_s} "
+                             f"(registry: {list(SERVE_SOAK_SEEDS)})")
     n_requests = int(os.environ.get("SERVE_REQUESTS", 24))
     prompt_len = int(os.environ.get("SERVE_PROMPT_LEN", 16))
     max_new = int(os.environ.get("SERVE_MAX_NEW", 32))
@@ -126,6 +164,14 @@ def main() -> None:
     # untrained greedy LM collapses into dominates the run.
     spec_conc = int(os.environ.get("SERVE_SPEC_CONCURRENCY", 1))
     spec_max_new = int(os.environ.get("SERVE_SPEC_MAX_NEW", 64))
+    # Robustness axes for the concurrency sweep: a bounded queue (sheds
+    # counted per row) and optional per-request deadline budgets.
+    ql_env = args.queue_limit or os.environ.get("SERVE_QUEUE_LIMIT")
+    queue_limit = int(ql_env) if ql_env else None
+    deadline_s = (float(os.environ["SERVE_DEADLINE_S"])
+                  if os.environ.get("SERVE_DEADLINE_S") else None)
+    ttft_deadline_s = (float(os.environ["SERVE_TTFT_DEADLINE_S"])
+                       if os.environ.get("SERVE_TTFT_DEADLINE_S") else None)
 
     # Default geometry: small GPT-2 family but with the weights (~93 MB
     # fp32) well past any cache, so the decode step is weight-STREAM
@@ -145,8 +191,11 @@ def main() -> None:
         d_model=dm,
     )
     model = GPT2(cfg)
-    params = model.init(jax.random.PRNGKey(seed),
-                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # Soak mode builds its own tiny model (it measures scheduling under
+    # faults, not FLOPs) — don't pay the ~93 MB default init for it.
+    params = (None if soak_seeds else
+              model.init(jax.random.PRNGKey(seed),
+                         jnp.zeros((1, 8), jnp.int32))["params"])
     kind = jax.devices()[0].device_kind
 
     rng = np.random.default_rng(seed)
@@ -155,19 +204,28 @@ def main() -> None:
 
     def drive(engine, offsets, reqs, new_tokens):
         """Submit ``reqs`` at ``offsets`` (seconds from start), step the
-        engine to completion; return aggregate timing."""
+        engine to completion; return aggregate timing.  A submit refused
+        by the bounded queue (QueueFull) is counted shed and dropped —
+        the overload contract is refusal, so the bench must absorb it
+        rather than retry-loop the burst back in."""
         n = len(reqs)
         start = time.perf_counter()
         handles = []
         nxt = 0
+        shed = 0
         latencies = []
         consumed = {}  # request id -> tokens already accounted
         last_emit = start
         while nxt < n or engine.slots_in_use or engine.queue_depth:
             now = time.perf_counter()
             while nxt < n and now - start >= offsets[nxt]:
-                handles.append(engine.submit(reqs[nxt], new_tokens,
-                                             seed=seed + nxt))
+                try:
+                    handles.append(engine.submit(
+                        reqs[nxt], new_tokens, seed=seed + nxt,
+                        deadline_s=deadline_s,
+                        ttft_deadline_s=ttft_deadline_s))
+                except QueueFull:
+                    shed += 1
                 nxt += 1
                 now = time.perf_counter()
             if engine.slots_in_use or engine.queue_depth:
@@ -190,7 +248,7 @@ def main() -> None:
         elapsed = last_emit - start
         ttfts = [h.token_times[0] - h.submit_time for h in handles
                  if h.token_times]
-        return elapsed, latencies, ttfts
+        return elapsed, latencies, ttfts, handles, shed
 
     def latency_fields(latencies, ttfts):
         return {
@@ -213,9 +271,11 @@ def main() -> None:
     # (prompt_len, max_new) geometry, so the timed loop never recompiles.
     # Skipped in spec mode: its rows compare against a PLAIN ENGINE at
     # the same concurrency instead (the honest baseline for speculation).
+    # Skipped in soak mode too: the soak referees robustness invariants
+    # against per-request generate() references, not throughput.
     seq_tps = per_req_s = None
     seq_latencies = []
-    if not spec_ks:
+    if not spec_ks and not soak_seeds:
         np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
                             max_new))
         t0 = time.perf_counter()
@@ -233,7 +293,11 @@ def main() -> None:
                         max_len=cfg.max_seq_len, prefill_chunk=chunk)
         # Warmup: compile prefill/decode/sample for THIS geometry off the
         # clock (the persistent cache makes relaunches cheap on TPU).
+        # The queue bound is applied AFTER warmup — a --queue-limit
+        # below the warmup batch size must shed the measured burst, not
+        # the warmup's own submits.
         engine.generate_many(prompts[:2], 2)
+        engine.queue_limit = queue_limit
         base_stats = dict(engine.stats)
 
         # Poisson arrivals: offered load = `load` x the sequential service
@@ -243,8 +307,13 @@ def main() -> None:
         gaps = arrival_rng.exponential(1.0 / lam, size=n_requests)
         offsets = np.cumsum(gaps) - gaps[0]  # first request at t=0
 
-        elapsed, latencies, ttfts = drive(engine, offsets, prompts, max_new)
-        tps = n_requests * max_new / elapsed if elapsed > 0 else 0.0
+        elapsed, latencies, ttfts, handles, shed = drive(
+            engine, offsets, prompts, max_new)
+        # Count what was actually EMITTED: with a bounded queue or
+        # deadlines some requests shed or retire early, and charging the
+        # full n*max_new would overstate throughput.
+        emitted_tokens = sum(len(h.tokens) for h in handles)
+        tps = emitted_tokens / elapsed if elapsed > 0 else 0.0
         dec = engine.stats["decode_steps"] - base_stats.get("decode_steps", 0)
         act = (engine.stats["active_slot_steps"]
                - base_stats.get("active_slot_steps", 0))
@@ -254,6 +323,9 @@ def main() -> None:
             "concurrency": c,
             "value": round(tps, 1),
             "unit": "tokens/sec",
+            "queue_limit": queue_limit,
+            "shed": shed,
+            "deadline_expired": int(engine.stats["deadline_expired"]),
             "sequential_tokens_per_sec": round(seq_tps, 1),
             "speedup_vs_sequential": round(tps / seq_tps, 2) if seq_tps
             else None,
@@ -302,7 +374,7 @@ def main() -> None:
         plain = Engine(model, zero_params, num_slots=spec_conc,
                        max_len=cfg.max_seq_len, prefill_chunk=chunk)
         plain.generate_many([warm], 2)  # warmup: prefill+decode programs
-        base_elapsed, _base_lat, base_ttft = drive(
+        base_elapsed, _base_lat, base_ttft, _h, _s = drive(
             plain, offsets, spec_prompts, spec_max_new)
         base_tps = (n_requests * spec_max_new / base_elapsed
                     if base_elapsed > 0 else 0.0)
@@ -316,7 +388,7 @@ def main() -> None:
         # Repetitive warmup prompt: guarantees drafted steps, so the
         # VERIFY program compiles off the clock too.
         engine.generate_many([warm], 8)
-        elapsed, latencies, ttfts = drive(
+        elapsed, latencies, ttfts, _h, _s = drive(
             engine, offsets, spec_prompts, spec_max_new)
         tps = (n_requests * spec_max_new / elapsed if elapsed > 0 else 0.0)
         emit({
@@ -348,8 +420,123 @@ def main() -> None:
             "device_kind": kind,
         })
 
+    def run_soak(soak_seed: int) -> None:
+        """Fault-injection soak against the robustness layer, fully
+        deterministic per seed: a small engine (tiny config — the soak
+        exercises SCHEDULING under faults, not FLOPs) serves a workload
+        mixing free-running requests, impossible TTFT deadlines, tight
+        total deadlines, mid-stream client cancels, and queue-limit
+        sheds, while a drafter dies mid-run (quarantine) and two device
+        steps are injected to fail (requeue-once containment).  The row
+        passes only if nothing wedged (bounded step count), the engine
+        ended empty, and every surviving COMPLETE request's greedy
+        output is bit-identical to generate()."""
+        from tpudp.serve import FinishReason
+        from tpudp.serve.faults import FailingDrafter, FaultySteps
+
+        srng = np.random.default_rng(10_000 + soak_seed)
+        s_cfg = GPT2Config(
+            vocab_size=int(os.environ.get("SOAK_VOCAB", 128)),
+            max_seq_len=64,
+            num_layers=int(os.environ.get("SOAK_LAYERS", 2)),
+            num_heads=2,
+            d_model=int(os.environ.get("SOAK_DMODEL", 64)),
+        )
+        s_model = GPT2(s_cfg)
+        s_params = s_model.init(jax.random.PRNGKey(soak_seed),
+                                jnp.zeros((1, 8), jnp.int32))["params"]
+        n = int(os.environ.get("SOAK_REQUESTS", 16))
+        p_len, s_new = 8, 8
+        s_prompts = [srng.integers(0, s_cfg.vocab_size, size=p_len)
+                     .astype(np.int32) for _ in range(n)]
+        hook = FaultySteps(
+            fail_at=set(int(x) for x in srng.integers(5, 60, size=2)))
+        eng = Engine(
+            s_model, s_params, num_slots=4, max_len=32, prefill_chunk=8,
+            speculate_k=2,
+            drafter=FailingDrafter(inner=NgramDrafter(),
+                                   ok_proposals=int(srng.integers(1, 8))),
+            queue_limit=6, drafter_timeout_s=30.0, step_fault_hook=hook)
+        # Request mix by kind: 0 -> impossible TTFT deadline (expires
+        # while queued), 1 -> tight total deadline (expires wherever the
+        # clock catches it), 2 -> cancelled mid-stream, else free-run.
+        kinds = srng.integers(0, 8, size=n)
+        cancel_at = {i: int(srng.integers(1, s_new))
+                     for i in range(n) if kinds[i] == 2}
+        handles: list = []
+        submitted = 0
+        steps = 0
+        max_steps = 100 + 40 * n  # wedge guard: way past any honest run
+        while ((submitted < n or eng.slots_in_use or eng.queue_depth)
+               and steps < max_steps):
+            for _ in range(3):  # submit in waves: queue + admission churn
+                if submitted >= n:
+                    break
+                i = submitted
+                kw = {}
+                if kinds[i] == 0:
+                    kw["ttft_deadline_s"] = 1e-7
+                elif kinds[i] == 1:
+                    kw["deadline_s"] = 0.02
+                try:
+                    handles.append(eng.submit(s_prompts[i], s_new,
+                                              seed=soak_seed + i, **kw))
+                except QueueFull:
+                    handles.append(None)
+                submitted += 1
+            eng.step()
+            steps += 1
+            for i, h in enumerate(handles):
+                if (h is not None and not h.done and i in cancel_at
+                        and len(h.tokens) >= cancel_at[i]):
+                    h.cancel()
+        wedged = steps >= max_steps
+        no_leak = eng.slots_in_use == 0 and eng.queue_depth == 0
+        parity_ok = True
+        completed = 0
+        for i, h in enumerate(handles):
+            if h is None or h.finish_reason is not FinishReason.COMPLETE:
+                continue
+            completed += 1
+            ref = np.asarray(generate(s_model, s_params,
+                                      jnp.asarray(s_prompts[i][None]),
+                                      s_new))[0, p_len:]
+            if h.tokens != ref.tolist():
+                parity_ok = False
+        emit({
+            "metric": SOAK_METRIC,
+            "seed": soak_seed,
+            "value": completed,
+            "unit": "completed_requests",
+            "requests": n,
+            "steps": steps,
+            "wedged": wedged,
+            "no_leak": no_leak,
+            "parity_ok": parity_ok,
+            "shed": int(eng.stats["shed"]),
+            "deadline_expired": int(eng.stats["deadline_expired"]),
+            "cancelled": int(eng.stats["cancelled"]),
+            "errors": int(eng.stats["errors"]),
+            "requeued": int(eng.stats["requeued"]),
+            "step_failures": int(eng.stats["step_failures"]),
+            "drafter_quarantined": int(eng.stats["drafter_quarantined"]),
+            "num_layers": s_cfg.num_layers,
+            "d_model": s_cfg.d_model,
+            "vocab_size": s_cfg.vocab_size,
+            "device_kind": kind,
+        })
+
     # One level crashing (OOM, transient backend fault) must not cost
     # the remaining rows — same isolation contract as matrix_bench.
+    if soak_seeds:
+        for s in soak_seeds:
+            try:
+                run_soak(s)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": SOAK_METRIC, "seed": s,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        print(json.dumps({"serve_soak": results}))
+        return
     if spec_ks:
         # One zero tree for the whole sweep: a fresh tree per k would
         # miss the engine's (cfg, params-identity) program cache and

@@ -43,9 +43,11 @@ def _reference(model, params, prompt, n):
 
 
 class GarbageDrafter:
-    """Adversarial drafter: always proposes k copies of one token (and
-    out-of-range ids, which the engine must clip).  Near-zero acceptance
-    — output must be bit-identical anyway."""
+    """Adversarial drafter: always proposes k copies of an out-of-range
+    id.  The robustness layer QUARANTINES it on first sight (out-of-
+    vocab proposals are a drafter-contract violation) and the engine
+    falls back to plain decode — output must be bit-identical anyway,
+    with the garbage proposal charged as proposed-and-rejected."""
 
     def propose(self, context, k):
         return np.full(k, 10 ** 9, np.int64)
@@ -371,10 +373,14 @@ def test_cancel_frees_slot_and_reuse_is_clean(model_and_params):
     eng.run_until_complete()
     np.testing.assert_array_equal(
         _reference(model, params, p2, 6)[0, 9:], np.asarray(h2.tokens))
-    # result() on a cancelled request returns the partial sequence.
-    np.testing.assert_array_equal(
-        h1.result(), np.concatenate([p1, np.asarray(emitted_before,
-                                                    np.int32)]))
+    # result() on a cancelled request raises (finish_reason contract);
+    # the partial tokens stay on the handle.
+    from tpudp.serve import FinishReason, RequestFailed
+
+    with pytest.raises(RequestFailed, match="cancelled"):
+        h1.result()
+    assert h1.finish_reason is FinishReason.CANCELLED
+    assert h1.tokens == emitted_before
     assert eng.stats["cancelled"] == 1 and eng.stats["completed"] == 1
 
 

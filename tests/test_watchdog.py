@@ -211,6 +211,29 @@ def test_truncated_emergency_dump_is_ignored(tmp_path, capsys):
     clear_emergency_sentinel(root)  # idempotent when already clear
 
 
+def test_scoped_timeout_override_and_acknowledge():
+    """Watchdog.step(timeout_s=...) arms a per-scope deadline distinct
+    from the default (the serve engine guards its blocking device calls
+    with a much tighter budget than a training step's), and
+    acknowledge() clears a HANDLED hang so the next scope proceeds —
+    the serve engine's containment path."""
+    wd = Watchdog(timeout_s=10.0, kill=False, poll_s=0.01).start()
+    try:
+        with wd.step(timeout_s=0.05):  # tight scope under a lax default
+            time.sleep(0.2)
+        assert wd._hang_seen.is_set()
+        assert wd.acknowledge() is True   # hang handled
+        assert wd.acknowledge() is False  # idempotent
+        with wd.step(timeout_s=0.05):     # reusable after acknowledge
+            pass
+        with wd.step():                   # default-deadline scope too
+            pass
+        with pytest.raises(ValueError, match="timeout_s"):
+            wd.step(timeout_s=0.0)
+    finally:
+        wd.stop()
+
+
 def test_check_finite():
     assert check_finite(1.25) == 1.25
     with pytest.raises(FloatingPointError, match="step 7"):

@@ -41,6 +41,12 @@ SERVE_CONCURRENCIES = (1, 4, 8)
 # --speculate-k, n-gram drafting vs the non-speculative baseline) must
 # measure on the TPU; same registry contract.
 SERVE_SPEC_KS = (2, 4, 8)
+# Fault-injection soak seeds (serve_bench.py --soak: random cancels,
+# deadline mix, injected drafter/step faults against the serve engine's
+# robustness layer) that must PASS on the TPU — a seed is closed only by
+# a row that completed with parity intact and no slot/queue leak; same
+# registry contract.
+SERVE_SOAK_SEEDS = (0, 1, 2)
 
 
 def history_path(path: str) -> str:
@@ -152,6 +158,26 @@ def serve_spec_missing(d: str) -> list[int]:
     return [k for k in SERVE_SPEC_KS if k not in done]
 
 
+def serve_soak_missing(d: str) -> list[int]:
+    """Soak seeds still lacking a PASSING real-TPU run.  A soak row
+    closes its seed only when it measured something (``value`` =
+    completed requests > 0), the surviving outputs matched generate()
+    bit-exactly (``parity_ok``), and the engine ended empty
+    (``no_leak``) — a soak that wedged, leaked a slot, or diverged is a
+    FAILURE to retry, exactly like an error row.  CPU smoke rows never
+    close a seed (same rules as serve_missing)."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_soak.jsonl")):
+        if (r.get("metric") == "serve_soak"
+                and r.get("seed") in SERVE_SOAK_SEEDS
+                and measured(r)
+                and r.get("parity_ok") is True
+                and r.get("no_leak") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["seed"])
+    return [s for s in SERVE_SOAK_SEEDS if s not in done]
+
+
 def epoch_missing(d: str) -> bool:
     return not any(
         r.get("metric") == "vgg11_epoch_images_per_sec" and measured(r)
@@ -253,7 +279,7 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
                                      "collective", "lever", "serve",
-                                     "serve_spec"])
+                                     "serve_spec", "serve_soak"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -266,6 +292,9 @@ def main() -> None:
         print(",".join(str(c) for c in serve_missing(args.dir)), end="")
     elif args.stage == "serve_spec":
         print(",".join(str(k) for k in serve_spec_missing(args.dir)),
+              end="")
+    elif args.stage == "serve_soak":
+        print(",".join(str(s) for s in serve_soak_missing(args.dir)),
               end="")
     elif args.stage == "collective":
         print("collective" if collective_missing(args.dir) else "", end="")

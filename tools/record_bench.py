@@ -208,6 +208,34 @@ def main() -> None:
                   f"p50 {r.get('ttft_p50_ms')} ms) | "
                   f"`serve_bench.py --speculate-k` | |")
 
+    # Soak rows render pass/fail: a soak that wedged, leaked, or broke
+    # parity is a robustness FAILURE even if it "measured" something —
+    # the same criteria as bench_gaps.serve_soak_missing, so recorder
+    # and gate can't disagree.
+    soak = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_soak.jsonl"))
+         if "seed" in r and "serve_soak" not in r), "seed")
+    for r in sorted(soak.values(), key=lambda r: r.get("seed", 0)):
+        if (not measured(r) or not r.get("parity_ok")
+                or not r.get("no_leak")):
+            why = r.get("error") or ", ".join(
+                w for w, bad in (("wedged", r.get("wedged")),
+                                 ("slot/queue leak", not r.get("no_leak")),
+                                 ("parity broken", not r.get("parity_ok")))
+                if bad) or "no real measurement"
+            print(f"| serve_soak seed={r.get('seed')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --soak` | |")
+        else:
+            print(f"| serve soak seed={r['seed']} (fault injection) | "
+                  f"PASS: {r['value']} completed bit-exact of "
+                  f"{r.get('requests')} ({r.get('shed')} shed, "
+                  f"{r.get('deadline_expired')} deadline, "
+                  f"{r.get('cancelled')} cancelled, {r.get('errors')} "
+                  f"error, {r.get('step_failures')} step faults "
+                  f"contained, drafter quarantined: "
+                  f"{bool(r.get('drafter_quarantined'))}) | "
+                  f"`serve_bench.py --soak` | |")
+
     flash = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
          if "t" in r), "t")

@@ -91,6 +91,10 @@ serve_spec_ok() {
   local out; out=$(python tools/bench_gaps.py serve_spec) || return 1
   [ -z "$out" ]
 }
+serve_soak_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_soak) || return 1
+  [ -z "$out" ]
+}
 mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
@@ -339,6 +343,21 @@ while true; do
         > bench_results/serve_spec.jsonl 2> bench_results/serve_spec.err
       log "serve_spec_bench rc=$? -> bench_results/serve_spec.jsonl"
     fi
+    if serve_soak_ok; then
+      log "serve_soak.jsonl already good; skipping serve soak"
+    else
+      # Fault-injection soak (tpudp.serve robustness layer): random
+      # cancels, deadline mix, queue-limit sheds, injected drafter/step
+      # faults; a seed passes only with no wedge, no slot leak, and
+      # bit-exact parity on surviving requests — resumes at seed
+      # granularity via bench_gaps, like the serve_spec stage.
+      bank bench_results/serve_soak.jsonl
+      ensure_window
+      SERVE_SOAK="$(python tools/bench_gaps.py serve_soak)" \
+        timeout -k "$GRACE" "$(stage_t 900)" python benchmarks/serve_bench.py \
+        > bench_results/serve_soak.jsonl 2> bench_results/serve_soak.err
+      log "serve_soak rc=$? -> bench_results/serve_soak.jsonl"
+    fi
     if flash_ok; then
       log "flash.jsonl already good; skipping flash bench"
     else
@@ -367,7 +386,8 @@ while true; do
     # waiting for the next window (a stage that died on a healthy relay —
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
-        && lever_ok && collective_ok && serve_ok && serve_spec_ok; then
+        && lever_ok && collective_ok && serve_ok && serve_spec_ok \
+        && serve_soak_ok; then
       log "battery done"
       exit 0
     fi

@@ -1,8 +1,14 @@
 """tpudp.serve — continuous-batching inference (slot scheduler, chunked
-prefill, streaming decode, speculative decoding).  See docs/SERVING.md."""
+prefill, streaming decode, speculative decoding, robustness layer:
+bounded admission, deadlines, fault isolation, graceful drain).  See
+docs/SERVING.md; deterministic fault injectors live in
+``tpudp.serve.faults``."""
 
-from tpudp.serve.engine import TRACE_COUNTS, Engine, Request
+from tpudp.serve.engine import (TRACE_COUNTS, Engine, EngineClosed,
+                                FinishReason, QueueFull, Request,
+                                RequestFailed)
 from tpudp.serve.speculate import Drafter, DraftModelDrafter, NgramDrafter
 
 __all__ = ["Engine", "Request", "TRACE_COUNTS", "Drafter",
-           "DraftModelDrafter", "NgramDrafter"]
+           "DraftModelDrafter", "NgramDrafter", "FinishReason",
+           "QueueFull", "EngineClosed", "RequestFailed"]

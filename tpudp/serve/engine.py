@@ -62,11 +62,46 @@ Design (static shapes everywhere — the TPU rule that shapes are compile
 Host-side scheduling (admission, retirement, chunk bookkeeping, draft
 proposal, cancellation) is plain Python between device steps — the same
 split as the training stack (host data pipeline around a jitted step).
+
+**Robustness layer** (the serving mirror of the trainer's watchdog +
+elastic-resume posture; SURVEY.md §5 records the reference hanging
+forever on any fault):
+
+  * **Bounded admission** — ``Engine(queue_limit=N)`` sheds overload with
+    a typed :class:`QueueFull` instead of growing the host queue without
+    bound (``stats["shed"]`` counts refusals).
+  * **Deadlines** — ``submit(..., deadline_s=, ttft_deadline_s=)``
+    budgets are checked at every scheduler iteration; an expired request
+    retires with ``FinishReason.DEADLINE`` (emitted tokens stay on the
+    handle, the slot frees for the next queued request).
+  * **Drafter quarantine** — a drafter that raises, returns malformed or
+    out-of-vocab tokens, or exceeds ``drafter_timeout_s`` per propose is
+    permanently quarantined: the engine falls back to the plain decode
+    program (outputs unchanged — drafts were only ever hints) and
+    records why.  ``tpudp.serve.faults`` provides deterministic
+    injectors.
+  * **Step-failure containment** — an exception escaping a device step
+    cannot wedge the arena: the donated KV cache is rebuilt, every
+    in-flight request is requeued ONCE (its emitted tokens and PRNG
+    chain carry over, so the retried request continues bit-identically),
+    and a request failing a second time retires with
+    ``FinishReason.ERROR``.  Queued work is untouched — the arena keeps
+    serving.
+  * **Graceful shutdown** — :meth:`Engine.drain` stops admission and
+    finishes all accepted work; :meth:`Engine.close` stops admission and
+    retires everything immediately; both make later ``submit()`` raise
+    :class:`EngineClosed`.
+  * **Watchdog arming** — ``Engine(watchdog=wd, step_timeout_s=s)``
+    wraps every blocking device call in a scoped watchdog deadline
+    (``tpudp.utils.watchdog.Watchdog.step``), so a wedged TPU step is
+    detected from OUTSIDE the blocked call, mirroring the trainer.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
+import enum
 import functools
 import time
 
@@ -84,6 +119,60 @@ from tpudp.ops.sampling import sample_tokens, split_keys, verify_tokens
 # compiles ONCE per engine geometry no matter how many requests churn
 # through the slots.
 TRACE_COUNTS = collections.Counter()
+
+
+class FinishReason(str, enum.Enum):
+    """Why a request stopped.  ``COMPLETE``/``EOS`` are success; the rest
+    are failures and make :meth:`Request.result` raise
+    :class:`RequestFailed` (the emitted tokens stay on the handle)."""
+
+    COMPLETE = "complete"    # max_new_tokens emitted
+    EOS = "eos"              # sampled the request's eos_id
+    CANCELLED = "cancelled"  # Engine.cancel()/Request.cancel()/close()
+    DEADLINE = "deadline"    # deadline_s / ttft_deadline_s expired
+    ERROR = "error"          # a device-step failure exhausted the requeue
+    SHED = "shed"            # queued work discarded by Engine.close()
+
+
+# stats counter bumped per finish reason (COMPLETE and EOS share
+# "completed" — both are successful retirements, and existing consumers
+# count successes there).
+_FINISH_COUNTER = {
+    FinishReason.COMPLETE: "completed",
+    FinishReason.EOS: "completed",
+    FinishReason.CANCELLED: "cancelled",
+    FinishReason.DEADLINE: "deadline_expired",
+    FinishReason.ERROR: "errors",
+    FinishReason.SHED: "shed",
+}
+
+
+class QueueFull(RuntimeError):
+    """submit() refused: the engine's queue is at ``queue_limit``.
+    Overload degrades by shedding work at the door instead of growing
+    host memory without bound; callers retry, redirect, or drop."""
+
+
+class EngineClosed(RuntimeError):
+    """submit() (or generate_many()) called after :meth:`Engine.drain` or
+    :meth:`Engine.close` — the engine no longer accepts work."""
+
+
+class RequestFailed(RuntimeError):
+    """:meth:`Request.result` called on a request that did not finish
+    successfully.  Carries the handle (``.request``) and its
+    ``.finish_reason``; tokens emitted before the failure remain on
+    ``request.tokens``."""
+
+    def __init__(self, request: "Request"):
+        self.request = request
+        self.finish_reason = request.finish_reason
+        detail = f" ({request.error})" if request.error is not None else ""
+        super().__init__(
+            f"request {request.id} finished with "
+            f"{request.finish_reason.value!r} after "
+            f"{len(request.tokens)} of {request.max_new_tokens} "
+            f"tokens{detail}")
 
 
 def _build_steps(cfg, params):
@@ -214,11 +303,18 @@ class Request:
     ``draft_proposed``/``draft_accepted`` count this request's drafted
     and accepted tokens (``acceptance_rate`` is their ratio).
     :meth:`cancel` retires the request immediately — a disconnected
-    client must not pin a slot until ``max_new_tokens``."""
+    client must not pin a slot until ``max_new_tokens``.
+
+    ``finish_reason`` (a :class:`FinishReason`) records WHY the request
+    stopped; it is ``None`` until ``done``.  :meth:`result` raises
+    :class:`RequestFailed` for any non-success reason instead of
+    silently returning a truncated sequence."""
 
     def __init__(self, engine: "Engine", rid: int, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
-                 top_p: float, seed: int, eos_id: int | None):
+                 top_p: float, seed: int, eos_id: int | None,
+                 deadline_s: float | None = None,
+                 ttft_deadline_s: float | None = None):
         self._engine = engine
         self.id = rid
         self.prompt = prompt
@@ -228,16 +324,23 @@ class Request:
         self.top_p = top_p  # 1.0 = disabled
         self.seed = seed
         self.eos_id = eos_id
+        self.deadline_s = deadline_s
+        self.ttft_deadline_s = ttft_deadline_s
         self.tokens: list[int] = []
         self.token_times: list[float] = []
         self.submit_time = time.perf_counter()
         self.done = False
-        self.cancelled = False
+        self.finish_reason: FinishReason | None = None
+        self.error: BaseException | None = None
         self.draft_proposed = 0
         self.draft_accepted = 0
         self._slot: int | None = None
-        self._nfill = 0  # prompt tokens already in the cache
-        self._order = 0  # admission order (prefill FIFO tiebreak)
+        self._fill = prompt  # tokens to prefill (prompt, or prompt +
+        #                      emitted tokens after a step-failure requeue)
+        self._nfill = 0      # fill tokens already in the cache
+        self._order = 0      # admission order (prefill FIFO tiebreak)
+        self._requeued = False      # one-shot step-failure requeue budget
+        self._resume_key = None     # PRNG chain saved across a requeue
 
     @property
     def acceptance_rate(self) -> float | None:
@@ -246,6 +349,16 @@ class Request:
         if not self.draft_proposed:
             return None
         return self.draft_accepted / self.draft_proposed
+
+    @property
+    def cancelled(self) -> bool:
+        return self.finish_reason is FinishReason.CANCELLED
+
+    @property
+    def ok(self) -> bool:
+        """Finished successfully (budget reached or EOS sampled)."""
+        return self.finish_reason in (FinishReason.COMPLETE,
+                                      FinishReason.EOS)
 
     def cancel(self) -> bool:
         """Retire this request now (see :meth:`Engine.cancel`)."""
@@ -263,11 +376,15 @@ class Request:
                 return
 
     def result(self) -> np.ndarray:
-        """Drive the engine until this request completes; return the full
-        ``prompt + generated`` int32 sequence (for a cancelled request:
-        the prompt plus whatever was emitted before cancellation)."""
+        """Drive the engine until this request finishes; return the full
+        ``prompt + generated`` int32 sequence.  Raises
+        :class:`RequestFailed` if the request did not finish successfully
+        (cancelled, deadline, error, shed) instead of silently returning
+        a truncated sequence — the partial tokens stay on ``tokens``."""
         while not self.done:
             self._engine.step()
+        if not self.ok:
+            raise RequestFailed(self)
         return np.concatenate([self.prompt,
                                np.asarray(self.tokens, np.int32)])
 
@@ -291,11 +408,25 @@ class Engine:
     scratch positions per slot (a window's rejected tail must never wrap
     past ``max_len``), so ``prompt + max_new_tokens + speculate_k`` must
     fit in ``max_len``.
+
+    Robustness knobs (see the module docstring): ``queue_limit`` bounds
+    the submit queue (:class:`QueueFull` sheds overload);
+    ``drafter_timeout_s`` is the per-propose budget past which the
+    drafter is quarantined; ``watchdog``/``step_timeout_s`` arm a scoped
+    :class:`tpudp.utils.watchdog.Watchdog` deadline around every
+    blocking device call; ``step_fault_hook`` (a public attribute; also
+    settable later) is called as ``hook(kind, index)`` immediately
+    before each device call — the fault-injection seam
+    ``tpudp.serve.faults`` plugs into.
     """
 
     def __init__(self, model, params: dict, *, num_slots: int = 8,
                  max_len: int | None = None, prefill_chunk: int = 16,
-                 speculate_k: int = 0, drafter=None):
+                 speculate_k: int = 0, drafter=None,
+                 queue_limit: int | None = None,
+                 drafter_timeout_s: float | None = None,
+                 watchdog=None, step_timeout_s: float | None = None,
+                 step_fault_hook=None):
         cfg = model.config
         validate_decode_config(cfg, "Engine")
         if num_slots < 1:
@@ -339,6 +470,16 @@ class Engine:
                 f"max_len ({self.max_len}) must exceed speculate_k "
                 f"({speculate_k}) — the arena reserves k scratch "
                 f"positions per slot for the speculative window")
+        if queue_limit is not None and queue_limit < 1:
+            raise ValueError(
+                f"queue_limit must be >= 1 (or None for unbounded), "
+                f"got {queue_limit}")
+        if drafter_timeout_s is not None and drafter_timeout_s <= 0:
+            raise ValueError(f"drafter_timeout_s must be > 0, got "
+                             f"{drafter_timeout_s}")
+        if step_timeout_s is not None and step_timeout_s <= 0:
+            raise ValueError(
+                f"step_timeout_s must be > 0, got {step_timeout_s}")
         self.model = model
         self.config = cfg
         self.params = params
@@ -363,13 +504,27 @@ class Engine:
         self._next_id = 0
         self._admitted = 0
         self.stats = collections.Counter()
+        # Robustness state.
+        self.queue_limit = queue_limit
+        self.drafter_timeout_s = drafter_timeout_s
+        self.step_fault_hook = step_fault_hook
+        self._watchdog = watchdog
+        self._step_timeout_s = step_timeout_s
+        self._device_calls = 0
+        self._accepting = True
+        self._closed = False
+        self._drafter_quarantined = False
+        self.drafter_quarantine_reason: str | None = None
+        self.last_step_error: BaseException | None = None
 
     # -- submission ----------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int | None = None,
                top_p: float | None = None, seed: int = 0,
-               eos_id: int | None = None) -> Request:
+               eos_id: int | None = None,
+               deadline_s: float | None = None,
+               ttft_deadline_s: float | None = None) -> Request:
         """Queue one generation request; returns its streaming handle.
 
         Same sampling contract as ``generate()``: ``temperature=0`` is
@@ -377,7 +532,29 @@ class Engine:
         truncated to top-k and/or the top-p nucleus, seeded per request
         (draws are independent of co-resident requests).  ``eos_id``
         retires the request early when sampled (the eos token is
-        included in ``tokens``)."""
+        included in ``tokens``).
+
+        ``deadline_s`` bounds the request's total wall-clock budget from
+        submit; ``ttft_deadline_s`` bounds the wait for the FIRST token
+        (a queueing/prefill SLO — it stops applying once a token is
+        emitted).  An expired request retires with
+        ``FinishReason.DEADLINE`` at the next scheduler iteration; its
+        emitted tokens stay on the handle and its slot frees.
+
+        Raises :class:`EngineClosed` after :meth:`drain`/:meth:`close`,
+        and :class:`QueueFull` when ``queue_limit`` queued requests are
+        already waiting (the typed backpressure signal — checked before
+        any validation, so overload is refused at minimum cost)."""
+        if not self._accepting:
+            raise EngineClosed(
+                "Engine.drain()/close() was called; the engine no longer "
+                "accepts work")
+        if (self.queue_limit is not None
+                and len(self._queue) >= self.queue_limit):
+            self.stats["shed"] += 1
+            raise QueueFull(
+                f"queue_limit ({self.queue_limit}) queued requests "
+                f"already waiting; request refused (shed)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must hold at least one token")
@@ -407,9 +584,15 @@ class Engine:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if eos_id is not None and not 0 <= eos_id < vocab:
             raise ValueError(f"eos_id must be in [0, {vocab})")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if ttft_deadline_s is not None and ttft_deadline_s <= 0:
+            raise ValueError(
+                f"ttft_deadline_s must be > 0, got {ttft_deadline_s}")
         r = Request(self, self._next_id, prompt, max_new_tokens,
                     float(temperature), int(top_k or 0),
-                    float(1.0 if top_p is None else top_p), seed, eos_id)
+                    float(1.0 if top_p is None else top_p), seed, eos_id,
+                    deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s)
         self._next_id += 1
         self._queue.append(r)
         self.stats["submitted"] += 1
@@ -421,34 +604,60 @@ class Engine:
                       eos_id: int | None = None) -> list[np.ndarray]:
         """Batched convenience wrapper: submit every prompt (request i is
         seeded ``seed + i``), run to completion, return the full
-        sequences in submission order."""
-        handles = [self.submit(p, max_new_tokens, temperature=temperature,
-                               top_k=top_k, top_p=top_p, seed=seed + i,
-                               eos_id=eos_id)
-                   for i, p in enumerate(prompts)]
+        sequences in submission order.  If a later submit raises (bad
+        prompt i, queue full), the already-queued prompts 0..i-1 are
+        CANCELLED before the error propagates — a failed batch must not
+        leave orphans pinned in the queue forever.  Results go through
+        :meth:`Request.result`, so a request that did not finish
+        successfully (e.g. a persistent step failure) raises
+        :class:`RequestFailed` instead of silently returning a truncated
+        sequence."""
+        handles = []
+        try:
+            for i, p in enumerate(prompts):
+                handles.append(
+                    self.submit(p, max_new_tokens, temperature=temperature,
+                                top_k=top_k, top_p=top_p, seed=seed + i,
+                                eos_id=eos_id))
+        except Exception:
+            for h in handles:
+                self.cancel(h)
+            raise
         self.run_until_complete()
-        return [np.concatenate([h.prompt, np.asarray(h.tokens, np.int32)])
-                for h in handles]
+        return [h.result() for h in handles]
 
     # -- scheduling ----------------------------------------------------
 
     def step(self) -> list[tuple[Request, int]]:
-        """One scheduler iteration: admit queued requests into free
-        slots, run at most one prefill chunk (the oldest admitted request
-        still prefilling), then one batched decode step — or, with
-        speculation on, one batched draft+verify window — for every
-        decoding slot.  Returns the ``(request, token)`` pairs emitted."""
+        """One scheduler iteration: expire deadlines, admit queued
+        requests into free slots, run at most one prefill chunk (the
+        oldest admitted request still prefilling), then one batched
+        decode step — or, with speculation on, one batched draft+verify
+        window — for every decoding slot.  Returns the
+        ``(request, token)`` pairs emitted.
+
+        An exception escaping a device step is CONTAINED
+        (:meth:`_contain_step_failure`): in-flight requests are requeued
+        once (then retired with ``ERROR``), the arena is rebuilt, and
+        the engine keeps serving — the one failure mode this layer
+        forbids is a wedge.  A closed engine's step is a no-op."""
         emitted: list[tuple[Request, int]] = []
+        if self._closed:
+            return emitted
+        self._expire_deadlines()
         self._admit()
-        slot = self._next_prefill_slot()
-        if slot is not None:
-            self._run_prefill_chunk(slot, emitted)
-        if any(r is not None and r._nfill == r.prompt.size
-               for r in self._slots):
-            if self.speculate_k:
-                self._run_verify(emitted)
-            else:
-                self._run_decode(emitted)
+        try:
+            slot = self._next_prefill_slot()
+            if slot is not None:
+                self._run_prefill_chunk(slot, emitted)
+            if any(r is not None and r._nfill == r._fill.size
+                   for r in self._slots):
+                if self.speculate_k and not self._drafter_quarantined:
+                    self._run_verify(emitted)
+                else:
+                    self._run_decode(emitted)
+        except Exception as exc:  # noqa: BLE001 — containment by design
+            self._contain_step_failure(exc)
         self.stats["steps"] += 1
         return emitted
 
@@ -462,19 +671,54 @@ class Engine:
         finished (completed or previously cancelled), True otherwise."""
         if request.done:
             return False
-        request.cancelled = True
         if request._slot is not None:
-            self._retire(request._slot, cancelled=True)
+            self._retire(request._slot, FinishReason.CANCELLED)
         else:
             self._queue.remove(request)
-            request.done = True
-            self.stats["cancelled"] += 1
+            self._finish(request, FinishReason.CANCELLED)
         return True
 
     def run_until_complete(self) -> None:
         """Drive the engine until the queue and every slot are empty."""
         while self._queue or any(r is not None for r in self._slots):
             self.step()
+
+    def drain(self) -> None:
+        """Graceful shutdown: stop admission (``submit()`` raises
+        :class:`EngineClosed` from now on), finish every queued and
+        in-flight request, then close.  Idempotent; safe after
+        :meth:`close`."""
+        self._accepting = False
+        self.run_until_complete()
+        self._closed = True
+
+    def close(self) -> None:
+        """Immediate shutdown: stop admission, retire every in-flight
+        request as ``CANCELLED`` (emitted tokens stay on the handles)
+        and every queued request as ``SHED``.  Idempotent."""
+        self._accepting = False
+        while self._queue:
+            self._finish(self._queue.popleft(), FinishReason.SHED)
+        for s, r in enumerate(self._slots):
+            if r is not None:
+                self._retire(s, FinishReason.CANCELLED)
+        self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def accepting(self) -> bool:
+        """False once :meth:`drain`/:meth:`close` has begun."""
+        return self._accepting
+
+    @property
+    def drafter_quarantined(self) -> bool:
+        """True once the drafter has been permanently quarantined
+        (``drafter_quarantine_reason`` says why); the engine then runs
+        the plain decode program, outputs unchanged."""
+        return self._drafter_quarantined
 
     @property
     def slots_in_use(self) -> int:
@@ -511,41 +755,145 @@ class Engine:
             self._temps[s] = r.temperature
             self._topk[s] = r.top_k
             self._topp[s] = r.top_p
-            self._keys = self._keys.at[s].set(jax.random.PRNGKey(r.seed))
+            # A step-failure requeue resumes the request's saved PRNG
+            # chain (already advanced once per committed token), so the
+            # retried request's remaining draws are bit-identical to an
+            # uninterrupted run.
+            key = (jnp.asarray(r._resume_key) if r._resume_key is not None
+                   else jax.random.PRNGKey(r.seed))
+            self._keys = self._keys.at[s].set(key)
             self.stats["admitted"] += 1
+
+    def _finish(self, r: Request, reason: FinishReason,
+                error: BaseException | None = None) -> None:
+        r.done = True
+        r.finish_reason = reason
+        r.error = error
+        self.stats[_FINISH_COUNTER[reason]] += 1
+
+    def _deadline_passed(self, r: Request, now: float) -> bool:
+        waited = now - r.submit_time
+        if r.deadline_s is not None and waited > r.deadline_s:
+            return True
+        return (r.ttft_deadline_s is not None and not r.tokens
+                and waited > r.ttft_deadline_s)
+
+    def _expire_deadlines(self) -> None:
+        """Retire every queued/in-flight request whose wall-clock budget
+        has expired (``FinishReason.DEADLINE``) — BEFORE admission, so a
+        dead-on-arrival queued request never wastes a slot or a prefill
+        chunk.  Emitted tokens stay on the handle; freed slots serve the
+        next queued request this same step."""
+        now = time.perf_counter()
+        for r in [r for r in self._queue if self._deadline_passed(r, now)]:
+            self._queue.remove(r)
+            self._finish(r, FinishReason.DEADLINE)
+        for s, r in enumerate(self._slots):
+            if r is not None and self._deadline_passed(r, now):
+                self._retire(s, FinishReason.DEADLINE)
+
+    def _guard(self, timeout_s: float | None):
+        """Scoped watchdog deadline (no-op without a watchdog)."""
+        if self._watchdog is None:
+            return contextlib.nullcontext()
+        return self._watchdog.step(timeout_s)
+
+    def _device(self, kind: str, fn, *args):
+        """Run one jitted step program behind the robustness seams: the
+        fault-injection hook (``step_fault_hook(kind, index)``, raising
+        to simulate a step failure) and the optional scoped watchdog
+        deadline, so a wedged device call is detected from OUTSIDE the
+        blocked call (``kill=True`` exits for the scheduler to restart;
+        ``kill=False`` raises at the next call and is contained like any
+        other step failure)."""
+        idx = self._device_calls
+        self._device_calls += 1
+        with self._guard(self._step_timeout_s):
+            if self.step_fault_hook is not None:
+                self.step_fault_hook(kind, idx)
+            return fn(*args)
+
+    def _contain_step_failure(self, exc: BaseException) -> None:
+        """An exception escaped a device step: rebuild the arena (the
+        failed call may have consumed the donated KV cache, so every
+        slot's cached state is suspect) and requeue each in-flight
+        request ONCE — with its emitted tokens and PRNG chain carried
+        over, re-prefilling ``prompt + tokens`` continues the request
+        bit-identically.  A request failing a second time retires with
+        ``FinishReason.ERROR``.  Queued requests are untouched; the
+        engine keeps serving."""
+        self.stats["step_failures"] += 1
+        self.last_step_error = exc
+        if self._watchdog is not None:
+            self._watchdog.acknowledge()  # handled; next scope may proceed
+        self._cache = KVCache.zeros(self.config, self.num_slots,
+                                    self.max_len)
+        survivors: list[Request] = []
+        for s in sorted(
+                (s for s, r in enumerate(self._slots) if r is not None),
+                key=lambda s: self._slots[s]._order):
+            r = self._slots[s]
+            # The keys array is NOT donated, so the chain survives the
+            # failed call (whose key update never committed).
+            key = np.asarray(self._keys[s])
+            self._slots[s] = None
+            self._len[s] = 0
+            self._temps[s] = 0.0
+            self._topk[s] = 0
+            self._topp[s] = 1.0
+            r._slot = None
+            if r._requeued:
+                self._finish(r, FinishReason.ERROR, error=exc)
+            else:
+                r._requeued = True
+                r._resume_key = key
+                r._nfill = 0
+                r._fill = np.concatenate(
+                    [r.prompt, np.asarray(r.tokens, np.int32)])
+                survivors.append(r)
+                self.stats["requeued"] += 1
+        # Requeued work goes to the FRONT in admission order: it was
+        # already accepted and partially served, and queue_limit never
+        # applies to it (shedding admitted work would turn one transient
+        # fault into data loss).
+        self._queue.extendleft(reversed(survivors))
 
     def _next_prefill_slot(self) -> int | None:
         pending = [(r._order, s) for s, r in enumerate(self._slots)
-                   if r is not None and r._nfill < r.prompt.size]
+                   if r is not None and r._nfill < r._fill.size]
         return min(pending)[1] if pending else None
 
     def _run_prefill_chunk(self, s: int, emitted) -> None:
         r = self._slots[s]
+        fill = r._fill
         start = r._nfill
-        end = min(start + self.prefill_chunk, r.prompt.size)
+        end = min(start + self.prefill_chunk, fill.size)
         buf = np.zeros((1, self.prefill_chunk), np.int32)
-        buf[0, :end - start] = r.prompt[start:end]
-        last_logits, self._cache = self._prefill_step(
-            self._cache, np.int32(s), buf,
+        buf[0, :end - start] = fill[start:end]
+        last_logits, self._cache = self._device(
+            "prefill", self._prefill_step, self._cache, np.int32(s), buf,
             np.int32(start), np.int32(end - start - 1))
         r._nfill = end
         self._len[s] = end
         self.stats["prefill_chunks"] += 1
-        if end == r.prompt.size:
-            # Prompt fully cached: the chunk's last-token logits are the
-            # request's FIRST sampling event (exactly generate()'s
-            # prefill-then-sample order).
-            tok, carry = _sample_row(
-                last_logits, self._temps[s], self._topk[s], self._topp[s],
-                self._keys[s])
+        if end == fill.size:
+            # Fill fully cached: the chunk's last-token logits are the
+            # request's next sampling event (for a fresh request, the
+            # FIRST — exactly generate()'s prefill-then-sample order;
+            # for a requeued one, event ``len(tokens) + 1`` under the
+            # resumed key chain).
+            tok, carry = self._device(
+                "sample", _sample_row, last_logits, self._temps[s],
+                self._topk[s], self._topp[s], self._keys[s])
             self._keys = self._keys.at[s].set(carry)
             self._commit(s, int(tok), emitted)
 
     def _run_decode(self, emitted) -> None:
         active = np.array(
-            [r is not None and r._nfill == r.prompt.size
+            [r is not None and r._nfill == r._fill.size
              for r in self._slots])
-        self._cache, toks, self._keys = self._decode_step(
+        self._cache, toks, self._keys = self._device(
+            "decode", self._decode_step,
             self._cache, self._last, self._len, active, self._temps,
             self._topk, self._topp, self._keys)
         toks = np.asarray(toks)
@@ -555,6 +903,85 @@ class Engine:
             self._len[s] += 1  # the fed token's KV landed this step
             self._commit(int(s), int(toks[s]), emitted)
 
+    def _quarantine_drafter(self, reason: str, r: Request | None = None,
+                            proposed: int = 0) -> None:
+        """Permanently disable a misbehaving drafter.  Drafts were only
+        ever hints, so outputs are unchanged — the engine simply runs
+        the plain decode program from the next step on (both programs
+        are already warm; no recompile).  ``proposed`` tokens that came
+        back before the fault are charged as proposed-and-rejected, so
+        acceptance accounting stays truthful."""
+        self._drafter_quarantined = True
+        self.drafter_quarantine_reason = reason
+        self.stats["drafter_quarantined"] = 1
+        if r is not None and proposed:
+            r.draft_proposed += proposed
+            self.stats["draft_tokens"] += proposed
+
+    def _gather_drafts(self, active, k):
+        """Host-side draft proposals for every decoding slot, behind the
+        fault-isolation wall: a drafter that raises, returns non-integer
+        or out-of-vocab tokens, or exceeds ``drafter_timeout_s`` per
+        propose is quarantined and this step's proposals are discarded
+        (returns None; the caller falls back to plain decode).  A buggy
+        host-side drafter can therefore never corrupt or stall the
+        stream.
+
+        Each propose runs inside a scoped watchdog deadline too (when
+        one is armed): a propose that BLOCKS outright — the one fault no
+        host-side timing check can see from inside — is detected from
+        outside like a wedged device step (``kill=True`` exits for the
+        scheduler; ``kill=False`` surfaces as a StepHangError at the
+        next guarded scope, which quarantines the drafter here)."""
+        proposed = []
+        budget = self.drafter_timeout_s
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            context = np.concatenate(
+                [r.prompt, np.asarray(r.tokens, np.int32)])
+            t0 = time.perf_counter()
+            try:
+                with self._guard(budget if budget is not None
+                                 else self._step_timeout_s):
+                    raw = self.drafter.propose(context, k)
+                draft = np.asarray(raw).reshape(-1)[:k]
+            except Exception as exc:  # noqa: BLE001 — isolation by design
+                self._quarantine_drafter(
+                    f"propose() raised {type(exc).__name__}: {exc}")
+                return None
+            took = time.perf_counter() - t0
+            if (self._watchdog is not None
+                    and self._watchdog.acknowledge()):
+                # The monitor fired WHILE propose was blocked (kill=True
+                # would have exited the process; a propose that never
+                # returns at all is exactly that case) — quarantine here
+                # so the hang is charged to the drafter, not to the next
+                # guarded device call.
+                self._quarantine_drafter(
+                    f"propose() exceeded the armed watchdog deadline "
+                    f"({took:.4f}s elapsed)", r, int(draft.size))
+                return None
+            if draft.size and draft.dtype.kind not in "iu":
+                self._quarantine_drafter(
+                    f"propose() returned non-integer tokens "
+                    f"(dtype {draft.dtype})", r, int(draft.size))
+                return None
+            if draft.size and (int(draft.min()) < 0
+                               or int(draft.max())
+                               >= self.config.vocab_size):
+                self._quarantine_drafter(
+                    "propose() returned out-of-vocab token ids",
+                    r, int(draft.size))
+                return None
+            if budget is not None and took > budget:
+                self._quarantine_drafter(
+                    f"propose() took {took:.4f}s "
+                    f"(drafter_timeout_s={budget})", r, int(draft.size))
+                return None
+            if draft.size:
+                proposed.append((int(s), draft.astype(np.int32)))
+        return proposed
+
     def _run_verify(self, emitted) -> None:
         """Draft host-side, verify device-side: up to ``speculate_k``
         proposed tokens per decoding slot ride the window with the row's
@@ -562,8 +989,9 @@ class Engine:
         next token) is committed in order.  EOS or an exhausted budget
         retires the row mid-window and the remaining emitted tokens are
         dropped — exactly the tokens sequential decode would never have
-        produced.  Out-of-range drafts are clipped (they just get
-        rejected); drafts are hints, never correctness inputs.
+        produced.  Drafts are hints, never correctness inputs — and a
+        drafter that violates even the hint contract (raise/malformed/
+        slow) is quarantined by ``_gather_drafts``.
 
         A step where NO row drafted falls through to the plain decode
         step: the k+1-wide verify forward costs real extra FLOPs per
@@ -573,29 +1001,21 @@ class Engine:
         creates a new one."""
         k = self.speculate_k
         active = np.array(
-            [r is not None and r._nfill == r.prompt.size
+            [r is not None and r._nfill == r._fill.size
              for r in self._slots])
+        proposed = self._gather_drafts(active, k)
+        if not proposed:  # nothing drafted, or the drafter just got cut
+            self._run_decode(emitted)
+            return
         tokens = np.zeros((self.num_slots, k + 1), np.int32)
         tokens[:, 0] = self._last
         n_draft = np.zeros(self.num_slots, np.int32)
-        proposed = []
-        for s in np.nonzero(active)[0]:
-            r = self._slots[s]
-            context = np.concatenate(
-                [r.prompt, np.asarray(r.tokens, np.int32)])
-            draft = np.asarray(self.drafter.propose(context, k),
-                               np.int32).reshape(-1)[:k]
-            if draft.size:
-                proposed.append((int(s), draft))
-        if not proposed:
-            self._run_decode(emitted)
-            return
         for s, draft in proposed:
-            tokens[s, 1:1 + draft.size] = np.clip(
-                draft, 0, self.config.vocab_size - 1)
+            tokens[s, 1:1 + draft.size] = draft  # validated in-vocab
             n_draft[s] = draft.size
             self._slots[s].draft_proposed += int(draft.size)
-        self._cache, out, n_emit, self._keys = self._verify_step(
+        self._cache, out, n_emit, self._keys = self._device(
+            "verify", self._verify_step,
             self._cache, tokens, self._len, active, n_draft, self._temps,
             self._topk, self._topp, self._keys)
         out = np.asarray(out)
@@ -624,13 +1044,14 @@ class Engine:
         self._last[s] = tok
         emitted.append((r, tok))
         self.stats["tokens"] += 1
-        if (len(r.tokens) >= r.max_new_tokens
-                or (r.eos_id is not None and tok == r.eos_id)):
-            self._retire(s)
+        if r.eos_id is not None and tok == r.eos_id:
+            self._retire(s, FinishReason.EOS)
+        elif len(r.tokens) >= r.max_new_tokens:
+            self._retire(s, FinishReason.COMPLETE)
 
-    def _retire(self, s: int, cancelled: bool = False) -> None:
+    def _retire(self, s: int, reason: FinishReason,
+                error: BaseException | None = None) -> None:
         r = self._slots[s]
-        r.done = True
         r._slot = None
         self._slots[s] = None
         self._len[s] = 0  # slot recycled; the next prefill overwrites from 0
@@ -641,4 +1062,4 @@ class Engine:
         self._temps[s] = 0.0
         self._topk[s] = 0
         self._topp[s] = 1.0
-        self.stats["cancelled" if cancelled else "completed"] += 1
+        self._finish(r, reason, error)

@@ -1,0 +1,154 @@
+"""Deterministic fault injection for ``tpudp.serve`` — the robustness
+layer's test fixtures and the soak harness's building blocks.
+
+The engine's robustness claims (drafter quarantine, step-failure
+containment, deadline retirement, bounded admission) are only worth
+anything if they are exercised by REPRODUCIBLE faults: a flake that
+appears once a week in production proves nothing in CI.  Every injector
+here is plain deterministic Python — which call fails, how, and when is
+fixed by constructor arguments, so a failing soak seed replays exactly.
+
+Two injection seams, both first-class engine API:
+
+  * **Drafter faults** — :class:`FailingDrafter`, :class:`SlowDrafter`,
+    and :class:`MalformedDrafter` are drop-in ``Drafter`` implementations
+    passed as ``Engine(drafter=...)``.  They violate the drafter
+    contract in the three ways a real host-side drafter can: raising,
+    stalling, and returning garbage.  The engine must quarantine them
+    (``Engine.drafter_quarantined``) without perturbing any output —
+    drafts are hints, so the referee is bit-exact greedy parity.
+  * **Step faults** — :class:`FaultySteps` and :class:`SlowSteps` are
+    ``Engine(step_fault_hook=...)`` callables invoked as
+    ``hook(kind, index)`` immediately before each jitted device call
+    (``kind`` in ``{"prefill", "sample", "decode", "verify"}``;
+    ``index`` is the engine's monotonically increasing device-call
+    counter, so a retried call gets a NEW index and a one-shot fault
+    stays one-shot).  Raising simulates a device-step failure (XLA
+    error, preempted TPU); sleeping simulates a wedged step for the
+    watchdog to catch.
+
+Used by ``tests/test_serve_robustness.py`` and the ``serve_soak`` stage
+(``benchmarks/serve_bench.py --soak``, registered in
+``tools/bench_gaps.py``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the injectors below — typed so tests can tell an
+    injected failure from an organic one."""
+
+
+class FailingDrafter:
+    """Proposes via ``inner`` for ``ok_proposals`` calls, then raises on
+    every later call — the mid-run drafter death.  ``inner=None`` makes
+    the healthy calls propose nothing (still well-formed)."""
+
+    def __init__(self, inner=None, ok_proposals: int = 0,
+                 exc_type=InjectedFault):
+        if ok_proposals < 0:
+            raise ValueError(
+                f"ok_proposals must be >= 0, got {ok_proposals}")
+        self.inner = inner
+        self.ok_proposals = ok_proposals
+        self.exc_type = exc_type
+        self.calls = 0
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        self.calls += 1
+        if self.calls > self.ok_proposals:
+            raise self.exc_type(
+                f"injected drafter failure (call {self.calls})")
+        if self.inner is None:
+            return np.zeros(0, np.int32)
+        return self.inner.propose(context, k)
+
+
+class SlowDrafter:
+    """Valid proposals delivered after ``delay_s`` — trips
+    ``Engine(drafter_timeout_s=...)``.  With ``inner=None`` it proposes
+    k copies of the context's first token (in-vocab by construction), so
+    the quarantine decision is purely about TIME, never content."""
+
+    def __init__(self, delay_s: float, inner=None):
+        self.delay_s = delay_s
+        self.inner = inner
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        time.sleep(self.delay_s)
+        if self.inner is not None:
+            return self.inner.propose(context, k)
+        context = np.asarray(context, np.int32).reshape(-1)
+        return np.full(max(k, 0), int(context[0]), np.int32)
+
+
+class MalformedDrafter:
+    """Returns structurally invalid proposals.  Modes:
+
+    * ``"out_of_vocab"`` — ids past any real vocab size
+    * ``"negative"`` — negative ids
+    * ``"float"`` — non-integer dtype
+    * ``"junk"`` — not coercible to a token array at all
+    """
+
+    MODES = ("out_of_vocab", "negative", "float", "junk")
+
+    def __init__(self, mode: str = "out_of_vocab"):
+        if mode not in self.MODES:
+            raise ValueError(f"mode must be one of {self.MODES}, "
+                             f"got {mode!r}")
+        self.mode = mode
+
+    def propose(self, context: np.ndarray, k: int):
+        k = max(k, 1)
+        if self.mode == "out_of_vocab":
+            return np.full(k, 2 ** 31 - 1, np.int64)
+        if self.mode == "negative":
+            return np.full(k, -3, np.int32)
+        if self.mode == "float":
+            return np.full(k, 0.5, np.float32)
+        return "these are not tokens"
+
+
+class FaultySteps:
+    """Step-raise hook: raises :class:`InjectedFault` when the device-
+    call ``index`` is in ``fail_at`` (optionally restricted to one step
+    ``kind``).  The hook runs before the device call, so the injected
+    failure lands exactly where a real one would: inside the engine's
+    step-containment region.  ``fired`` records what was injected."""
+
+    def __init__(self, fail_at, kind: str | None = None):
+        self.fail_at = set(fail_at)
+        self.kind = kind
+        self.fired: list[tuple[str, int]] = []
+
+    def __call__(self, kind: str, index: int) -> None:
+        if index in self.fail_at and (self.kind is None
+                                      or kind == self.kind):
+            self.fired.append((kind, index))
+            raise InjectedFault(
+                f"injected step fault at {kind} call {index}")
+
+
+class SlowSteps:
+    """Step-stall hook: sleeps ``delay_s`` before the configured device
+    calls — a deterministic stand-in for a wedged TPU step, used to
+    exercise ``Engine(watchdog=...)`` arming (the sleep happens inside
+    the watchdog's scoped deadline)."""
+
+    def __init__(self, stall_at, delay_s: float, kind: str | None = None):
+        self.stall_at = set(stall_at)
+        self.delay_s = delay_s
+        self.kind = kind
+        self.fired: list[tuple[str, int]] = []
+
+    def __call__(self, kind: str, index: int) -> None:
+        if index in self.stall_at and (self.kind is None
+                                       or kind == self.kind):
+            self.fired.append((kind, index))
+            time.sleep(self.delay_s)

@@ -59,6 +59,12 @@ class Watchdog:
         with wd.step():
             fetch_fence(state.params)  # tpudp.utils.profiler
 
+    A scope may carry its own deadline (``wd.step(timeout_s=5.0)``) so one
+    watchdog can guard regions with very different legitimate durations —
+    the serve engine wraps each blocking device call this way
+    (``tpudp.serve.Engine(watchdog=..., step_timeout_s=...)``) with a much
+    tighter budget than a training step's.
+
     ``kill=True`` (default) hard-exits the process on a hang — the correct
     behavior for a wedged collective, which no Python exception can unwind;
     the launcher/scheduler restarts the job and ``--checkpoint-dir``
@@ -129,18 +135,30 @@ class Watchdog:
             self._armed = False
             self._deadline = None
 
+    def acknowledge(self) -> bool:
+        """kill=False mode: clear a recorded hang after the caller has
+        CONTAINED it (retired/requeued the affected work), so the next
+        scoped :meth:`step` proceeds instead of re-raising a hang that was
+        already handled.  Returns whether a hang had been recorded.  The
+        serve engine calls this from its step-failure containment;
+        kill=True watchdogs never reach here (the process is gone)."""
+        seen = self._hang_seen.is_set()
+        self._hang_seen.clear()
+        return seen
+
     # -- hot path ------------------------------------------------------
     class _Step:
-        def __init__(self, wd: "Watchdog"):
+        def __init__(self, wd: "Watchdog", timeout_s: float | None = None):
             self.wd = wd
+            self.timeout_s = wd.timeout_s if timeout_s is None else timeout_s
 
         def __enter__(self):
             wd = self.wd
             if wd._hang_seen.is_set() and not wd.kill:
                 raise StepHangError(
-                    f"a previous step exceeded {wd.timeout_s}s")
+                    "a previous step exceeded its deadline")
             with wd._lock:
-                wd._deadline = time.monotonic() + wd.timeout_s
+                wd._deadline = time.monotonic() + self.timeout_s
             return self
 
         def __exit__(self, *exc):
@@ -148,8 +166,13 @@ class Watchdog:
                 self.wd._deadline = None
             return False
 
-    def step(self) -> "_Step":
-        return Watchdog._Step(self)
+    def step(self, timeout_s: float | None = None) -> "_Step":
+        """Scoped deadline; ``timeout_s`` overrides the default for this
+        one region (a serving decode step's budget is not a training
+        step's)."""
+        if timeout_s is not None and timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        return Watchdog._Step(self, timeout_s)
 
     # -- monitor -------------------------------------------------------
     def _monitor(self) -> None:
