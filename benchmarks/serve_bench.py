@@ -47,6 +47,20 @@ stays bounded while sheds absorb the burst".  Every row also carries
 ``shed``/``deadline_expired`` counters (0 when the knobs are off);
 SERVE_DEADLINE_S / SERVE_TTFT_DEADLINE_S attach per-request budgets.
 
+With ``--prefix-cache W1,W2`` (or SERVE_PREFIX) the bench instead emits
+one ``serve_prefix`` row per workload, measuring what the block-pool +
+radix-tree prefix cache (``Engine(prefix_cache_blocks=N)``,
+``tpudp.serve.prefix_cache``) buys on the traffic it exists for:
+``shared_prefix`` (every request carries the same long system prompt
+plus a short unique tail — the "millions of users behind one system
+prompt" shape) and ``multiturn`` (conversations that re-send their whole
+history each turn).  Each row runs the IDENTICAL greedy workload through
+a cache-off and a cache-on engine (greedy outputs are bit-identical
+either way — ``parity_ok`` records the bench's own check) and reports
+TTFT p50/p99 for both, the hit-token counts
+(``stats["prefix_hit_tokens"]``/``["prefix_lookups"]``), and the
+headline ``value`` = uncached/cached TTFT p50 ratio.
+
 With ``--soak SEED1,SEED2`` (or SERVE_SOAK) the bench instead runs the
 fault-injection SOAK harness (one ``serve_soak`` row per seed): a
 deterministic per-seed mix of random cancels, impossible and tight
@@ -62,10 +76,13 @@ Runs on whatever device is attached; SERVE_PLATFORM=cpu pins the CPU
 smoke mode (tier-1 runs it at a trimmed geometry).  Knobs: SERVE_CONCURRENCY
 (comma-separated subset of the registered levels — the watcher's
 gap-resume path), SERVE_SPECULATE_K (same, for the spec rows),
-SERVE_SOAK (same, for the soak rows), SERVE_SPEC_CONCURRENCY,
+SERVE_SOAK (same, for the soak rows),
+SERVE_PREFIX (same, for the prefix rows), SERVE_SPEC_CONCURRENCY,
 SERVE_REQUESTS, SERVE_PROMPT_LEN, SERVE_MAX_NEW, SERVE_LAYERS,
 SERVE_DMODEL, SERVE_VOCAB, SERVE_CHUNK, SERVE_LOAD, SERVE_SEED,
 SERVE_QUEUE_LIMIT, SERVE_DEADLINE_S, SERVE_TTFT_DEADLINE_S,
+SERVE_PREFIX_BLOCKS, SERVE_PREFIX_LEN, SERVE_PREFIX_CONCURRENCY,
+SERVE_PREFIX_USERS, SERVE_PREFIX_TURNS,
 SOAK_REQUESTS, SOAK_LAYERS, SOAK_DMODEL, SOAK_VOCAB,
 SERVE_STRICT_LEVELS=1 (reject unregistered levels/seeds).
 """
@@ -79,11 +96,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
-                              SERVE_SOAK_SEEDS, SERVE_SPEC_KS)
+                              SERVE_PREFIX_WORKLOADS, SERVE_SOAK_SEEDS,
+                              SERVE_SPEC_KS)
 
 METRIC = "serve_tokens_per_sec"
 SPEC_METRIC = "serve_spec_tokens_per_sec"
 SOAK_METRIC = "serve_soak"
+PREFIX_METRIC = "serve_prefix"
 
 
 def _percentile(xs, q):
@@ -108,6 +127,11 @@ def main() -> None:
                     help="comma-separated soak seeds; runs the "
                          "fault-injection soak harness instead of the "
                          "concurrency sweep (env: SERVE_SOAK)")
+    ap.add_argument("--prefix-cache", default=None,
+                    help="comma-separated prefix-caching workloads "
+                         "(shared_prefix, multiturn); emits TTFT "
+                         "cache-on/off rows instead of the concurrency "
+                         "sweep (env: SERVE_PREFIX)")
     ap.add_argument("--queue-limit", default=None,
                     help="bound the engine queue in the concurrency "
                          "sweep; overload sheds with QueueFull and rows "
@@ -135,12 +159,22 @@ def main() -> None:
     spec_ks = _parse_levels(spec_env) if spec_env else []
     soak_env = args.soak or os.environ.get("SERVE_SOAK")
     soak_seeds = _parse_levels(soak_env) if soak_env else []
+    prefix_env = args.prefix_cache or os.environ.get("SERVE_PREFIX")
+    prefix_workloads = ([w for w in prefix_env.split(",") if w]
+                        if prefix_env else [])
+    bad_w = [w for w in prefix_workloads
+             if w not in SERVE_PREFIX_WORKLOADS]
+    if bad_w:
+        # Always strict for names (unlike numeric levels, an unknown
+        # workload name is a typo, not an unregistered sweep point).
+        raise SystemExit(f"error: unknown prefix workloads {bad_w} "
+                         f"(registry: {list(SERVE_PREFIX_WORKLOADS)})")
     levels_env = os.environ.get("SERVE_CONCURRENCY")
     levels = (_parse_levels(levels_env)
               if levels_env else list(SERVE_CONCURRENCIES))
     if os.environ.get("SERVE_STRICT_LEVELS") == "1":
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
-        if not spec_ks and not soak_seeds and bad:
+        if not spec_ks and not soak_seeds and not prefix_workloads and bad:
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
         bad_k = [k for k in spec_ks if k not in SERVE_SPEC_KS]
@@ -180,9 +214,25 @@ def main() -> None:
     # little; measured on the 2-core host: 17M params -> 2.8x batch-8
     # scan gain, 4M params -> 2.0x).
     dm = int(os.environ.get("SERVE_DMODEL", 512))
+    # Prefix-cache axes: pool budget, the shared system prompt's length,
+    # the cached engines' slot count, and the multiturn conversation
+    # shape (users x turns, each turn re-sending the whole history).
+    prefix_blocks = int(os.environ.get("SERVE_PREFIX_BLOCKS", 64))
+    prefix_len = int(os.environ.get("SERVE_PREFIX_LEN", 4 * chunk))
+    prefix_conc = int(os.environ.get("SERVE_PREFIX_CONCURRENCY", 4))
+    prefix_users = int(os.environ.get("SERVE_PREFIX_USERS", 4))
+    prefix_turns = int(os.environ.get("SERVE_PREFIX_TURNS", 3))
+    prefix_tail = max(chunk // 2, 1)
     slack = max(spec_ks, default=0)  # speculative windows need k scratch
-    need = prompt_len + (max(max_new, spec_max_new) + slack
-                         if spec_ks else max_new)
+    if prefix_workloads:
+        # The deepest multiturn prompt is the whole prior conversation:
+        # shared prefix + `turns` user tails + (turns-1) responses, plus
+        # this turn's generation.
+        need = (prefix_len + prefix_turns * prefix_tail
+                + prefix_turns * max_new)
+    else:
+        need = prompt_len + (max(max_new, spec_max_new) + slack
+                             if spec_ks else max_new)
     cfg = GPT2Config(
         vocab_size=int(os.environ.get("SERVE_VOCAB", 8192)),
         max_seq_len=((need + chunk - 1) // chunk) * chunk,
@@ -275,7 +325,7 @@ def main() -> None:
     # against per-request generate() references, not throughput.
     seq_tps = per_req_s = None
     seq_latencies = []
-    if not spec_ks and not soak_seeds:
+    if not spec_ks and not soak_seeds and not prefix_workloads:
         np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
                             max_new))
         t0 = time.perf_counter()
@@ -526,6 +576,118 @@ def main() -> None:
             "device_kind": kind,
         })
 
+    def _prefix_engine(cache_blocks: int):
+        """Engine for the prefix rows, warmed OFF the clock: two
+        sequential identical generations compile prefill/decode/sample
+        — and, on the cached engine, the publish program (first
+        retirement) and the block-copy-in program (second admission's
+        hit).  The warm cache entries and counters are then dropped so
+        the measured run starts cold and every hit it records came
+        from the measured workload itself."""
+        e = Engine(model, params, num_slots=prefix_conc,
+                   max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                   prefix_cache_blocks=cache_blocks)
+        warm = np.arange(2 * chunk, dtype=np.int32) % cfg.vocab_size
+        e.generate_many([warm], 2)
+        e.generate_many([warm], 2)
+        if e.prefix_cache is not None:
+            e.prefix_cache.flush()
+            for key in ("prefix_lookups", "prefix_hit_tokens",
+                        "prefix_published_blocks"):
+                e.stats[key] = 0
+        return e
+
+    def run_prefix(workload: str) -> None:
+        """One prefix-caching row: the IDENTICAL greedy workload through
+        a cache-off and a cache-on engine (greedy outputs bit-identical
+        either way — the row's own parity_ok double-checks the tests'
+        contract), TTFT percentiles for both, and the cache-on engine's
+        hit accounting.  ``shared_prefix``: all requests = one long
+        system prompt + a short unique tail, submitted as a burst.
+        ``multiturn``: ``prefix_users`` conversations of
+        ``prefix_turns`` turns; every turn re-sends the whole history
+        plus a new user tail, so from turn 2 on the history is a cache
+        hit."""
+        prng = np.random.default_rng(seed + 3)
+        shared = prng.integers(0, cfg.vocab_size,
+                               size=prefix_len).astype(np.int32)
+
+        if workload == "shared_prefix":
+            reqs = [np.concatenate([shared, prng.integers(
+                0, cfg.vocab_size, size=prefix_tail).astype(np.int32)])
+                for _ in range(n_requests)]
+
+            def run(e):
+                offsets = np.zeros(len(reqs))
+                elapsed, _lat, ttfts, handles, _shed = drive(
+                    e, offsets, reqs, max_new)
+                tokens = sum(len(h.tokens) for h in handles)
+                return elapsed, ttfts, tokens, [h.tokens for h in handles]
+        else:  # multiturn
+            opening = [np.concatenate([shared, prng.integers(
+                0, cfg.vocab_size, size=prefix_tail).astype(np.int32)])
+                for _ in range(prefix_users)]
+            extras = [[prng.integers(0, cfg.vocab_size, size=prefix_tail)
+                       .astype(np.int32) for _ in range(prefix_turns - 1)]
+                      for _ in range(prefix_users)]
+
+            def run(e):
+                ttfts, outputs = [], []
+                tokens = 0
+                hist = list(opening)
+                t0 = time.perf_counter()
+                for t in range(prefix_turns):
+                    handles = [e.submit(hist[u], max_new, seed=seed + u)
+                               for u in range(prefix_users)]
+                    e.run_until_complete()
+                    for u, h in enumerate(handles):
+                        ttfts.append(h.token_times[0] - h.submit_time)
+                        tokens += len(h.tokens)
+                        outputs.append(h.tokens)
+                        if t + 1 < prefix_turns:
+                            hist[u] = np.concatenate(
+                                [h.result(), extras[u][t]])
+                return time.perf_counter() - t0, ttfts, tokens, outputs
+
+        off = _prefix_engine(0)
+        off_elapsed, off_ttfts, off_tokens, off_out = run(off)
+        on = _prefix_engine(prefix_blocks)
+        on_elapsed, on_ttfts, on_tokens, on_out = run(on)
+        on_p50 = _percentile(on_ttfts, 50)
+        off_p50 = _percentile(off_ttfts, 50)
+        emit({
+            "metric": PREFIX_METRIC,
+            "workload": workload,
+            "value": (round(off_p50 / on_p50, 3)
+                      if on_p50 and off_p50 else None),
+            "unit": "ttft_p50_speedup",
+            "ttft_p50_ms": round(on_p50 * 1e3, 3),
+            "ttft_p99_ms": round(_percentile(on_ttfts, 99) * 1e3, 3),
+            "ttft_p50_off_ms": round(off_p50 * 1e3, 3),
+            "ttft_p99_off_ms": round(
+                _percentile(off_ttfts, 99) * 1e3, 3),
+            "tokens_per_sec": round(on_tokens / on_elapsed, 1)
+            if on_elapsed > 0 else None,
+            "tokens_per_sec_off": round(off_tokens / off_elapsed, 1)
+            if off_elapsed > 0 else None,
+            "prefix_hit_tokens": int(on.stats["prefix_hit_tokens"]),
+            "prefix_lookups": int(on.stats["prefix_lookups"]),
+            "prefix_published_blocks": int(
+                on.stats["prefix_published_blocks"]),
+            "parity_ok": on_out == off_out,
+            "cache_blocks": prefix_blocks,
+            "concurrency": prefix_conc,
+            "requests": (n_requests if workload == "shared_prefix"
+                         else prefix_users * prefix_turns),
+            "prefix_len": prefix_len,
+            "max_new_tokens": max_new,
+            "prefill_chunk": chunk,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        })
+
     # One level crashing (OOM, transient backend fault) must not cost
     # the remaining rows — same isolation contract as matrix_bench.
     if soak_seeds:
@@ -536,6 +698,15 @@ def main() -> None:
                 emit({"metric": SOAK_METRIC, "seed": s,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
         print(json.dumps({"serve_soak": results}))
+        return
+    if prefix_workloads:
+        for w in prefix_workloads:
+            try:
+                run_prefix(w)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": PREFIX_METRIC, "workload": w,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        print(json.dumps({"serve_prefix": results}))
         return
     if spec_ks:
         # One zero tree for the whole sweep: a fresh tree per k would

@@ -16,6 +16,10 @@ this demo is about throughput and interleaving, not different text.
   # tokens per forward, outputs bit-identical (greedy) either way:
   python examples/serve_gpt2.py --speculate-k 4 --platform cpu
 
+  # Prefix caching: requests sharing a prompt prefix copy cached KV
+  # blocks instead of re-prefilling (outputs bit-identical either way):
+  python examples/serve_gpt2.py --prefix-cache-blocks 64 --platform cpu
+
   # Restore a train_gpt2.py checkpoint (params-only, like generate_gpt2):
   python examples/serve_gpt2.py --checkpoint-dir ckpt --layers 4 ...
 
@@ -59,6 +63,11 @@ def main() -> None:
                         "step via n-gram prompt lookup and verify them "
                         "in one forward (0 = off; output is identical "
                         "either way for greedy decoding)")
+    p.add_argument("--prefix-cache-blocks", type=int, default=0,
+                   help="prefix caching: pool this many KV blocks so "
+                        "requests sharing a prompt prefix copy cached "
+                        "blocks instead of re-prefilling (0 = off; "
+                        "output is identical either way)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
@@ -71,6 +80,9 @@ def main() -> None:
     if args.speculate_k < 0:
         raise SystemExit(f"error: --speculate-k must be >= 0 (got "
                          f"{args.speculate_k})")
+    if args.prefix_cache_blocks < 0:
+        raise SystemExit(f"error: --prefix-cache-blocks must be >= 0 "
+                         f"(got {args.prefix_cache_blocks})")
 
     if args.platform:
         import jax
@@ -124,7 +136,8 @@ def main() -> None:
     engine = Engine(model, params, num_slots=args.num_slots,
                     prefill_chunk=math.gcd(args.prefill_chunk,
                                            args.seq_len),
-                    speculate_k=args.speculate_k)
+                    speculate_k=args.speculate_k,
+                    prefix_cache_blocks=args.prefix_cache_blocks)
 
     # Mixed-length prompts from the training examples' deterministic
     # corpus draw (same generator family as train_gpt2.py).
@@ -161,6 +174,11 @@ def main() -> None:
         spec = (f" | verify steps={engine.stats['verify_steps']} "
                 f"draft acceptance="
                 f"{'n/a' if rate is None else f'{rate:.2f}'}")
+    if args.prefix_cache_blocks:
+        spec += (f" | prefix hit tokens="
+                 f"{engine.stats['prefix_hit_tokens']} "
+                 f"(pool {engine.prefix_cache.used_blocks}"
+                 f"/{args.prefix_cache_blocks} blocks)")
     print(f"[serve] {args.requests} requests, {total} tokens in {dt:.3f}s "
           f"({total / dt:.1f} tokens/sec incl. compile) | "
           f"decode steps={engine.stats['decode_steps']} "

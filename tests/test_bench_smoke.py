@@ -187,6 +187,77 @@ def test_matrix_bench_rows_parse():
     assert configs["dp_ring"]["ring_direction"] == "uni"
 
 
+def test_serve_prefix_bench_rows_parse():
+    """The serve_prefix stage's CPU smoke (tier-1's guard on the bench
+    path the TPU watcher resumes): both registered workloads emit a
+    parseable row with real cache traffic (prefix_hit_tokens > 0) and
+    bit-exact parity between the cached and uncached engines."""
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu",
+        "SERVE_PREFIX": "shared_prefix,multiturn",
+        "SERVE_LAYERS": "1", "SERVE_DMODEL": "64", "SERVE_VOCAB": "128",
+        "SERVE_REQUESTS": "4", "SERVE_MAX_NEW": "8", "SERVE_CHUNK": "8",
+        "SERVE_PREFIX_LEN": "24", "SERVE_PREFIX_TURNS": "2",
+        "SERVE_PREFIX_USERS": "2", "SERVE_PREFIX_CONCURRENCY": "2",
+        "SERVE_PREFIX_BLOCKS": "16",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byw = {r["workload"]: r for r in rows
+           if r.get("metric") == "serve_prefix" and "workload" in r}
+    assert set(byw) == {"shared_prefix", "multiturn"}, proc.stderr[-800:]
+    for r in byw.values():
+        assert "error" not in r, r
+        assert r["value"] > 0
+        assert r["prefix_hit_tokens"] > 0   # the cache actually served
+        assert r["prefix_lookups"] > 0
+        assert r["parity_ok"] is True       # bit-exact vs the uncached run
+        assert r["ttft_p50_ms"] > 0 and r["ttft_p50_off_ms"] > 0
+    # unregistered workload names fail fast, like BENCH_PARAM_DTYPE typos
+    bad = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu", "SERVE_PREFIX": "shared_prefx"},
+        timeout=300)
+    assert bad.returncode != 0
+    assert "prefix workloads" in (bad.stderr + bad.stdout)
+
+
+def test_serve_prefix_gap_gate(tmp_path):
+    """tools/bench_gaps serve_prefix stage: CPU smoke rows, error rows,
+    parity-broken rows, and zero-hit rows never close a workload;
+    banked TPU rows with real cache traffic do (the watcher's
+    window-accumulation contract, same rules as the serve stage)."""
+    from tools.bench_gaps import SERVE_PREFIX_WORKLOADS, serve_prefix_missing
+
+    d = str(tmp_path)
+    assert serve_prefix_missing(d) == list(SERVE_PREFIX_WORKLOADS)
+    rows = [
+        {"metric": "serve_prefix", "workload": "shared_prefix",
+         "value": 1.4, "prefix_hit_tokens": 640, "parity_ok": True,
+         "device_kind": "cpu"},                       # smoke: no
+        {"metric": "serve_prefix", "workload": "multiturn",
+         "error": "relay wedged"},                    # error: no
+        {"metric": "serve_prefix", "workload": "multiturn",
+         "value": 2.0, "prefix_hit_tokens": 0, "parity_ok": True,
+         "device_kind": "TPU v5 lite"},               # no hits: no
+        {"metric": "serve_prefix", "workload": "shared_prefix",
+         "value": 2.0, "prefix_hit_tokens": 512, "parity_ok": False,
+         "device_kind": "TPU v5 lite"},               # parity broken: no
+        {"metric": "serve_prefix", "workload": "shared_prefix",
+         "value": 1.8, "prefix_hit_tokens": 512, "parity_ok": True,
+         "device_kind": "TPU v5 lite"},               # real: yes
+    ]
+    with open(os.path.join(d, "serve_prefix.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_prefix_missing(d) == ["multiturn"]
+    with open(os.path.join(d, "serve_prefix.history.jsonl"), "w") as f:
+        f.write(json.dumps(
+            {"metric": "serve_prefix", "workload": "multiturn",
+             "value": 1.2, "prefix_hit_tokens": 96, "parity_ok": True,
+             "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_prefix_missing(d) == []  # banked history row counts
+
+
 def test_bad_param_dtype_fails_fast():
     """BENCH_PARAM_DTYPE typos (e.g. 'bf16') must exit with an error before
     any measurement — a silent fp32 run recorded as 'bf16' would be a false

@@ -93,6 +93,24 @@ def test_train_then_generate_checkpoint_roundtrip(tmp_path):
     assert "RANDOM-INIT" not in gen.stdout
     assert "tokens: [" in gen.stdout
 
+    # A --seq-len SHORTER than the trained context is valid and safe
+    # (every decoded position stays inside the wpe table; round-5
+    # advisor: the old exact-equality guard rejected it needlessly).
+    short = _run("generate_gpt2.py",
+                 tiny[:6] + ["--seq-len", "8", "--max-new-tokens", "4",
+                             "--prompt-ids", "1,2", "--checkpoint-dir", ck])
+    assert short.returncode == 0, short.stderr[-800:]
+    assert "restored params from" in short.stdout
+    assert "tokens: [" in short.stdout
+
+    # A --seq-len LONGER than the trained table is the real clamp
+    # hazard and must still be refused loudly.
+    long = _run("generate_gpt2.py",
+                tiny[:6] + ["--seq-len", "32", "--max-new-tokens", "4",
+                            "--checkpoint-dir", ck])
+    assert long.returncode != 0
+    assert "wpe" in (long.stderr + long.stdout)
+
 
 @pytest.mark.parametrize("script,args", [
     ("train_vit.py", ["--steps", "2", "--batch-size", "16",

@@ -17,6 +17,9 @@ the missing work as arguments the benches accept:
     python tools/bench_gaps.py serve_spec -> comma-separated speculate_k
                                            values (speculative-serving
                                            rows missing)
+    python tools/bench_gaps.py serve_prefix -> comma-separated prefix-
+                                           caching workloads (TTFT
+                                           cache-on/off rows missing)
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -41,6 +44,13 @@ SERVE_CONCURRENCIES = (1, 4, 8)
 # --speculate-k, n-gram drafting vs the non-speculative baseline) must
 # measure on the TPU; same registry contract.
 SERVE_SPEC_KS = (2, 4, 8)
+# Prefix-caching workloads (serve_bench.py --prefix-cache: TTFT with the
+# block-pool + radix-tree cache on vs off on shared-system-prompt and
+# multi-turn traffic) that must be measured on the TPU; same registry
+# contract.  A row closes its workload only with real cache traffic
+# (prefix_hit_tokens > 0) and bit-exact parity between the cached and
+# uncached engines.
+SERVE_PREFIX_WORKLOADS = ("shared_prefix", "multiturn")
 # Fault-injection soak seeds (serve_bench.py --soak: random cancels,
 # deadline mix, injected drafter/step faults against the serve engine's
 # robustness layer) that must PASS on the TPU — a seed is closed only by
@@ -156,6 +166,27 @@ def serve_spec_missing(d: str) -> list[int]:
                 and "TPU" in str(r.get("device_kind", ""))):
             done.add(r["speculate_k"])
     return [k for k in SERVE_SPEC_KS if k not in done]
+
+
+def serve_prefix_missing(d: str) -> list[str]:
+    """Prefix-caching workloads still lacking a real TPU measurement.
+    A row closes its workload only when it measured something (a
+    positive TTFT speedup), actually exercised the cache
+    (``prefix_hit_tokens > 0`` — a run whose lookups all missed proved
+    nothing about reuse), and kept bit-exact parity between the cached
+    and uncached engines (``parity_ok``).  CPU smoke and error rows
+    never close a workload (same rules as serve_missing).  Comma-ready
+    for SERVE_PREFIX so a window resumes the sweep mid-way."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_prefix.jsonl")):
+        if (r.get("metric") == "serve_prefix"
+                and r.get("workload") in SERVE_PREFIX_WORKLOADS
+                and measured(r)
+                and r.get("prefix_hit_tokens", 0) > 0
+                and r.get("parity_ok") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["workload"])
+    return [w for w in SERVE_PREFIX_WORKLOADS if w not in done]
 
 
 def serve_soak_missing(d: str) -> list[int]:
@@ -279,7 +310,8 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
                                      "collective", "lever", "serve",
-                                     "serve_spec", "serve_soak"])
+                                     "serve_spec", "serve_soak",
+                                     "serve_prefix"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -296,6 +328,8 @@ def main() -> None:
     elif args.stage == "serve_soak":
         print(",".join(str(s) for s in serve_soak_missing(args.dir)),
               end="")
+    elif args.stage == "serve_prefix":
+        print(",".join(serve_prefix_missing(args.dir)), end="")
     elif args.stage == "collective":
         print("collective" if collective_missing(args.dir) else "", end="")
     elif args.stage == "lever":

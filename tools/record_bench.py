@@ -208,6 +208,31 @@ def main() -> None:
                   f"p50 {r.get('ttft_p50_ms')} ms) | "
                   f"`serve_bench.py --speculate-k` | |")
 
+    # Prefix-caching rows: TTFT with the block-pool cache on vs off on
+    # the shared-prefix / multi-turn workloads, plus the hit accounting
+    # that proves the cache actually served blocks (the gate's
+    # prefix_hit_tokens > 0 criterion, bench_gaps.serve_prefix_missing).
+    pref = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_prefix.jsonl"))
+         if "workload" in r and "serve_prefix" not in r), "workload")
+    for r in sorted(pref.values(), key=lambda r: str(r.get("workload"))):
+        if not measured(r) or r.get("parity_ok") is not True:
+            why = r.get("error") or (
+                "parity broken" if r.get("parity_ok") is False
+                else "no real measurement")
+            print(f"| serve_prefix {r.get('workload')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --prefix-cache` | |")
+        else:
+            print(f"| prefix caching, {r['workload']} "
+                  f"(cache {r.get('cache_blocks')} blocks) | TTFT p50 "
+                  f"{r.get('ttft_p50_ms')} ms vs "
+                  f"{r.get('ttft_p50_off_ms')} ms uncached "
+                  f"(**{r['value']}x**, p99 {r.get('ttft_p99_ms')} vs "
+                  f"{r.get('ttft_p99_off_ms')} ms, "
+                  f"{r.get('prefix_hit_tokens')} hit tokens over "
+                  f"{r.get('prefix_lookups')} lookups, parity intact) | "
+                  f"`serve_bench.py --prefix-cache` | |")
+
     # Soak rows render pass/fail: a soak that wedged, leaked, or broke
     # parity is a robustness FAILURE even if it "measured" something —
     # the same criteria as bench_gaps.serve_soak_missing, so recorder

@@ -95,6 +95,10 @@ serve_soak_ok() {
   local out; out=$(python tools/bench_gaps.py serve_soak) || return 1
   [ -z "$out" ]
 }
+serve_prefix_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_prefix) || return 1
+  [ -z "$out" ]
+}
 mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
@@ -343,6 +347,20 @@ while true; do
         > bench_results/serve_spec.jsonl 2> bench_results/serve_spec.err
       log "serve_spec_bench rc=$? -> bench_results/serve_spec.jsonl"
     fi
+    if serve_prefix_ok; then
+      log "serve_prefix.jsonl already good; skipping prefix-cache bench"
+    else
+      # Prefix caching (block-pool + radix-tree KV reuse,
+      # tpudp.serve.prefix_cache): TTFT cache-on vs cache-off on the
+      # shared-system-prompt and multi-turn workloads — resumes at
+      # workload granularity via bench_gaps, like the serve_spec stage.
+      bank bench_results/serve_prefix.jsonl
+      ensure_window
+      SERVE_PREFIX="$(python tools/bench_gaps.py serve_prefix)" \
+        timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
+        > bench_results/serve_prefix.jsonl 2> bench_results/serve_prefix.err
+      log "serve_prefix_bench rc=$? -> bench_results/serve_prefix.jsonl"
+    fi
     if serve_soak_ok; then
       log "serve_soak.jsonl already good; skipping serve soak"
     else
@@ -387,7 +405,7 @@ while true; do
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
         && lever_ok && collective_ok && serve_ok && serve_spec_ok \
-        && serve_soak_ok; then
+        && serve_soak_ok && serve_prefix_ok; then
       log "battery done"
       exit 0
     fi

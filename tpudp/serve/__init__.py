@@ -1,14 +1,15 @@
 """tpudp.serve — continuous-batching inference (slot scheduler, chunked
-prefill, streaming decode, speculative decoding, robustness layer:
-bounded admission, deadlines, fault isolation, graceful drain).  See
-docs/SERVING.md; deterministic fault injectors live in
-``tpudp.serve.faults``."""
+prefill, streaming decode, speculative decoding, prefix caching,
+robustness layer: bounded admission, deadlines, fault isolation,
+graceful drain).  See docs/SERVING.md; deterministic fault injectors
+live in ``tpudp.serve.faults``."""
 
 from tpudp.serve.engine import (TRACE_COUNTS, Engine, EngineClosed,
                                 FinishReason, QueueFull, Request,
                                 RequestFailed)
+from tpudp.serve.prefix_cache import PrefixCache
 from tpudp.serve.speculate import Drafter, DraftModelDrafter, NgramDrafter
 
 __all__ = ["Engine", "Request", "TRACE_COUNTS", "Drafter",
            "DraftModelDrafter", "NgramDrafter", "FinishReason",
-           "QueueFull", "EngineClosed", "RequestFailed"]
+           "PrefixCache", "QueueFull", "EngineClosed", "RequestFailed"]

@@ -45,6 +45,23 @@ Design (static shapes everywhere — the TPU rule that shapes are compile
     sampled output reproducible regardless of admission order or which
     requests are co-resident — greedy requests are bit-identical to
     standalone ``generate()`` (the parity tests referee).
+  * **Prefix caching** (``prefix_cache_blocks > 0``) — a block-granular
+    KV pool + radix tree over token prefixes (``tpudp.serve.
+    prefix_cache``; blocks sized to ``prefill_chunk`` so cache
+    granularity aligns with chunk boundaries).  On admission the
+    scheduler looks up the longest cached block-aligned prefix of the
+    request's fill and COPIES those blocks into the slot's arena rows
+    (one compiled ``dynamic_update_slice`` program, traced
+    block/slot/pos scalars — compile-once like every other step),
+    prefilling only the uncached tail; on retirement the slot's
+    block-aligned PREFILLED prefix is published back to the pool
+    (insert-or-ref in the radix tree, cold unreferenced leaves evicted
+    under the block budget).  Prefill is deterministic given tokens and
+    only chunk-prefilled positions are ever published, so copied KV
+    equals recomputed KV bit-for-bit and greedy outputs stay identical
+    to ``generate()`` (``stats["prefix_hit_tokens"]`` /
+    ``stats["prefix_lookups"]`` account the traffic; ``0`` blocks — the
+    default — disables the subsystem byte-for-byte).
   * **Speculative decoding** (``speculate_k > 0``) — a host-side drafter
     (``tpudp.serve.speculate``) proposes up to k tokens per decoding
     slot; ONE verify forward scores the ``k+1``-token window at per-row
@@ -409,6 +426,15 @@ class Engine:
     past ``max_len``), so ``prompt + max_new_tokens + speculate_k`` must
     fit in ``max_len``.
 
+    ``prefix_cache_blocks > 0`` turns on prefix caching
+    (``tpudp.serve.prefix_cache``): retired requests publish their
+    block-aligned prefilled KV into a block pool indexed by a radix
+    tree, and a new request whose fill shares a cached block-aligned
+    prefix copies those blocks instead of re-prefilling them (greedy
+    outputs bit-identical either way; ``0`` — the default — disables
+    the subsystem byte-for-byte, stats keys included).  The public
+    handle is :attr:`prefix_cache` (``None`` when off).
+
     Robustness knobs (see the module docstring): ``queue_limit`` bounds
     the submit queue (:class:`QueueFull` sheds overload);
     ``drafter_timeout_s`` is the per-propose budget past which the
@@ -423,6 +449,7 @@ class Engine:
     def __init__(self, model, params: dict, *, num_slots: int = 8,
                  max_len: int | None = None, prefill_chunk: int = 16,
                  speculate_k: int = 0, drafter=None,
+                 prefix_cache_blocks: int = 0,
                  queue_limit: int | None = None,
                  drafter_timeout_s: float | None = None,
                  watchdog=None, step_timeout_s: float | None = None,
@@ -437,6 +464,10 @@ class Engine:
         if speculate_k < 0:
             raise ValueError(
                 f"speculate_k must be >= 0, got {speculate_k}")
+        if prefix_cache_blocks < 0:
+            raise ValueError(
+                f"prefix_cache_blocks must be >= 0 (0 disables prefix "
+                f"caching), got {prefix_cache_blocks}")
         if drafter is not None and speculate_k == 0:
             raise ValueError("drafter requires speculate_k >= 1 "
                              "(speculation is off at k=0)")
@@ -489,6 +520,18 @@ class Engine:
         self.drafter = drafter
         (self._decode_step, self._verify_step,
          self._prefill_step) = _engine_steps(cfg, params)
+        # Prefix cache: blocks sized to prefill_chunk so a cached block
+        # boundary is always a chunk boundary (imported lazily — the
+        # module imports TRACE_COUNTS from here, and the cache is
+        # optional).  None when off: every prefix-cache code path below
+        # is gated on it, so prefix_cache_blocks=0 is byte-for-byte the
+        # pre-cache engine (stats keys and trace counts included).
+        self.prefix_cache = None
+        if prefix_cache_blocks:
+            from tpudp.serve.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(cfg, prefix_cache_blocks,
+                                            prefill_chunk)
 
         self._cache = KVCache.zeros(cfg, num_slots, self.max_len)
         self._keys = jnp.zeros((num_slots, 2), jnp.uint32)
@@ -644,9 +687,17 @@ class Engine:
         emitted: list[tuple[Request, int]] = []
         if self._closed:
             return emitted
-        self._expire_deadlines()
-        self._admit()
         try:
+            # Deadline expiry and admission sit INSIDE the containment
+            # region: with prefix caching on, a deadline retirement can
+            # publish KV blocks and admission runs block copies (which
+            # donate the arena) — a failure (or a pending watchdog hang
+            # surfacing in a guard) must requeue + rebuild like any
+            # other step failure instead of escaping to the caller.
+            # Cache off, neither touches device state and this changes
+            # nothing.
+            self._expire_deadlines()
+            self._admit()
             slot = self._next_prefill_slot()
             if slot is not None:
                 self._run_prefill_chunk(slot, emitted)
@@ -763,6 +814,87 @@ class Engine:
                    else jax.random.PRNGKey(r.seed))
             self._keys = self._keys.at[s].set(key)
             self.stats["admitted"] += 1
+            if self.prefix_cache is not None:
+                self._admit_prefix(s, r)
+
+    def _admit_prefix(self, s: int, r: Request) -> None:
+        """Cache-hit admission: copy the longest cached block-aligned
+        prefix of the request's fill into its slot and skip that much
+        prefill.  Never copies the WHOLE fill — the final chunk is
+        always prefilled so its last-token logits feed the request's
+        first sampling event, exactly generate()'s prefill-then-sample
+        order (and exactly what a cold run computes, so outputs stay
+        bit-identical).  Each block rides one call of the ONE compiled
+        block-copy program; hit blocks are pinned for the copies so the
+        eviction scan can never free a block mid-reuse."""
+        from tpudp.serve import prefix_cache as _pc
+
+        cache = self.prefix_cache
+        self.stats["prefix_lookups"] += 1
+        blocks = cache.lookup(r._fill)
+        n_copy = min(len(blocks), (r._fill.size - 1) // self.prefill_chunk)
+        hit = n_copy * self.prefill_chunk
+        self.stats["prefix_hit_tokens"] += hit
+        if not n_copy:
+            return
+        cache.pin(blocks[:n_copy])
+        try:
+            for i in range(n_copy):
+                self._cache = self._device(
+                    "prefix_in", _pc.copy_block_in, self._cache,
+                    cache.pool, np.int32(blocks[i]), np.int32(s),
+                    np.int32(i * self.prefill_chunk))
+        finally:
+            cache.unpin(blocks[:n_copy])
+        r._nfill = hit
+        self._len[s] = hit
+
+    def _publish_prefix(self, s: int, r: Request) -> None:
+        """Retirement-time publish: insert the slot's block-aligned
+        PREFILLED prefix into the pool (insert-or-ref) and copy the KV
+        of any newly allocated blocks out of the arena.  Only
+        chunk-prefilled positions qualify (``r._nfill``, never
+        decode/verify-produced KV): every published block's contents
+        are then the deterministic chunked-prefill function of its
+        token prefix, which is what makes a later hit bit-identical to
+        recomputation.  Publishing is an optimization, never
+        load-bearing: any failure (including an injected device fault)
+        flushes the cache — with a fresh pool buffer, since the failed
+        call had the pool donated — and the retirement proceeds.  The
+        ARENA is read-only in the copy-out program, so a publish
+        failure never forces an arena rebuild."""
+        from tpudp.serve import prefix_cache as _pc
+
+        from tpudp.utils.watchdog import StepHangError
+
+        cache = self.prefix_cache
+        n_blocks = min(r._nfill, r._fill.size) // self.prefill_chunk
+        if not n_blocks:
+            return
+        try:
+            new = cache.publish(r._fill, n_blocks)
+            for block, start in new:
+                cache.pool = self._device(
+                    "prefix_out", _pc.copy_block_out, self._cache,
+                    cache.pool, np.int32(block), np.int32(s),
+                    np.int32(start))
+            self.stats["prefix_published_blocks"] += len(new)
+        except StepHangError:
+            # A pending watchdog hang surfaced in the publish guard: a
+            # DEVICE-HEALTH signal, not a cache fault — don't charge it
+            # to the cache.  Un-publish the blocks whose copies never
+            # ran (flush) and re-raise so step()'s containment handles
+            # it (acknowledge + arena rebuild); raised from a
+            # user-called cancel()/close() the hang flag stays set, so
+            # the next step's first device call re-raises and contains.
+            cache.flush(reallocate=True)
+            self.stats["prefix_flushes"] += 1
+            raise
+        except Exception as exc:  # noqa: BLE001 — publish is best-effort
+            cache.flush(reallocate=True)
+            self.stats["prefix_flushes"] += 1
+            self.stats["prefix_publish_failures"] += 1
+            self.last_step_error = exc
 
     def _finish(self, r: Request, reason: FinishReason,
                 error: BaseException | None = None) -> None:
@@ -828,6 +960,14 @@ class Engine:
             self._watchdog.acknowledge()  # handled; next scope may proceed
         self._cache = KVCache.zeros(self.config, self.num_slots,
                                     self.max_len)
+        # A rebuilt arena invalidates the published blocks wholesale:
+        # the failed call may have been a block copy with either buffer
+        # donated, and after an arbitrary device fault conservatism
+        # wins over proving which buffers survived — the cache re-warms
+        # from the traffic, correctness never depended on it.
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush(reallocate=True)
+            self.stats["prefix_flushes"] += 1
         survivors: list[Request] = []
         for s in sorted(
                 (s for s, r in enumerate(self._slots) if r is not None),
@@ -1052,6 +1192,15 @@ class Engine:
     def _retire(self, s: int, reason: FinishReason,
                 error: BaseException | None = None) -> None:
         r = self._slots[s]
+        # Publish BEFORE the slot state is cleared (the copy-out reads
+        # the slot's arena rows).  Every retirement reason qualifies:
+        # the prefilled prefix is valid KV regardless of why the
+        # request stopped (a cancelled/expired request's re-usable
+        # prefix is exactly as good as a completed one's).  Skipped
+        # once drain()/close() has begun — device copies to warm a pool
+        # no future request can ever read would only slow shutdown.
+        if self.prefix_cache is not None and self._accepting:
+            self._publish_prefix(s, r)
         r._slot = None
         self._slots[s] = None
         self._len[s] = 0  # slot recycled; the next prefill overwrites from 0

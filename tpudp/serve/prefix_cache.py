@@ -1,0 +1,295 @@
+"""Prefix caching for ``tpudp.serve`` — block-granular KV pool + radix
+tree reuse.
+
+Real serving traffic repeats itself: one system prompt in front of
+millions of requests, few-shot headers shared across a tenant, multi-turn
+conversations whose every turn re-sends the whole history.  The engine
+(PR 1-3) re-prefills those shared tokens per request — the dominant TTFT
+cost for exactly the traffic the ROADMAP north star names.  This module
+converts repeated prefills into KV block copies:
+
+  * **Block-granular KV pool** — ONE preallocated ``(layers,
+    cache_blocks, block_tokens, kv_heads, head_dim)`` :class:`KVCache`
+    twin of the engine's slot arena, where ``block_tokens`` equals the
+    engine's ``prefill_chunk`` so cache granularity aligns exactly with
+    chunk boundaries.  A block holds the KV of one chunk of some token
+    prefix.  Like everything else in the engine, shapes never depend on
+    the workload: publishing and reusing blocks moves DATA through two
+    fixed-shape programs, never reshapes anything.
+  * **Radix tree over token prefixes** — each edge is one
+    ``block_tokens``-token chunk; a node maps that chunk (in the context
+    of its ancestors) to the pool block holding its KV.  Per-node
+    ``refs`` count children plus explicit pins; a node with live
+    references is NEVER evicted (evicting an interior node would orphan
+    descendants whose KV is only meaningful in its context).  Eviction
+    takes the least-recently-touched unreferenced leaf, under the
+    ``cache_blocks`` budget — a logical clock, not wall time, so tests
+    replay deterministically.
+  * **Two compiled copy programs** — :func:`copy_block_in` (pool block ->
+    arena slot rows, used at admission) and :func:`copy_block_out`
+    (arena slot rows -> pool block, used at retirement).  Block id, slot
+    index, and position are traced scalars, so each program compiles
+    once per (arena, pool) geometry and cache churn never recompiles
+    (``TRACE_COUNTS`` observes this; tests pin it).
+
+Why copied KV is bit-identical to recomputed KV: prefill is a
+deterministic function of the token prefix, and the engine publishes
+ONLY chunk-prefilled positions (never decode/verify-produced KV) at the
+same chunk alignment every request uses (chunks always start at
+multiples of ``prefill_chunk`` from position 0).  A request that copies
+blocks ``0..m-1`` and prefills the tail therefore lands exactly the
+arena state it would have computed from scratch — no attention-math
+changes anywhere, so greedy outputs stay bit-identical to
+``generate()`` (``tests/test_prefix_cache.py`` referees, speculation and
+step-failure rebuilds included).
+
+The tree/pool metadata here is plain host-side Python (the same
+host-schedules/device-computes split as the engine); the engine owns the
+device calls so they run behind its fault-injection and watchdog seams.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+from tpudp.models.generate import KVCache
+from tpudp.serve.engine import TRACE_COUNTS
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def copy_block_in(cache, pool, block, slot, pos):
+    """Copy pool block ``block`` into arena slot ``slot`` at positions
+    ``[pos, pos + block_tokens)`` — the admission-time cache hit.  One
+    ``dynamic_update_slice`` per (k, v); ``block``/``slot``/``pos`` are
+    traced scalars, so this compiles once per (arena, pool) geometry no
+    matter which blocks which requests reuse.  The arena is donated
+    (XLA writes the rows in place); the pool is read-only here and
+    stays valid."""
+    TRACE_COUNTS["prefix_block_in"] += 1
+    k = lax.dynamic_slice_in_dim(pool.k, block, 1, axis=1)
+    v = lax.dynamic_slice_in_dim(pool.v, block, 1, axis=1)
+    return KVCache(
+        lax.dynamic_update_slice(cache.k, k, (0, slot, pos, 0, 0)),
+        lax.dynamic_update_slice(cache.v, v, (0, slot, pos, 0, 0)))
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def copy_block_out(cache, pool, block, slot, pos):
+    """Copy arena slot ``slot`` positions ``[pos, pos + block_tokens)``
+    into pool block ``block`` — the retirement-time publish.  The POOL
+    is donated (updated in place); the arena is read-only and stays
+    valid, which is why a failed publish never forces an arena
+    rebuild."""
+    TRACE_COUNTS["prefix_block_out"] += 1
+    layers, _, block_tokens, kv_heads, head_dim = pool.k.shape
+    sizes = (layers, 1, block_tokens, kv_heads, head_dim)
+    k = lax.dynamic_slice(cache.k, (0, slot, pos, 0, 0), sizes)
+    v = lax.dynamic_slice(cache.v, (0, slot, pos, 0, 0), sizes)
+    return KVCache(
+        lax.dynamic_update_slice(pool.k, k, (0, block, 0, 0, 0)),
+        lax.dynamic_update_slice(pool.v, v, (0, block, 0, 0, 0)))
+
+
+class _Node:
+    """One radix-tree edge: ``key`` (the chunk's token tuple) maps — in
+    the context of ``parent``'s prefix — to pool block ``block``.
+    ``refs`` counts children plus explicit pins; ``stamp`` is the
+    logical-clock LRU touch."""
+
+    __slots__ = ("key", "block", "parent", "children", "refs", "stamp")
+
+    def __init__(self, key, block, parent):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children = {}
+        self.refs = 0
+        self.stamp = 0
+
+
+class PrefixCache:
+    """Block pool + radix index.  Pure host-side bookkeeping plus one
+    device buffer (``pool``); the engine drives the copy programs.
+
+    Invariants (``check()`` verifies them; tests call it liberally):
+
+      * every tree node owns exactly one pool block; no block is both
+        owned and free; owned + free == ``num_blocks``.
+      * ``refs >= len(children)`` for every node (the excess is pins),
+        and a node with ``refs > 0`` is never evicted — interior nodes
+        are pinned by their children, so eviction only ever removes
+        cold leaves and the tree stays prefix-closed (a cached block's
+        ancestors are always cached too).
+      * all metadata is deterministic: LRU uses a logical clock and the
+        tree never holds device values, so a replayed workload evicts
+        identically.
+    """
+
+    def __init__(self, cfg, num_blocks: int, block_tokens: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}")
+        self.config = cfg
+        self.num_blocks = num_blocks
+        self.block_tokens = block_tokens
+        self.pool = KVCache.zeros(cfg, num_blocks, block_tokens)
+        self.evictions = 0
+        self._root = _Node(None, -1, None)
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._by_block: dict[int, _Node] = {}
+        self._clock = 0
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def node_count(self) -> int:
+        return len(self._by_block)
+
+    # -- index operations ----------------------------------------------
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    def _chunk_key(self, tokens, i: int) -> tuple:
+        c = self.block_tokens
+        return tuple(int(t) for t in tokens[i * c:(i + 1) * c])
+
+    def lookup(self, tokens) -> list[int]:
+        """Pool block ids covering the longest cached block-aligned
+        prefix of ``tokens`` (possibly empty).  Touches every matched
+        node, so a reused prefix stays warm against eviction."""
+        out: list[int] = []
+        cur = self._root
+        for i in range(len(tokens) // self.block_tokens):
+            nxt = cur.children.get(self._chunk_key(tokens, i))
+            if nxt is None:
+                break
+            self._touch(nxt)
+            out.append(nxt.block)
+            cur = nxt
+        return out
+
+    def pin(self, block_ids) -> None:
+        """Take a reference on each block's node: pinned blocks are
+        never evicted (the engine pins a hit's blocks for the duration
+        of the admission copies)."""
+        for b in block_ids:
+            self._by_block[b].refs += 1
+
+    def unpin(self, block_ids) -> None:
+        for b in block_ids:
+            node = self._by_block.get(b)
+            if node is not None:  # survived (flush drops all pins)
+                node.refs -= 1
+
+    def publish(self, tokens, n_blocks: int) -> list[tuple[int, int]]:
+        """Insert-or-ref the first ``n_blocks`` chunks of ``tokens``.
+
+        Existing nodes are just touched (their KV is already correct —
+        prefill is deterministic, so re-publishing a prefix can never
+        change a block's contents).  Missing nodes allocate a block
+        (evicting a cold unreferenced leaf when the pool is full) and
+        are returned as ``(block_id, token_start)`` pairs whose KV the
+        caller must copy out of the arena.  Stops early — keeping the
+        already-inserted prefix — when the budget is exhausted by
+        referenced/pinned entries (nodes on the current insertion path
+        are protected from the eviction scan, so an insert can never
+        eat its own ancestors)."""
+        new: list[tuple[int, int]] = []
+        cur = self._root
+        path: set[int] = set()
+        for i in range(n_blocks):
+            key = self._chunk_key(tokens, i)
+            nxt = cur.children.get(key)
+            if nxt is None:
+                block = self._alloc(path)
+                if block is None:
+                    break
+                nxt = _Node(key, block, cur)
+                cur.children[key] = nxt
+                cur.refs += 1
+                self._by_block[block] = nxt
+                new.append((block, i * self.block_tokens))
+            self._touch(nxt)
+            path.add(id(nxt))
+            cur = nxt
+        return new
+
+    def _alloc(self, exclude_path: set) -> int | None:
+        if self._free:
+            return self._free.pop()
+        victim = None
+        for node in self._by_block.values():
+            if node.refs or id(node) in exclude_path:
+                continue
+            if victim is None or node.stamp < victim.stamp:
+                victim = node
+        if victim is None:
+            return None
+        del victim.parent.children[victim.key]
+        victim.parent.refs -= 1
+        del self._by_block[victim.block]
+        self.evictions += 1
+        return victim.block
+
+    def flush(self, reallocate: bool = False) -> None:
+        """Drop every cached block (metadata only by default).  With
+        ``reallocate=True`` the pool buffer is rebuilt too — required
+        after a device call that had the pool donated may have failed
+        mid-flight (the engine's step-failure containment), where the
+        old buffer's validity is unknown."""
+        self._root = _Node(None, -1, None)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._by_block = {}
+        if reallocate:
+            self.pool = KVCache.zeros(self.config, self.num_blocks,
+                                      self.block_tokens)
+
+    def check(self) -> None:
+        """Verify tree/pool consistency; raises ``RuntimeError`` on any
+        violation (tests call this after every mutation storm)."""
+        seen: dict[int, _Node] = {}
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if node.refs < len(node.children):
+                raise RuntimeError(
+                    f"node {node.key!r} refs {node.refs} below child "
+                    f"count {len(node.children)}")
+            for key, child in node.children.items():
+                if child.parent is not node or child.key != key:
+                    raise RuntimeError(
+                        f"child {key!r} has inconsistent parent/key links")
+                if not 0 <= child.block < self.num_blocks:
+                    raise RuntimeError(
+                        f"node {key!r} owns out-of-range block "
+                        f"{child.block}")
+                if child.block in seen:
+                    raise RuntimeError(
+                        f"block {child.block} owned by two nodes")
+                seen[child.block] = child
+                stack.append(child)
+        if set(seen) != set(self._by_block):
+            raise RuntimeError("block index disagrees with the tree")
+        overlap = set(seen) & set(self._free)
+        if overlap:
+            raise RuntimeError(f"blocks {sorted(overlap)} both owned "
+                               f"and free")
+        if len(seen) + len(self._free) != self.num_blocks:
+            raise RuntimeError(
+                f"{len(seen)} owned + {len(self._free)} free != "
+                f"{self.num_blocks} total")
