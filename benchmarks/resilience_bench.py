@@ -1,0 +1,470 @@
+"""Kill/resume soak for the training resilience layer (tpudp/resilience.py).
+
+The training-stack counterpart of ``serve_bench.py --soak``: a subprocess
+trainer is driven through every failure mode the supervisor claims to
+survive — injected NaN gradients, a finite loss spike, a raising train
+step, a wedged (stalling) step under a kill=False watchdog, a dying
+loader, SIGKILL at a random point, and a corrupted newest checkpoint
+before a relaunch — with automatic relaunch until training completes.
+The referee is merciless and binary:
+
+  * the final parameters must be **bit-identical** to an uninterrupted
+    run of the same configuration (every recovery path restores a
+    checkpoint and deterministically replays, so recovery may cost wall
+    time, never a different model), and
+  * **every recovery is accounted** in the typed event log
+    (``events.jsonl``, written by the supervisor's ``on_event`` hook and
+    the relaunch resume): each injected fault kind must have a matching
+    recovery event — rollback for NaN/spike, step_retry for raise/stall
+    (``hang: true`` for the stall), loader_restart for the loader fault,
+    ckpt_fallback for the corruption — and every SIGKILL a relaunch.
+
+Chaos schedule per seed (deterministic; ``random.Random(seed)`` jitters
+only WHERE within the launch each fault lands, never whether it fires):
+
+  launch 1: loader fault + raising step in epoch 0; SIGKILLed shortly
+            after the epoch-1 checkpoint lands
+  (the newest step dir is then byte-flipped on disk)
+  launch 2: resumes (falling back past the corrupt dir), NaN batch +
+            stalling step; SIGKILLed after the epoch-2 checkpoint
+  launch 3: resumes, loss spike in the final epoch, runs to completion
+
+Emits one JSON row per seed (metric ``train_soak``) with the recovery
+counts, ``parity_ok``, ``accounted``, and ``device_kind`` — the
+``train_soak`` stage registered in ``tools/bench_gaps.py`` /
+``tools/record_bench.py`` / ``tools/tpu_when_ready.sh``; CPU smoke rows
+are pinned by ``tests/test_bench_smoke.py``.
+
+Env knobs: TRAIN_SOAK (comma seeds; default the registry),
+TRAIN_SOAK_PLATFORM (e.g. ``cpu``), TRAIN_SOAK_EPOCHS (3),
+TRAIN_SOAK_PER_EPOCH (6 batches), TRAIN_SOAK_BATCH (8),
+TRAIN_SOAK_KILLS (2), TRAIN_SOAK_WD_TIMEOUT (8s; the stall sleeps 1.75x
+that), TRAIN_SOAK_LOG_EVERY (2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.bench_gaps import TRAIN_SOAK_SEEDS  # noqa: E402
+
+
+def _cfg() -> dict:
+    return {
+        "epochs": int(os.environ.get("TRAIN_SOAK_EPOCHS", 3)),
+        "per_epoch": int(os.environ.get("TRAIN_SOAK_PER_EPOCH", 6)),
+        "batch": int(os.environ.get("TRAIN_SOAK_BATCH", 8)),
+        "kills": int(os.environ.get("TRAIN_SOAK_KILLS", 2)),
+        "wd_timeout": float(os.environ.get("TRAIN_SOAK_WD_TIMEOUT", 8.0)),
+        "log_every": int(os.environ.get("TRAIN_SOAK_LOG_EVERY", 2)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Worker: one trainer process (launched with --worker; config via env)
+# ---------------------------------------------------------------------------
+
+def _worker() -> int:
+    if os.environ.get("TRAIN_SOAK_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms",
+                          os.environ["TRAIN_SOAK_PLATFORM"])
+    import flax.linen as nn
+    import jax
+    import numpy as np
+
+    from tpudp.data.cifar10 import _synthetic
+    from tpudp.data.loader import DataLoader
+    from tpudp.data.prefetch import Prefetcher
+    from tpudp.resilience import ResiliencePolicy, auto_resume
+    from tpudp.train import Trainer
+    from tpudp.training_faults import (CorruptingLoader, RaisingLoader,
+                                       RaisingStep, StallingStep)
+    from tpudp.utils.watchdog import Watchdog
+
+    cfg = _cfg()
+    outdir = os.environ["TRAIN_SOAK_OUT"]
+    ckpt = os.path.join(outdir, "ckpt")
+    events_path = os.path.join(outdir, "events.jsonl")
+
+    def emit(ev: dict) -> None:
+        with open(events_path, "a") as f:
+            f.write(json.dumps(ev) + "\n")
+
+    def _idx(name):
+        v = os.environ.get(name, "")
+        return {int(x) for x in v.split(",") if x}
+
+    class SoakNet(nn.Module):
+        """Tiny BN-free conv net: trajectories are invariant to device
+        placement and the compile stays in single-digit seconds."""
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.relu(nn.Conv(4, (3, 3), padding=1)(x))
+            x = nn.avg_pool(x, (8, 8), strides=(8, 8))
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(10)(x)
+
+    ds = _synthetic(cfg["per_epoch"] * cfg["batch"], seed=17)
+    loader = DataLoader(ds, cfg["batch"], train=True, seed=5,
+                        backend="numpy")
+    nan_at, spike_at = _idx("TRAIN_SOAK_NAN_AT"), _idx("TRAIN_SOAK_SPIKE_AT")
+    loader_at = _idx("TRAIN_SOAK_LOADER_AT")
+    if nan_at or spike_at:
+        loader = CorruptingLoader(loader, nan_at=nan_at, spike_at=spike_at,
+                                  spike_scale=30.0)
+    if loader_at:
+        loader = RaisingLoader(loader, fail_at=loader_at)
+    prefetch = Prefetcher(loader, depth=2)
+
+    raise_at, stall_at = _idx("TRAIN_SOAK_RAISE_AT"), _idx("TRAIN_SOAK_STALL_AT")
+    raiser = RaisingStep(fail_at=raise_at)
+    staller = StallingStep(stall_at, delay_s=1.75 * cfg["wd_timeout"])
+    # Per-step pacing (sleep only — the math is untouched): the harness's
+    # SIGKILL lands a grace interval after a checkpoint appears, and the
+    # post-compile epochs of this tiny net are otherwise fast enough for
+    # a launch to FINISH inside that grace, dodging its kill.  0 on real
+    # hardware where steps have honest duration.
+    pace = float(os.environ.get("TRAIN_SOAK_PACE_S", 0.08))
+    import time as _time
+
+    def hook(kind, index):
+        if pace:
+            _time.sleep(pace)
+        staller(kind, index)
+        raiser(kind, index)
+
+    watchdog = Watchdog(timeout_s=cfg["wd_timeout"], kill=False,
+                        poll_s=0.2).start() if stall_at else None
+
+    trainer = Trainer(SoakNet(), None, "none", spmd_mode="single",
+                      log_every=cfg["log_every"], log_fn=lambda s: None,
+                      watchdog=watchdog, step_fault_hook=hook)
+    os.makedirs(ckpt, exist_ok=True)
+    start_epoch, skip = auto_resume(trainer, ckpt, cfg["per_epoch"],
+                                    log=lambda s: None, on_event=emit)
+    emit({"kind": "relaunch_resume", "epoch": start_epoch, "skip": skip})
+    policy = ResiliencePolicy(checkpoint_dir=ckpt, spike_factor=3.0,
+                              spike_min_history=1, on_event=emit)
+
+    def epoch_end(epoch: int) -> None:
+        # The harness's kill marker: one line per epoch THIS launch
+        # completed (the supervisor saves step_{epoch+1} right after this
+        # fn returns; the harness's kill grace covers that write), so
+        # SIGKILLs land after the launch's first full epoch — after its
+        # in-process faults have fired and recovered — never during
+        # startup.
+        with open(os.path.join(outdir, "epoch_end.marker"), "a") as f:
+            f.write(f"{epoch}\n")
+
+    trainer.fit(prefetch, epochs=cfg["epochs"], start_epoch=start_epoch,
+                skip_batches_first_epoch=skip, epoch_end_fn=epoch_end,
+                resilience=policy)
+    prefetch.close()
+    if watchdog is not None:
+        watchdog.stop()
+
+    flat = np.concatenate([np.asarray(leaf).ravel()
+                           for leaf in jax.tree.leaves(trainer.state.params)])
+    np.save(os.path.join(outdir, "params.npy"), flat)
+    with open(os.path.join(outdir, "done.json"), "w") as f:
+        json.dump({"device_kind": jax.devices()[0].device_kind,
+                   "steps": int(trainer.state.step),
+                   "stats": {k: v for k, v in trainer.stats.items()
+                             if k != "events"}}, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Harness: reference run + chaos run + parity/accounting referee
+# ---------------------------------------------------------------------------
+
+def _launch(outdir: str, faults: dict[str, str]) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["TRAIN_SOAK_OUT"] = outdir
+    for k in ("TRAIN_SOAK_NAN_AT", "TRAIN_SOAK_SPIKE_AT",
+              "TRAIN_SOAK_RAISE_AT", "TRAIN_SOAK_STALL_AT",
+              "TRAIN_SOAK_LOADER_AT"):
+        env.pop(k, None)
+    env.update(faults)
+    # stderr to a file, never a pipe: nobody drains a pipe while the
+    # worker runs, and libtpu/jax chatter past the ~64KB pipe buffer
+    # would block the worker mid-write (a fake "wedge").  Truncated per
+    # launch; _stderr_tail reads it on failure.
+    with open(os.path.join(outdir, "worker.err"), "wb") as errf:
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=errf)
+
+
+def _stderr_tail(outdir: str, n: int = 400) -> str:
+    try:
+        with open(os.path.join(outdir, "worker.err"), "rb") as f:
+            return f.read().decode(errors="replace")[-n:]
+    except OSError:
+        return ""
+
+
+def _wait_for(predicate, proc: subprocess.Popen, timeout_s: float) -> bool:
+    """Poll until ``predicate()`` or the worker exits; True if it fired
+    while the worker was still alive."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return proc.poll() is None
+        if proc.poll() is not None:
+            return False
+        time.sleep(0.05)
+    return False
+
+
+def _kill_after_first_epoch(proc: subprocess.Popen, outdir: str,
+                            marker_len0: int, timeout_s: float) -> bool:
+    """SIGKILL the worker shortly after THIS launch completes its first
+    full epoch (the worker appends one line to ``epoch_end.marker`` per
+    epoch end) — by then the launch's in-process faults have fired and
+    recovered, and its epoch checkpoint is landing.  Keying on the
+    launch's own progress (marker growth past ``marker_len0``) rather
+    than on checkpoint files keeps pre-existing checkpoints from an
+    earlier launch from arming the kill during startup.  Returns whether
+    the kill was delivered (the worker may legitimately win the race)."""
+    marker = os.path.join(outdir, "epoch_end.marker")
+
+    def grew() -> bool:
+        try:
+            return os.path.getsize(marker) > marker_len0
+        except OSError:
+            return False
+
+    if _wait_for(grew, proc, timeout_s):
+        time.sleep(0.4)  # past the epoch-end save, into the next epoch
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            return True
+    proc.wait()
+    return False
+
+
+def _marker_len(outdir: str) -> int:
+    try:
+        return os.path.getsize(os.path.join(outdir, "epoch_end.marker"))
+    except OSError:
+        return 0
+
+
+def _events(outdir: str) -> list[dict]:
+    path = os.path.join(outdir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    for line in open(path):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def run_soak(seed: int, workdir: str) -> dict:
+    cfg = _cfg()
+    rng = random.Random(seed * 7919 + 13)
+    per, total_s = cfg["per_epoch"], 600.0
+    ref_dir = os.path.join(workdir, f"ref_{seed}")
+    chaos_dir = os.path.join(workdir, f"chaos_{seed}")
+    os.makedirs(ref_dir, exist_ok=True)
+    os.makedirs(chaos_dir, exist_ok=True)
+
+    # Uninterrupted oracle.
+    proc = _launch(ref_dir, {})
+    proc.wait(timeout=total_s)
+    if proc.returncode != 0:
+        return {"seed": seed, "error": "reference run failed: "
+                + _stderr_tail(ref_dir)}
+
+    ckpt = os.path.join(chaos_dir, "ckpt")
+    kills = 0
+    launches = []
+    want_kills = cfg["kills"]
+
+    # Launch 1: loader fault + raising step in its first epoch; killed
+    # after its first epoch checkpoint lands.  The raise is pinned at
+    # least two calls past the loader draw: the loader fault travels
+    # through the Prefetcher's queue and must SURFACE (at the consumer's
+    # draw) before the step raise abandons the iteration, or the queued
+    # fault dies with the abandoned worker and never gets its recovery.
+    loader_at = rng.randrange(1, per - 3)
+    launches.append({
+        "TRAIN_SOAK_LOADER_AT": str(loader_at),
+        "TRAIN_SOAK_RAISE_AT": str(loader_at + 2 + rng.randrange(0, 2)),
+    })
+    # Launch 2: NaN batch early in its first (resumed) epoch + a stalling
+    # step; killed after its first epoch checkpoint.  The stall index is
+    # pinned to per+1..per+2: the guaranteed NaN rollback replays the
+    # whole epoch, so at least per+2 device calls dispatch BEFORE that
+    # epoch's checkpoint — the stall always fires (and its hang recovery
+    # completes) before the kill marker can arm.
+    launches.append({
+        "TRAIN_SOAK_NAN_AT": str(rng.randrange(1, per - 1)),
+        "TRAIN_SOAK_STALL_AT": str(per + 1 + rng.randrange(0, 2)),
+    })
+    # Final launch: loss spike in its first resumed epoch; runs to
+    # completion.
+    final_faults = {"TRAIN_SOAK_SPIKE_AT": str(rng.randrange(2, per - 1))}
+
+    corrupted = 0
+    for i, faults in enumerate(launches[:want_kills]):
+        len0 = _marker_len(chaos_dir)
+        proc = _launch(chaos_dir, faults)
+        if _kill_after_first_epoch(proc, chaos_dir, len0, total_s):
+            kills += 1
+        elif proc.returncode not in (0, -signal.SIGKILL):
+            return {"seed": seed, "error":
+                    f"chaos launch {i + 1} died rc={proc.returncode}: "
+                    + _stderr_tail(chaos_dir)}
+        if i == 0:
+            # Corrupt the newest VERIFIED checkpoint before the relaunch:
+            # the next resume must fall back to the previous intact step
+            # dir.  Never corrupt the only verified checkpoint — the
+            # fallback contract (refuse to silently restart from scratch)
+            # would correctly abort the whole soak.
+            from tpudp.utils.checkpoint import step_dirs_newest_first
+
+            verified = [d for d in step_dirs_newest_first(ckpt)
+                        if os.path.exists(d + ".manifest.json")]
+            if len(verified) >= 2:
+                from tpudp.training_faults import corrupt_checkpoint
+
+                corrupt_checkpoint(verified[0], mode="flip")
+                corrupted += 1
+    # Relaunch until done (the final launch carries the spike fault; any
+    # further relaunches — e.g. the spike landed before a kill — are
+    # fault-free).
+    relaunches = 0
+    while not os.path.exists(os.path.join(chaos_dir, "done.json")):
+        relaunches += 1
+        if relaunches > 6:
+            return {"seed": seed, "error": "soak did not converge in 6 "
+                    "relaunches"}
+        proc = _launch(chaos_dir, final_faults if relaunches == 1 else {})
+        try:
+            proc.wait(timeout=total_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return {"seed": seed, "error": "final launch timed out"}
+        if proc.returncode != 0:
+            return {"seed": seed, "error":
+                    f"final launch rc={proc.returncode}: "
+                    + _stderr_tail(chaos_dir)}
+
+    # Referee: bit-exact parity + typed-event accounting.
+    ref_params = open(os.path.join(ref_dir, "params.npy"), "rb").read()
+    chaos_params = open(os.path.join(chaos_dir, "params.npy"), "rb").read()
+    parity_ok = ref_params == chaos_params
+    events = _events(chaos_dir)
+    counts = {}
+    for e in events:
+        counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+    hang_retries = sum(1 for e in events
+                       if e["kind"] == "step_retry" and e.get("hang"))
+    raise_retries = sum(1 for e in events
+                        if e["kind"] == "step_retry" and not e.get("hang"))
+    spike_rollbacks = sum(1 for e in events if e["kind"] == "loss_spike")
+    nan_rollbacks = sum(1 for e in events if e["kind"] == "rollback"
+                        and "FloatingPointError" in e.get("error", ""))
+    resumes = counts.get("relaunch_resume", 0)
+    # Accounting adapts to the PLANNED chaos: with TRAIN_SOAK_KILLS < 2
+    # only the launches that ran injected their fault kinds (launch 2
+    # carries NaN + stall), so only those owe a recovery.  The TPU stage
+    # and the slow-tier test run the full 2-kill menu.
+    ran = launches[:want_kills]
+    planned_nan = any("TRAIN_SOAK_NAN_AT" in f for f in ran)
+    planned_stall = any("TRAIN_SOAK_STALL_AT" in f for f in ran)
+    planned_loader = any("TRAIN_SOAK_LOADER_AT" in f for f in ran)
+    planned_raise = any("TRAIN_SOAK_RAISE_AT" in f for f in ran)
+    accounted = (counts.get("loader_restart", 0) >= int(planned_loader)
+                 and raise_retries >= int(planned_raise)
+                 and hang_retries >= int(planned_stall)
+                 and nan_rollbacks >= int(planned_nan)
+                 and spike_rollbacks >= 1
+                 and counts.get("ckpt_fallback", 0) >= corrupted
+                 and (corrupted >= 1) == (want_kills >= 1)
+                 and kills == want_kills
+                 and resumes >= kills + 1)
+    done = json.load(open(os.path.join(chaos_dir, "done.json")))
+    recoveries = (counts.get("rollback", 0) + counts.get("step_retry", 0)
+                  + counts.get("ckpt_fallback", 0)
+                  + counts.get("loader_restart", 0) + kills)
+    return {
+        "metric": "train_soak", "seed": seed, "value": recoveries,
+        "unit": "recoveries", "parity_ok": parity_ok,
+        "accounted": accounted, "kills": kills, "relaunches": resumes,
+        "corrupted_checkpoints": corrupted,
+        "rollbacks": counts.get("rollback", 0),
+        "nan_rollbacks": nan_rollbacks, "spike_rollbacks": spike_rollbacks,
+        "step_retries": counts.get("step_retry", 0),
+        "hang_retries": hang_retries,
+        "ckpt_fallbacks": counts.get("ckpt_fallback", 0),
+        "loader_restarts": counts.get("loader_restart", 0),
+        "steps": done.get("steps"),
+        "epochs": cfg["epochs"], "per_epoch": per, "batch": cfg["batch"],
+        "device_kind": done.get("device_kind"),
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run one trainer process (env-config)")
+    ap.add_argument("--soak", type=str, default=None,
+                    help="comma-separated seeds (env: TRAIN_SOAK; default "
+                         "the registry)")
+    ap.add_argument("--workdir", type=str, default=None,
+                    help="scratch root (default: a fresh temp dir)")
+    args = ap.parse_args()
+    if args.worker:
+        raise SystemExit(_worker())
+    soak_env = args.soak or os.environ.get("TRAIN_SOAK")
+    if soak_env is not None and not soak_env.strip():
+        return  # the gap helper said: nothing missing
+    seeds = ([int(s) for s in soak_env.split(",") if s]
+             if soak_env else list(TRAIN_SOAK_SEEDS))
+    bad = [s for s in seeds if s not in TRAIN_SOAK_SEEDS]
+    if bad:
+        raise SystemExit(f"error: unregistered soak seeds {bad} "
+                         f"(registry: {list(TRAIN_SOAK_SEEDS)})")
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="tpudp_train_soak_")
+    for seed in seeds:
+        try:
+            row = run_soak(seed, workdir)
+        except Exception as e:  # crash isolation: one seed, one row
+            row = {"seed": seed, "error": f"{type(e).__name__}: {e}"}
+        if "error" in row:
+            row.setdefault("metric", "train_soak")
+            row.setdefault("value", 0)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -256,6 +258,100 @@ def test_serve_prefix_gap_gate(tmp_path):
              "value": 1.2, "prefix_hit_tokens": 96, "parity_ok": True,
              "device_kind": "TPU v5 lite"}) + "\n")
     assert serve_prefix_missing(d) == []  # banked history row counts
+
+
+def test_train_soak_bench_row_parses():
+    """The train_soak stage's CPU smoke (tier-1's guard on the kill/
+    resume soak the TPU watcher resumes): a reduced 1-kill plan (loader
+    fault + raising step + SIGKILL + corrupt-checkpoint fallback + loss
+    spike) must complete with zero human intervention, final params
+    bit-identical to the uninterrupted run (parity_ok), and every planned
+    recovery accounted in the typed event log (accounted).  The FULL
+    2-kill menu (adds NaN rollback + stall-under-watchdog) runs in the
+    slow tier (test_train_soak_full_menu) and on the TPU stage."""
+    proc = _run("benchmarks/resilience_bench.py", {
+        "TRAIN_SOAK_PLATFORM": "cpu",
+        "TRAIN_SOAK": "0",
+        "TRAIN_SOAK_KILLS": "1",
+        "TRAIN_SOAK_PACE_S": "0.05",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byseed = {r["seed"]: r for r in rows
+              if r.get("metric") == "train_soak"}
+    assert set(byseed) == {0}, proc.stderr[-800:]
+    r = byseed[0]
+    assert "error" not in r, r
+    assert r["value"] > 0                      # recoveries happened
+    assert r["parity_ok"] is True              # bit-exact vs uninterrupted
+    assert r["accounted"] is True              # every planned fault recovered
+    assert r["kills"] == 1 and r["relaunches"] >= r["kills"] + 1
+    assert r["spike_rollbacks"] >= 1 and r["loader_restarts"] >= 1
+    assert r["step_retries"] >= 1 and r["ckpt_fallbacks"] >= 1
+    # unregistered seeds fail fast, like the serve soak's seed registry
+    bad = _run("benchmarks/resilience_bench.py", {
+        "TRAIN_SOAK_PLATFORM": "cpu", "TRAIN_SOAK": "7"}, timeout=300)
+    assert bad.returncode != 0
+    assert "soak seeds" in (bad.stderr + bad.stdout)
+
+
+@pytest.mark.slow
+def test_train_soak_full_menu():
+    """The full 2-kill chaos schedule — NaN, spike, stall-under-watchdog,
+    step-raise, loader-raise, 2 SIGKILLs, corrupt checkpoint — with the
+    bit-exact + fully-accounted referee (the acceptance oracle for
+    docs/RESILIENCE.md)."""
+    proc = _run("benchmarks/resilience_bench.py", {
+        "TRAIN_SOAK_PLATFORM": "cpu",
+        "TRAIN_SOAK": "0",
+        "TRAIN_SOAK_WD_TIMEOUT": "6",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    r = next(r for r in rows if r.get("metric") == "train_soak")
+    assert "error" not in r, r
+    assert r["parity_ok"] is True and r["accounted"] is True
+    assert r["kills"] == 2 and r["relaunches"] >= 3
+    assert r["nan_rollbacks"] >= 1 and r["spike_rollbacks"] >= 1
+    assert r["hang_retries"] >= 1 and r["loader_restarts"] >= 1
+    assert r["ckpt_fallbacks"] >= 1
+
+
+def test_train_soak_gap_gate(tmp_path):
+    """tools/bench_gaps train_soak stage: CPU smoke rows, error rows,
+    parity-broken rows, and unaccounted rows never close a seed; banked
+    TPU rows that passed do (the watcher's window-accumulation contract,
+    same rules as the serve_soak stage)."""
+    from tools.bench_gaps import TRAIN_SOAK_SEEDS, train_soak_missing
+
+    d = str(tmp_path)
+    assert train_soak_missing(d) == list(TRAIN_SOAK_SEEDS)
+    rows = [
+        {"metric": "train_soak", "seed": 0, "value": 9,
+         "parity_ok": True, "accounted": True,
+         "device_kind": "cpu"},                       # smoke: no
+        {"metric": "train_soak", "seed": 1,
+         "error": "relay wedged", "value": 0},        # error: no
+        {"metric": "train_soak", "seed": 1, "value": 8,
+         "parity_ok": False, "accounted": True,
+         "device_kind": "TPU v5 lite"},               # diverged: no
+        {"metric": "train_soak", "seed": 2, "value": 7,
+         "parity_ok": True, "accounted": False,
+         "device_kind": "TPU v5 lite"},               # unaccounted: no
+        {"metric": "train_soak", "seed": 0, "value": 9,
+         "parity_ok": True, "accounted": True,
+         "device_kind": "TPU v5 lite"},               # real pass: yes
+    ]
+    with open(os.path.join(d, "train_soak.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert train_soak_missing(d) == [1, 2]
+    with open(os.path.join(d, "train_soak.history.jsonl"), "w") as f:
+        f.write(json.dumps(
+            {"metric": "train_soak", "seed": 2, "value": 6,
+             "parity_ok": True, "accounted": True,
+             "device_kind": "TPU v5 lite"}) + "\n")
+    assert train_soak_missing(d) == [1]  # banked history row counts
 
 
 def test_bad_param_dtype_fails_fast():

@@ -68,6 +68,50 @@ def test_prefetcher_rejects_bad_depth():
         Prefetcher([], depth=0)
 
 
+def _no_live_workers(deadline_s=5.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        workers = [t for t in threading.enumerate()
+                   if t.name == "tpudp-prefetch" and t.is_alive()]
+        if not workers:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_prefetcher_abandoned_iteration_leaves_no_thread():
+    """Supervisor restarts abandon iteration mid-epoch repeatedly: once
+    the iterator is dropped (no explicit close), the worker must exit —
+    no live tpudp-prefetch thread, no put() blocked on a full queue."""
+    import gc
+
+    ds = _dataset(64)
+    pf = Prefetcher(DataLoader(ds, 8, train=True), depth=1)
+    it = iter(pf)
+    next(it)  # worker running, queue full (depth 1), put() blocking
+    del it    # abandoned WITHOUT close(): generator finalizer must stop it
+    gc.collect()
+    assert _no_live_workers(), "prefetch worker leaked after abandonment"
+
+
+def test_prefetcher_close_stops_workers_and_unblocks_put():
+    """Explicit close(): the guaranteed path for consumers that cannot
+    rely on GC finalizers (soak relaunch loops).  Idempotent, and the
+    Prefetcher stays iterable afterwards."""
+    ds = _dataset(64)
+    pf = Prefetcher(DataLoader(ds, 8, train=True), depth=1)
+    it = iter(pf)
+    next(it)  # worker alive, blocked in put() on the full depth-1 queue
+    holder = [it]  # keep a live reference so GC cannot help
+    pf.close()
+    assert _no_live_workers(), "close() left a live prefetch worker"
+    del holder
+    # reusable after close: a fresh iteration spawns a fresh worker
+    assert len(list(pf)) == len(list(DataLoader(ds, 8, train=True)))
+    pf.close()  # idempotent
+    pf.close()
+
+
 def test_prefetcher_place_hook_runs_on_worker_thread():
     """Device-side prefetch: set_place runs on the prefetch thread for every
     batch; yielded batches carry the placed result."""

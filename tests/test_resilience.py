@@ -1,0 +1,394 @@
+"""In-process fault supervision (tpudp/resilience.py): every recovery
+path restores a checkpoint and deterministically replays, so the final
+parameters are BIT-IDENTICAL to an uninterrupted run — the acceptance
+oracle for divergence rollback, step/hang retry, loader containment, and
+checkpoint-integrity fallback.  Faults come from the deterministic
+injectors in tpudp/training_faults.py (the trainer analogue of
+tpudp/serve/faults.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.small_model import SmallConv
+from tpudp.data.cifar10 import _synthetic
+from tpudp.data.loader import DataLoader
+from tpudp.data.prefetch import Prefetcher
+from tpudp.resilience import ResiliencePolicy
+from tpudp.train import Trainer
+from tpudp.training_faults import (CorruptingLoader, InjectedTrainingFault,
+                                   RaisingLoader, RaisingStep, StallingStep,
+                                   corrupt_checkpoint)
+from tpudp.utils.watchdog import Watchdog
+
+
+def _loader(nan_at=(), spike_at=(), loader_fail=(), prefetch=False):
+    ds = _synthetic(64, seed=3)
+    ld = DataLoader(ds, 16, train=True, seed=2, backend="numpy")
+    if nan_at or spike_at:
+        ld = CorruptingLoader(ld, nan_at=nan_at, spike_at=spike_at)
+    if loader_fail:
+        ld = RaisingLoader(ld, fail_at=loader_fail)
+    if prefetch:
+        ld = Prefetcher(ld, depth=2)
+    return ld
+
+
+def _trainer(hook=None, watchdog=None):
+    return Trainer(SmallConv(), None, "none", spmd_mode="single",
+                   log_every=2, log_fn=lambda s: None, watchdog=watchdog,
+                   step_fault_hook=hook)
+
+
+def _run(ckpt_dir, *, epochs=2, policy_kw=None, **loader_kw):
+    hook = loader_kw.pop("hook", None)
+    watchdog = loader_kw.pop("watchdog", None)
+    tr = _trainer(hook=hook, watchdog=watchdog)
+    pol = (ResiliencePolicy(checkpoint_dir=str(ckpt_dir), spike_factor=4.0,
+                            spike_min_history=1, **(policy_kw or {}))
+           if ckpt_dir is not None else None)
+    tr.fit(_loader(**loader_kw), epochs=epochs, resilience=pol)
+    return tr
+
+
+def _kernel(tr):
+    return np.asarray(tr.state.params["Dense_0"]["kernel"])
+
+
+@pytest.fixture(scope="module")
+def clean_kernel(tmp_path_factory):
+    """The uninterrupted 2-epoch oracle every recovery must match
+    bit-exactly (computed once; compiles dominate this module)."""
+    tr = _run(tmp_path_factory.mktemp("clean"))
+    return _kernel(tr)
+
+
+def test_resilience_none_is_default_and_inert(tmp_path):
+    """The default path carries no supervisor state: stats stays empty,
+    no checkpoint dir is required, nothing is written."""
+    tr = _run(None)
+    assert tr.stats == {}
+    assert tr._resilience is None
+
+
+def test_nan_window_rolls_back_bit_exact(tmp_path, clean_kernel):
+    """A NaN batch (NaN grads -> NaN params -> check_finite window) rolls
+    back to the last verified checkpoint and replays; the transient fault
+    does not re-fire, so the final params match the clean run exactly."""
+    tr = _run(tmp_path, nan_at={5})
+    assert tr.stats["rollbacks"] == 1
+    assert np.array_equal(clean_kernel, _kernel(tr))
+    kinds = [e["kind"] for e in tr.stats["events"]]
+    assert "rollback" in kinds
+    rb = next(e for e in tr.stats["events"] if e["kind"] == "rollback")
+    assert "FloatingPointError" in rb["error"]
+
+
+def test_loss_spike_rolls_back_bit_exact(tmp_path, clean_kernel):
+    """A finite spike beyond spike_factor x the trailing median rolls
+    back just like a NaN — caught at the spike, not epochs later."""
+    tr = _run(tmp_path, spike_at={6})
+    assert tr.stats["rollbacks"] == 1
+    assert any(e["kind"] == "loss_spike" for e in tr.stats["events"])
+    assert np.array_equal(clean_kernel, _kernel(tr))
+
+
+def test_step_fault_retries_in_process_bit_exact(tmp_path, clean_kernel):
+    """An exception escaping the train step takes the emergency-dump
+    path, restores, and continues IN THE SAME PROCESS; the dump is
+    consumed (a later relaunch must use the step series)."""
+    tr = _run(tmp_path, hook=RaisingStep(fail_at={6}))
+    assert tr.stats["step_retries"] == 1
+    assert np.array_equal(clean_kernel, _kernel(tr))
+    assert not os.path.isdir(tmp_path / "emergency")  # consumed
+    ev = next(e for e in tr.stats["events"] if e["kind"] == "step_retry")
+    assert ev["hang"] is False
+
+
+def test_hang_recovers_in_process_and_rearms(tmp_path, clean_kernel):
+    """A stalled step under a kill=False watchdog surfaces StepHangError;
+    the supervisor dumps, restores, RE-ARMS the watchdog, and training
+    completes in the same process (previously cli.py needed a relaunch)."""
+    wd = Watchdog(timeout_s=0.8, kill=False, poll_s=0.05).start()
+    try:
+        tr = _run(tmp_path, hook=StallingStep({6}, delay_s=1.6),
+                  watchdog=wd)
+    finally:
+        wd.stop()
+    hangs = [e for e in tr.stats["events"]
+             if e["kind"] == "step_retry" and e["hang"]]
+    assert hangs and tr.stats["step_retries"] >= 1
+    assert np.array_equal(clean_kernel, _kernel(tr))
+
+
+def test_loader_fault_restarts_at_exact_offset(tmp_path, clean_kernel):
+    """An exception out of the Prefetcher WORKER (the fault sits under
+    the prefetch thread) restarts the pipeline and replays the consumed
+    draws — same host-RNG sequence, bit-exact trajectory."""
+    tr = _run(tmp_path, loader_fail={5}, prefetch=True)
+    assert tr.stats["loader_restarts"] == 1
+    assert np.array_equal(clean_kernel, _kernel(tr))
+    ev = next(e for e in tr.stats["events"]
+              if e["kind"] == "loader_restart")
+    # draw 5 is batch 1 of epoch 1 (4 batches/epoch): the pipeline
+    # restarted at exactly that offset within its epoch
+    assert ev["epoch"] == 1 and ev["offset"] == 1
+
+
+def test_rollback_budget_exhaustion_escalates_original(tmp_path):
+    """A persistent NaN exhausts max_rollbacks and the ORIGINAL
+    FloatingPointError escalates (the pre-resilience crash semantics)."""
+    tr = _trainer()
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        tr.fit(_loader(nan_at=range(5, 10 ** 6)), epochs=2,
+               resilience=ResiliencePolicy(checkpoint_dir=str(tmp_path),
+                                           max_rollbacks=2))
+    assert tr.stats["rollbacks"] == 2
+    assert any(e["kind"] == "rollback_escalation"
+               for e in tr.stats["events"])
+
+
+def test_same_step_second_failure_escalates(tmp_path):
+    """A PERSISTENT step fault fails again at the same step after the
+    retry; the second consecutive failure escalates the original error."""
+    tr = _trainer(hook=RaisingStep(persist_from=6))
+    with pytest.raises(InjectedTrainingFault):
+        tr.fit(_loader(), epochs=2,
+               resilience=ResiliencePolicy(checkpoint_dir=str(tmp_path)))
+    assert tr.stats["step_retries"] == 1
+    assert any(e["kind"] == "step_escalation" for e in tr.stats["events"])
+
+
+def test_loader_budget_exhaustion_escalates_original(tmp_path):
+    tr = _trainer()
+    with pytest.raises(InjectedTrainingFault):
+        tr.fit(_loader(loader_fail=range(5, 10 ** 6)), epochs=2,
+               resilience=ResiliencePolicy(checkpoint_dir=str(tmp_path),
+                                           max_loader_restarts=2))
+    assert tr.stats["loader_restarts"] == 2
+
+
+def test_eval_fault_replays_missed_epoch_end(tmp_path, clean_kernel):
+    """A fault during the epoch TAIL (eval / epoch-end hook) resumes at
+    the next epoch boundary; the supervisor must replay the missed tail
+    — otherwise that epoch's checkpoint is silently never written."""
+    ds = _synthetic(32, seed=9)
+    test_loader = DataLoader(ds, 16, train=False, backend="numpy")
+    saved = []
+    # 2 epochs x 4 train batches: eval after epoch 0 is device call 5
+    tr = _trainer(hook=RaisingStep(fail_at={5}, kind="eval"))
+    tr.fit(_loader(), test_loader, epochs=2,
+           epoch_end_fn=lambda e: saved.append(e),
+           resilience=ResiliencePolicy(checkpoint_dir=str(tmp_path)))
+    assert tr.stats["step_retries"] == 1
+    assert saved == [0, 1]  # epoch 0's tail was replayed, not skipped
+    assert os.path.isdir(tmp_path / "step_1")  # its checkpoint exists
+    assert os.path.isdir(tmp_path / "step_2")
+    assert np.array_equal(clean_kernel, _kernel(tr))
+
+
+def test_corrupt_newest_checkpoint_falls_back(tmp_path, clean_kernel):
+    """A bit-flipped newest checkpoint fails its manifest and restore
+    falls back to the previous intact step dir; with every dir corrupt
+    the walk refuses loudly instead of silently restarting."""
+    from tpudp.utils.checkpoint import restore_latest_verified
+
+    tr = _run(tmp_path)  # leaves step_0..step_2, all with manifests
+    corrupt_checkpoint(tmp_path / "step_2", mode="flip")
+    state, path, skipped = restore_latest_verified(
+        str(tmp_path), tr.state, log=lambda s: None)
+    assert path.endswith("step_1") and len(skipped) == 1
+    assert int(state.step) == 4  # epoch-1 boundary on 4 batches/epoch
+    # the rejected dir left the series (quarantined), so a second walk
+    # does not re-count the same corruption
+    assert not (tmp_path / "step_2").is_dir()
+    assert (tmp_path / "step_2.corrupt").is_dir()
+    _s, _p, skipped2 = restore_latest_verified(
+        str(tmp_path), tr.state, log=lambda s: None)
+    assert _p.endswith("step_1") and skipped2 == []
+    # manifest tamper and torn (truncated) dirs are rejected the same way
+    corrupt_checkpoint(tmp_path / "step_1", mode="manifest")
+    corrupt_checkpoint(tmp_path / "step_0", mode="truncate")
+    with pytest.raises(RuntimeError, match="corrupt or torn"):
+        restore_latest_verified(str(tmp_path), tr.state, log=lambda s: None)
+
+
+def test_in_fit_rollback_survives_corrupt_newest(tmp_path, clean_kernel):
+    """The supervisor's own rollback walks past a corrupt newest
+    checkpoint mid-fit (stats['ckpt_fallbacks'] accounts it) and still
+    lands bit-exact."""
+    # Train epoch 0 with a checkpoint, then corrupt it and continue with
+    # a NaN in epoch 1: rollback must fall back to step_0.
+    tr = _trainer()
+    pol = ResiliencePolicy(checkpoint_dir=str(tmp_path))
+    tr.fit(_loader(), epochs=1, resilience=pol)
+    corrupt_checkpoint(tmp_path / "step_1", mode="flip")
+    tr2 = _trainer()
+    tr2.state = tr.state  # continue the same trajectory mid-run
+    # NaN at draw 2 = epoch 1's third batch (the resumed loader's draw
+    # counter starts fresh at epoch 1)
+    tr2.fit(_loader(nan_at={2}), epochs=2, start_epoch=1,
+            resilience=ResiliencePolicy(checkpoint_dir=str(tmp_path)))
+    assert tr2.stats["rollbacks"] == 1
+    assert tr2.stats["ckpt_fallbacks"] >= 1
+    assert np.array_equal(clean_kernel, _kernel(tr2))
+
+
+def test_prune_never_deletes_last_verified(tmp_path):
+    """prune_step_dirs keeps the newest VERIFIABLE checkpoint even
+    outside the keep window: if the newer retained dirs are torn, it is
+    the only restorable state left."""
+    from tpudp.utils.checkpoint import (manifest_path, prune_step_dirs,
+                                        save_checkpoint)
+
+    state = {"w": np.arange(4.0)}
+    save_checkpoint(tmp_path / "step_1", state)
+    save_checkpoint(tmp_path / "step_2", state)
+    # newer dirs exist but are torn: bare directories, no manifest
+    (tmp_path / "step_3").mkdir()
+    (tmp_path / "step_4").mkdir()
+    deleted = prune_step_dirs(tmp_path, keep=2)
+    # step_2 (newest verified) survives though it falls outside the keep
+    # window; step_1 is prunable and its manifest goes with it
+    assert sorted(os.path.basename(d) for d in deleted) == ["step_1"]
+    assert (tmp_path / "step_2").is_dir()
+    assert os.path.exists(manifest_path(tmp_path / "step_2"))
+    assert not os.path.exists(manifest_path(tmp_path / "step_1"))
+
+
+def test_eval_nan_fails_loudly_with_context():
+    """Satellite: a NaN eval must raise with epoch + iteration context,
+    not report a garbage accuracy number."""
+    import jax
+
+    tr = _trainer()
+    poisoned = jax.tree.map(lambda x: np.asarray(x) * np.float32(np.nan),
+                            tr.state.params)
+    tr.state = tr.state.replace(params=poisoned)
+    ds = _synthetic(32, seed=3)
+    ld = DataLoader(ds, 16, train=False, backend="numpy")
+    with pytest.raises(FloatingPointError) as ei:
+        tr.evaluate(ld, epoch=3)
+    msg = str(ei.value)
+    assert "eval loss" in msg and "epoch 3" in msg and "eval batches" in msg
+
+
+def test_emergency_dump_waits_for_async_writer(tmp_path, monkeypatch):
+    """Satellite: the emergency dump drains an in-flight async epoch-end
+    write BEFORE writing into the same root — the wait must come after
+    sentinel invalidation and before the save."""
+    from tpudp import resilience
+    from tpudp.utils import checkpoint as ck
+
+    order = []
+
+    class FakeWriter:
+        def wait(self):
+            order.append("wait")
+
+    class FakeState:
+        step = 7
+
+    monkeypatch.setattr(ck, "clear_emergency_sentinel",
+                        lambda root: order.append("clear"))
+    monkeypatch.setattr(ck, "save_checkpoint",
+                        lambda path, state: order.append("save"))
+    monkeypatch.setattr(ck, "write_emergency_sentinel",
+                        lambda root, step=None, per_epoch_batches=None:
+                        order.append("sentinel"))
+    dump = resilience.make_emergency_dump(
+        str(tmp_path), lambda: FakeState(), 10,
+        async_writer=FakeWriter(), log=lambda s: None)
+    dump()
+    assert order == ["clear", "wait", "save", "sentinel"]
+
+
+def test_auto_resume_prefers_emergency_and_falls_back(tmp_path):
+    """auto_resume mirrors the CLI: newest verified step dir, then the
+    sentinel-gated emergency dump (consumed on restore); a corrupt dump
+    is quarantined instead of crash-looping."""
+    from tpudp.utils.checkpoint import (save_checkpoint,
+                                        write_emergency_sentinel)
+    from tpudp.resilience import auto_resume
+
+    tr = _run(tmp_path)  # step_0..step_2 on 4 batches/epoch
+    # emergency dump two batches into epoch 1 (step counter 6)
+    mid = tr.state.replace(step=tr.state.step * 0 + 6)
+    save_checkpoint(tmp_path / "emergency", mid)
+    write_emergency_sentinel(tmp_path, step=6, per_epoch_batches=4)
+    tr2 = _trainer()
+    epoch, skip = auto_resume(tr2, str(tmp_path), 4, log=lambda s: None)
+    assert (epoch, skip) == (1, 2)
+    assert not (tmp_path / "emergency").is_dir()  # consumed
+    assert (tmp_path / "emergency.restored").is_dir()
+
+    # corrupt dump: quarantined, resume falls back to the step series
+    save_checkpoint(tmp_path / "emergency", mid)
+    write_emergency_sentinel(tmp_path, step=6, per_epoch_batches=4)
+    corrupt_checkpoint(tmp_path / "emergency", mode="flip")
+    tr3 = _trainer()
+    epoch, skip = auto_resume(tr3, str(tmp_path), 4, log=lambda s: None)
+    assert (epoch, skip) == (2, 0)  # step_2, the newest verified
+    assert (tmp_path / "emergency.corrupt").is_dir()
+
+
+@pytest.mark.slow
+def test_subprocess_kill_and_auto_resume_bit_exact(tmp_path):
+    """E2E across REAL process boundaries (pattern from
+    tests/multihost_worker.py, via the soak bench's worker): SIGKILL the
+    trainer mid-run, relaunch until done, and require final params
+    byte-identical to an uninterrupted worker."""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = os.path.join(repo, "benchmarks", "resilience_bench.py")
+
+    def launch(outdir):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS",)}
+        env.update({"TRAIN_SOAK_PLATFORM": "cpu", "TRAIN_SOAK_OUT": outdir,
+                    "TRAIN_SOAK_EPOCHS": "3", "TRAIN_SOAK_PER_EPOCH": "4",
+                    "TRAIN_SOAK_BATCH": "8"})
+        return subprocess.Popen([sys.executable, bench, "--worker"],
+                                env=env, cwd=repo,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+
+    ref = str(tmp_path / "ref")
+    chaos = str(tmp_path / "chaos")
+    os.makedirs(ref), os.makedirs(chaos)
+    proc = launch(ref)
+    assert proc.wait(timeout=600) == 0, proc.stderr.read()[-800:]
+
+    proc = launch(chaos)
+    # kill once the epoch-1 checkpoint has committed (manifest written
+    # after the orbax dir finalized), so the relaunch provably RESUMES
+    # into the run rather than replaying from the initial state
+    marker = os.path.join(chaos, "ckpt", "step_1.manifest.json")
+    deadline = time.monotonic() + 600
+    while not os.path.exists(marker) and time.monotonic() < deadline:
+        assert proc.poll() is None, proc.stderr.read()[-800:]
+        time.sleep(0.05)
+    time.sleep(0.2)  # a little into epoch 1
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    relaunches = 0
+    while not os.path.exists(os.path.join(chaos, "done.json")):
+        relaunches += 1
+        assert relaunches <= 4
+        proc = launch(chaos)
+        assert proc.wait(timeout=600) == 0, proc.stderr.read()[-800:]
+
+    ref_bytes = open(os.path.join(ref, "params.npy"), "rb").read()
+    chaos_bytes = open(os.path.join(chaos, "params.npy"), "rb").read()
+    assert ref_bytes == chaos_bytes
+    resumes = [json.loads(l) for l in open(os.path.join(chaos,
+                                                        "events.jsonl"))
+               if '"relaunch_resume"' in l]
+    assert len(resumes) >= 2  # the kill was resumed, not restarted
+    assert any(r["epoch"] > 0 or r["skip"] > 0 for r in resumes[1:])

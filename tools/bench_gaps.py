@@ -20,6 +20,9 @@ the missing work as arguments the benches accept:
     python tools/bench_gaps.py serve_prefix -> comma-separated prefix-
                                            caching workloads (TTFT
                                            cache-on/off rows missing)
+    python tools/bench_gaps.py train_soak -> comma-separated kill/resume
+                                           soak seeds (training-resilience
+                                           rows missing)
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -57,6 +60,14 @@ SERVE_PREFIX_WORKLOADS = ("shared_prefix", "multiturn")
 # a row that completed with parity intact and no slot/queue leak; same
 # registry contract.
 SERVE_SOAK_SEEDS = (0, 1, 2)
+# Kill/resume soak seeds for the TRAINING resilience layer
+# (benchmarks/resilience_bench.py: SIGKILL + relaunch, injected NaN/
+# spike/stall/step-raise/loader faults, checkpoint corruption against
+# tpudp/resilience.py) that must PASS on the TPU — a seed is closed only
+# by a row whose final params were bit-identical to the uninterrupted
+# run (parity_ok) with every recovery accounted in the typed event log
+# (accounted); same registry contract.
+TRAIN_SOAK_SEEDS = (0, 1, 2)
 
 
 def history_path(path: str) -> str:
@@ -209,6 +220,27 @@ def serve_soak_missing(d: str) -> list[int]:
     return [s for s in SERVE_SOAK_SEEDS if s not in done]
 
 
+def train_soak_missing(d: str) -> list[int]:
+    """Kill/resume soak seeds still lacking a PASSING real-TPU run.  A
+    row closes its seed only when it measured something (``value`` =
+    recoveries > 0 — a soak that recovered nothing proved nothing), the
+    final params matched the uninterrupted run bit-exactly
+    (``parity_ok``), and every injected fault/kill has a matching typed
+    recovery event (``accounted``) — a soak that diverged or lost a
+    recovery is a FAILURE to retry, exactly like an error row.  CPU
+    smoke rows never close a seed (same rules as serve_soak_missing)."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "train_soak.jsonl")):
+        if (r.get("metric") == "train_soak"
+                and r.get("seed") in TRAIN_SOAK_SEEDS
+                and measured(r)
+                and r.get("parity_ok") is True
+                and r.get("accounted") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["seed"])
+    return [s for s in TRAIN_SOAK_SEEDS if s not in done]
+
+
 def epoch_missing(d: str) -> bool:
     return not any(
         r.get("metric") == "vgg11_epoch_images_per_sec" and measured(r)
@@ -311,7 +343,7 @@ def main() -> None:
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_soak",
-                                     "serve_prefix"])
+                                     "serve_prefix", "train_soak"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -327,6 +359,9 @@ def main() -> None:
               end="")
     elif args.stage == "serve_soak":
         print(",".join(str(s) for s in serve_soak_missing(args.dir)),
+              end="")
+    elif args.stage == "train_soak":
+        print(",".join(str(s) for s in train_soak_missing(args.dir)),
               end="")
     elif args.stage == "serve_prefix":
         print(",".join(serve_prefix_missing(args.dir)), end="")

@@ -261,6 +261,36 @@ def main() -> None:
                   f"{bool(r.get('drafter_quarantined'))}) | "
                   f"`serve_bench.py --soak` | |")
 
+    # Training kill/resume soak rows render pass/fail: a soak whose final
+    # params diverged from the uninterrupted run or whose recoveries are
+    # not all accounted in the typed event log is a resilience FAILURE
+    # even if it "measured" something — the same criteria as
+    # bench_gaps.train_soak_missing, so recorder and gate can't disagree.
+    tsoak = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "train_soak.jsonl"))
+         if "seed" in r and r.get("metric") == "train_soak"), "seed")
+    for r in sorted(tsoak.values(), key=lambda r: r.get("seed", 0)):
+        if (not measured(r) or not r.get("parity_ok")
+                or not r.get("accounted")):
+            why = r.get("error") or ", ".join(
+                w for w, bad in (("params diverged", not r.get("parity_ok")),
+                                 ("recovery unaccounted",
+                                  not r.get("accounted")))
+                if bad) or "no real measurement"
+            print(f"| train_soak seed={r.get('seed')} | FAILED: "
+                  f"{str(why)[:120]} | `resilience_bench.py` | |")
+        else:
+            print(f"| train soak seed={r['seed']} (kill/resume + fault "
+                  f"injection) | PASS: bit-exact params after "
+                  f"{r['value']} recoveries ({r.get('kills')} SIGKILLs, "
+                  f"{r.get('nan_rollbacks')} NaN + "
+                  f"{r.get('spike_rollbacks')} spike rollbacks, "
+                  f"{r.get('step_retries')} step retries "
+                  f"({r.get('hang_retries')} hangs), "
+                  f"{r.get('ckpt_fallbacks')} checkpoint fallbacks, "
+                  f"{r.get('loader_restarts')} loader restarts) | "
+                  f"`resilience_bench.py` | |")
+
     flash = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
          if "t" in r), "t")

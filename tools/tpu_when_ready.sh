@@ -99,6 +99,10 @@ serve_prefix_ok() {
   local out; out=$(python tools/bench_gaps.py serve_prefix) || return 1
   [ -z "$out" ]
 }
+train_soak_ok() {
+  local out; out=$(python tools/bench_gaps.py train_soak) || return 1
+  [ -z "$out" ]
+}
 mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
@@ -376,6 +380,22 @@ while true; do
         > bench_results/serve_soak.jsonl 2> bench_results/serve_soak.err
       log "serve_soak rc=$? -> bench_results/serve_soak.jsonl"
     fi
+    if train_soak_ok; then
+      log "train_soak.jsonl already good; skipping training soak"
+    else
+      # Training kill/resume soak (tpudp/resilience.py): subprocess
+      # trainer SIGKILL'd at random points + injected NaN/spike/stall/
+      # step-raise/loader faults + checkpoint corruption; a seed passes
+      # only with final params bit-identical to the uninterrupted run
+      # and every recovery accounted in the typed event log — resumes
+      # at seed granularity via bench_gaps, like the serve_soak stage.
+      bank bench_results/train_soak.jsonl
+      ensure_window
+      TRAIN_SOAK="$(python tools/bench_gaps.py train_soak)" \
+        timeout -k "$GRACE" "$(stage_t 900)" python benchmarks/resilience_bench.py \
+        > bench_results/train_soak.jsonl 2> bench_results/train_soak.err
+      log "train_soak rc=$? -> bench_results/train_soak.jsonl"
+    fi
     if flash_ok; then
       log "flash.jsonl already good; skipping flash bench"
     else
@@ -405,7 +425,7 @@ while true; do
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
         && lever_ok && collective_ok && serve_ok && serve_spec_ok \
-        && serve_soak_ok && serve_prefix_ok; then
+        && serve_soak_ok && serve_prefix_ok && train_soak_ok; then
       log "battery done"
       exit 0
     fi

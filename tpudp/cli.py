@@ -129,7 +129,27 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "restart + --checkpoint-dir resume. Must exceed one "
                         "full log window (log-every steps) plus first-step "
                         "compile time. The reference hangs forever in this "
-                        "case (SURVEY.md §5); default: disabled")
+                        "case (SURVEY.md §5); default: disabled. With "
+                        "--resilience the hang recovers IN-PROCESS instead "
+                        "of exiting")
+    p.add_argument("--resilience", action="store_true",
+                   help="run training under the in-process fault supervisor "
+                        "(tpudp/resilience.py, docs/RESILIENCE.md): NaN/"
+                        "spike windows roll back to the last verified "
+                        "checkpoint and replay deterministically, step "
+                        "faults and hangs retry in-process after an "
+                        "emergency dump, loader failures restart the "
+                        "pipeline at the exact batch offset. Requires "
+                        "--checkpoint-dir; the trajectory stays "
+                        "bit-identical to an uninterrupted run")
+    p.add_argument("--max-rollbacks", type=int, default=None, metavar="N",
+                   help="divergence-rollback budget before the original "
+                        "error escalates (--resilience only; default 3)")
+    p.add_argument("--spike-factor", type=float, default=None, metavar="X",
+                   help="roll back when a window loss exceeds X times the "
+                        "trailing-median window loss (--resilience only; "
+                        "default: spike detection off, NaN windows still "
+                        "roll back)")
     return p
 
 
@@ -169,6 +189,23 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
         raise SystemExit(
             "error: --eval-only requires --checkpoint-dir (there is no "
             "model to evaluate otherwise)")
+    if args.resilience and not args.checkpoint_dir:
+        raise SystemExit(
+            "error: --resilience requires --checkpoint-dir (rollback and "
+            "step recovery restore from the step_N series under it)")
+    if (args.max_rollbacks is not None or args.spike_factor is not None) \
+            and not args.resilience:
+        raise SystemExit(
+            "error: --max-rollbacks/--spike-factor configure the "
+            "--resilience supervisor; pass --resilience too")
+    if args.max_rollbacks is not None and args.max_rollbacks < 0:
+        raise SystemExit(
+            f"error: --max-rollbacks must be >= 0 (got {args.max_rollbacks})")
+    if args.spike_factor is not None and args.spike_factor <= 1.0:
+        raise SystemExit(
+            f"error: --spike-factor must be > 1.0 (got {args.spike_factor}) "
+            "— a window loss always 'exceeds' a sub-unit multiple of the "
+            "median and every window would roll back")
     if args.platform:  # must precede the first device query
         jax.config.update("jax_platforms", args.platform)
     initialize_distributed(args.master, args.num_nodes, args.rank, PORT)
@@ -192,6 +229,15 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     world = 1 if mesh is None else mesh.size
     num_hosts = jax.process_count()
     host_id = jax.process_index()
+    if args.resilience and num_hosts > 1:
+        raise SystemExit(
+            "error: --resilience is single-host for now: recovery makes "
+            "per-process restore/rollback decisions, and without a "
+            "cross-host agreement protocol two hosts could resume "
+            "different epochs (divergent replicas, wedged collectives). "
+            "Multi-host keeps the watchdog's exit-and-relaunch posture "
+            "(--step-timeout without --resilience); coordinated rollback "
+            "is future work (docs/RESILIENCE.md)")
 
     if args.batch_size % world or args.batch_size % num_hosts:
         raise SystemExit(
@@ -240,12 +286,18 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     if args.step_timeout:
         from tpudp.utils.watchdog import Watchdog
 
+        # Under --resilience the watchdog must NOT kill: the hang surfaces
+        # as StepHangError at the next beat and the supervisor recovers
+        # in-process (dump, restore, re-arm) instead of a full relaunch.
+        outcome = ("recovering in-process" if args.resilience
+                   else "exiting for scheduler restart")
         watchdog = Watchdog(
             timeout_s=args.step_timeout,
+            kill=not args.resilience,
             on_hang=[lambda: print(
                 f"[tpudp] FAILURE DETECTED: step exceeded "
                 f"{args.step_timeout}s (wedged collective or dead peer); "
-                "exiting for scheduler restart", flush=True)],
+                f"{outcome}", flush=True)],
         ).start()
     trainer = Trainer(model, mesh, sync, seed=args.seed,
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode,
@@ -269,10 +321,27 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
         from tpudp.utils.checkpoint import (emergency_dir, latest_step_dir,
                                             restore_checkpoint,
+                                            restore_latest_verified,
                                             save_checkpoint)
 
         latest = latest_step_dir(args.checkpoint_dir)
-        if latest:
+        if latest and jax.process_count() == 1:
+            # Verified restore with fallback: a torn or bit-flipped newest
+            # checkpoint (killed mid-save, disk rot) must never crash-loop
+            # the resume — walk back to the newest intact step dir
+            # (tpudp/utils/checkpoint.py::restore_latest_verified).
+            trainer.state, used, _skipped = restore_latest_verified(
+                args.checkpoint_dir, trainer.state, log=print)
+            start_epoch = int(used.rsplit("_", 1)[1])
+            restored = True
+            print(f"[tpudp] resumed from {used} (epoch {start_epoch})")
+        elif latest:
+            # Multi-host: per-process fallback/quarantine decisions could
+            # put hosts on DIFFERENT epochs (divergent replicas, wedged
+            # collectives) — keep the uniform-outcome legacy restore; a
+            # corrupt checkpoint crashes every process identically and
+            # the scheduler relaunches.  Coordinated multi-host fallback
+            # is future work (docs/RESILIENCE.md).
             trainer.state = restore_checkpoint(latest, trainer.state)
             start_epoch = int(latest.rsplit("_", 1)[1])
             restored = True
@@ -304,7 +373,27 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                     "Relaunch with the original configuration, or remove "
                     "the dump directory to restart the epoch from the "
                     "last step_N checkpoint.")
-            trainer.state = restore_checkpoint(emerg, trainer.state)
+            try:
+                # verify=True (single-host): the dump carries a checksum
+                # manifest; a dump whose sentinel committed but whose
+                # bytes rotted must fall back to the step series, never
+                # crash-loop the resume.  Multi-host keeps the legacy
+                # unverified restore: a per-process quarantine decision
+                # could leave hosts resuming different states.
+                trainer.state = restore_checkpoint(
+                    emerg, trainer.state, verify=jax.process_count() == 1)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                print(f"[tpudp] WARNING: emergency dump {emerg} failed "
+                      f"restore/verification ({e}); quarantining it and "
+                      "falling back to the epoch checkpoint series")
+                if jax.process_index() == 0:
+                    from tpudp.utils.checkpoint import quarantine_emergency
+
+                    quarantine_emergency(args.checkpoint_dir)
+                emerg = None
+        if emerg:
             restored = True
             if args.eval_only:
                 # Read-only use: evaluating the dump must not consume it —
@@ -318,15 +407,9 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
                 multihost_utils.sync_global_devices("tpudp_emergency_restore")
             if not args.eval_only and jax.process_index() == 0:
-                from tpudp.utils.checkpoint import clear_emergency_sentinel
+                from tpudp.utils.checkpoint import consume_emergency
 
-                used = emerg + ".restored"
-                if os.path.isdir(used):
-                    import shutil
-
-                    shutil.rmtree(used)
-                os.rename(emerg, used)
-                clear_emergency_sentinel(args.checkpoint_dir)
+                consume_emergency(args.checkpoint_dir)
             if not args.eval_only:
                 # Fast-forward instead of re-running the epoch head: the
                 # dump's optimizer-step counter is one per loader batch
@@ -347,31 +430,36 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                       f"{emerg} (epoch {start_epoch}: fast-forwarding "
                       f"{skip_first}/{per_epoch} already-trained batches)")
 
-        if watchdog is not None:
+        if args.checkpoint_async and not args.eval_only:
+            # BEFORE the watchdog dump hook: the dump closure must drain
+            # this writer's in-flight epoch-end save first — two orbax
+            # writers interleaving in one root can tear both checkpoints.
+            from tpudp.utils.checkpoint import AsyncCheckpointWriter
+
+            async_writer = AsyncCheckpointWriter()
+
+        if watchdog is not None and not args.resilience:
             # Failure recovery (VERDICT r1 #9): a detected hang dumps the
             # live TrainState before the process exits, so a wedged
             # collective loses at most the current epoch's progress since
             # the last completed step, not everything since the last epoch.
+            # The closure (shared with the resilience supervisor's step
+            # recovery) invalidates the previous dump's sentinel first,
+            # waits out any overlapped async epoch-end write, saves, then
+            # commits the sentinel only after orbax finalized.
+            # NOT registered under --resilience: the supervisor dumps at
+            # recovery time itself, and a second writer firing from the
+            # watchdog thread into the same emergency root would race it
+            # (two orbax writers in one root can tear both).
+            from tpudp.resilience import make_emergency_dump
+
+            _save = make_emergency_dump(
+                args.checkpoint_dir, lambda: trainer.state,
+                len(train_loader), async_writer=async_writer,
+                log=lambda s: print(s, flush=True))
+
             def _emergency_dump() -> None:
                 import threading
-
-                def _save() -> None:
-                    from tpudp.utils.checkpoint import (
-                        clear_emergency_sentinel, write_emergency_sentinel)
-
-                    # Invalidate any previous dump FIRST: if this save is
-                    # abandoned mid-write, a stale sentinel must not make
-                    # the half-written directory look restorable.
-                    clear_emergency_sentinel(args.checkpoint_dir)
-                    path = os.path.join(args.checkpoint_dir, "emergency")
-                    save_checkpoint(path, trainer.state)
-                    # Commit record: written only after orbax finalized.
-                    write_emergency_sentinel(
-                        args.checkpoint_dir,
-                        step=int(trainer.state.step),
-                        per_epoch_batches=len(train_loader))
-                    print(f"[tpudp] emergency checkpoint saved to {path}",
-                          flush=True)
 
                 # Bounded: saving fetches device buffers, and on a truly
                 # wedged device that fetch can hang — the dump must never
@@ -382,10 +470,38 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
             watchdog.on_hang.append(_emergency_dump)
 
-        if args.checkpoint_async and not args.eval_only:
-            from tpudp.utils.checkpoint import AsyncCheckpointWriter
+        if watchdog is not None and args.resilience:
+            # Hard-exit backstop: kill=False recovery only works for
+            # stalls that RETURN (the StepHangError surfaces at the next
+            # beat).  A truly wedged collective (dead peer) never returns
+            # to a beat, so without this the process would hang forever —
+            # strictly worse than the kill=True path it replaced.  If the
+            # supervisor has not recovered (re-armed clears _hang_seen)
+            # within a grace period, exit for the scheduler exactly like
+            # the non-resilient watchdog.
+            hang_gen = [0]  # per-hang generation: a stale backstop from
+            # an already-recovered hang must not fire during a LATER
+            # hang's still-in-grace recovery (that hang spawned its own
+            # backstop with a fresh full grace period)
 
-            async_writer = AsyncCheckpointWriter()
+            def _hard_exit_backstop() -> None:
+                import threading
+                import time as _time
+
+                hang_gen[0] += 1
+                my_gen = hang_gen[0]
+
+                def _backstop() -> None:
+                    _time.sleep(max(args.step_timeout, 60.0))
+                    if watchdog._hang_seen.is_set() and hang_gen[0] == my_gen:
+                        print("[tpudp] hang NOT recovered in-process "
+                              "(wedged collective?); exiting for "
+                              "scheduler restart", flush=True)
+                        os._exit(42)
+
+                threading.Thread(target=_backstop, daemon=True).start()
+
+            watchdog.on_hang.append(_hard_exit_backstop)
 
         def epoch_end_fn(epoch: int) -> None:
             path = os.path.join(args.checkpoint_dir, f"step_{epoch + 1}")
@@ -428,14 +544,36 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
 
     from tpudp.utils.profiler import trace
 
+    resilience = None
+    if args.resilience:
+        from tpudp.resilience import ResiliencePolicy
+
+        resilience = ResiliencePolicy(
+            checkpoint_dir=args.checkpoint_dir,
+            spike_factor=args.spike_factor,
+            # epoch_end_fn above already saves step_{epoch+1} into the
+            # same root; the supervisor must not double-write it.
+            save_epoch_checkpoints=False,
+            checkpoint_writer=async_writer,
+            **({"max_rollbacks": args.max_rollbacks}
+               if args.max_rollbacks is not None else {}),
+        )
+
     try:
         with trace(args.profile_dir):
             trainer.fit(train_loader, test_loader, epochs=args.epochs,
                         start_epoch=start_epoch, epoch_end_fn=epoch_end_fn,
-                        skip_batches_first_epoch=skip_first)
+                        skip_batches_first_epoch=skip_first,
+                        resilience=resilience)
     finally:
         if async_writer is not None:
             async_writer.close()  # join the last epoch's write
+    if resilience is not None:
+        s = trainer.stats
+        print(f"[tpudp] resilience summary: {s.get('rollbacks', 0)} "
+              f"rollbacks, {s.get('step_retries', 0)} step retries, "
+              f"{s.get('ckpt_fallbacks', 0)} checkpoint fallbacks, "
+              f"{s.get('loader_restarts', 0)} loader restarts")
     if watchdog is not None:
         watchdog.stop()
     if args.profile_dir:

@@ -37,6 +37,27 @@ class Prefetcher:
         self.loader = loader
         self.depth = depth
         self.place = place
+        self._lock = threading.Lock()
+        self._live: list[tuple[threading.Event, threading.Thread]] = []
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop every live worker thread and wait for it to exit.
+
+        Abandoning iteration mid-epoch normally stops the worker via the
+        generator's ``finally`` (GC-driven), but a consumer that merely
+        drops the iterator without closing it — a supervisor restarting
+        the pipeline, a relaunched soak worker — must be able to
+        GUARANTEE no ``tpudp-prefetch`` thread survives and no ``put`` is
+        left blocked.  Idempotent; the Prefetcher remains iterable after
+        close (a new ``__iter__`` spawns a fresh worker)."""
+        with self._lock:
+            live = list(self._live)
+        for stop, _t in live:
+            stop.set()
+        for _stop, t in live:
+            t.join(timeout)
+        with self._lock:
+            self._live = [e for e in self._live if e[1].is_alive()]
 
     def set_place(self, fn) -> None:
         """Install/replace the batch-placement hook (applies to batches
@@ -76,6 +97,8 @@ class Prefetcher:
                 put(e)
 
         t = threading.Thread(target=worker, daemon=True, name="tpudp-prefetch")
+        with self._lock:
+            self._live.append((stop, t))
         t.start()
         try:
             while True:
@@ -87,3 +110,5 @@ class Prefetcher:
                 yield item
         finally:
             stop.set()
+            with self._lock:
+                self._live = [e for e in self._live if e[0] is not stop]

@@ -1,0 +1,471 @@
+"""In-process fault supervision for ``Trainer.fit`` — the training-stack
+counterpart of the serve engine's containment layer (PR 3).
+
+The trainer's detectors existed before this module — the heartbeat
+``Watchdog`` sees hangs, ``check_finite`` sees non-finite loss windows,
+emergency dumps make a killed process resumable — but every one of them
+ended in a DEAD process that a human (or scheduler) had to relaunch.
+Production TPU training treats preemptions, flaky steps, and loss spikes
+as the steady state (arXiv:2204.06514); the contract a runtime must keep
+through them is TRAJECTORY CONSISTENCY (arXiv:2509.07003): recovery may
+cost wall time, never a different model.  This module converts each
+detector into in-process recovery under exactly that oracle — every
+recovery path restores a checkpoint and deterministically replays, so the
+final parameters are bit-identical to an uninterrupted run (the
+kill/resume soak in ``benchmarks/resilience_bench.py`` enforces this).
+
+Recovery taxonomy (docs/RESILIENCE.md):
+
+  * **Divergence rollback** — a non-finite loss window
+    (``FloatingPointError`` from ``check_finite``) or a window loss beyond
+    ``spike_factor`` x the trailing median (:class:`LossSpikeError`)
+    restores the newest VERIFIED checkpoint and fast-forwards the data
+    stream to the restore point (``train_epoch(skip_batches=)`` — host
+    RNG replays, so the consumed-batch sequence is unchanged).  Bounded
+    by ``max_rollbacks``, then the ORIGINAL error escalates.
+  * **Step-fault / hang recovery** — an exception escaping the train step
+    (or ``StepHangError`` from a ``kill=False`` watchdog) takes the
+    emergency-dump path, restores it (or falls back to the newest
+    verified checkpoint if the live state was invalidated by donation),
+    re-arms the watchdog, and continues IN THE SAME PROCESS.  A second
+    consecutive failure at the same step escalates.
+  * **Checkpoint-integrity fallback** — every restore verifies the
+    per-leaf checksum manifest; a torn/corrupt newest checkpoint falls
+    back to the previous intact step dir (``stats["ckpt_fallbacks"]``).
+  * **Loader containment** — an exception out of the loader/Prefetcher
+    worker restarts the pipeline and replays to the exact batch offset
+    (same host-RNG draws), bounded by ``max_loader_restarts`` per epoch.
+
+Every recovery is a typed event in ``trainer.stats["events"]`` with
+counters (``rollbacks`` / ``step_retries`` / ``ckpt_fallbacks`` /
+``loader_restarts``), so the soak can account one recovery per injected
+fault.  ``Trainer.fit(..., resilience=None)`` — the default — is
+byte-for-byte today's behavior: no supervisor, no extra host work, the
+original crash semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tpudp.utils.watchdog import StepHangError
+
+
+class LossSpikeError(RuntimeError):
+    """A finite but anomalous window loss: beyond ``spike_factor`` x the
+    trailing-median window loss.  Finite spikes poison momentum and can
+    take many windows to surface as NaN — rolling back at the spike is
+    the cheap early exit (veScale's trajectory argument)."""
+
+    def __init__(self, loss: float, median: float, step: int):
+        super().__init__(
+            f"training loss spike at step {step}: {loss:.6g} > "
+            f"{median:.6g} trailing median")
+        self.loss, self.median, self.step = loss, median, step
+
+
+class ResilienceExhausted(RuntimeError):
+    """Internal escalation signal: a recovery budget ran out.  Carries the
+    ORIGINAL error, which the supervisor re-raises — escalation must look
+    exactly like today's crash so schedulers/tests keyed on the original
+    exception type keep working."""
+
+    def __init__(self, message: str, original: BaseException):
+        super().__init__(message)
+        self.original = original
+
+
+@dataclass
+class ResiliencePolicy:
+    """Knobs for the in-process fault supervisor (``Trainer.fit``'s
+    ``resilience=`` argument).  ``checkpoint_dir`` is required: rollback
+    and step recovery restore from the ``step_N`` series (and the
+    emergency dump) under this root.
+
+    ``spike_factor=None`` disables spike detection (NaN windows still roll
+    back).  ``save_epoch_checkpoints=False`` is for drivers whose
+    ``epoch_end_fn`` already saves into the same root (tpudp.cli) — the
+    supervisor then never double-writes.  ``checkpoint_writer`` is the
+    driver's AsyncCheckpointWriter if one is active: the supervisor calls
+    ``wait()`` on it before any emergency dump so an overlapped epoch-end
+    write can never interleave with the dump in the same root."""
+
+    checkpoint_dir: str
+    max_rollbacks: int = 3
+    spike_factor: float | None = None
+    spike_window: int = 8
+    spike_min_history: int = 3
+    max_step_retries: int = 1
+    max_loader_restarts: int = 3
+    save_epoch_checkpoints: bool = True
+    checkpoint_writer: Any = None
+    on_event: Callable[[dict], None] | None = None
+
+
+def make_emergency_dump(checkpoint_dir: str, get_state,
+                        per_epoch_batches: int,
+                        async_writer=None, log=print) -> Callable[[], None]:
+    """Build the dump closure shared by the CLI's watchdog ``on_hang`` and
+    the supervisor's step recovery: invalidate the previous dump's
+    sentinel FIRST, drain any in-flight async epoch-end write (two orbax
+    writers interleaving in one root can tear both), save, then commit
+    the sentinel only after orbax finalized."""
+    from tpudp.utils.checkpoint import (clear_emergency_sentinel,
+                                        save_checkpoint,
+                                        write_emergency_sentinel)
+
+    def dump() -> None:
+        clear_emergency_sentinel(checkpoint_dir)
+        if async_writer is not None:
+            async_writer.wait()
+        state = get_state()
+        path = os.path.join(checkpoint_dir, "emergency")
+        save_checkpoint(path, state)
+        write_emergency_sentinel(checkpoint_dir, step=int(state.step),
+                                 per_epoch_batches=per_epoch_batches)
+        log(f"[tpudp] emergency checkpoint saved to {path}")
+
+    return dump
+
+
+def auto_resume(trainer, checkpoint_dir: str, per_epoch_batches: int,
+                *, log=print, on_event=None) -> tuple[int, int]:
+    """Restore ``trainer.state`` from ``checkpoint_dir`` the way the CLI
+    does — emergency dump preferred (then consumed), else the newest
+    VERIFIED ``step_N`` — and return ``(start_epoch, skip_batches)``.
+
+    Single-host distillation of tpudp.cli's resume block for supervised
+    workers (the soak's relaunch loop, tests); position is derived from
+    the restored optimizer-step counter, so any restore point continues
+    the exact batch grid."""
+    from tpudp.utils.checkpoint import (consume_emergency, emergency_dir,
+                                        latest_step_dir, quarantine_emergency,
+                                        restore_checkpoint,
+                                        restore_latest_verified)
+
+    restored = False
+    if latest_step_dir(checkpoint_dir):
+        state, path, skipped = restore_latest_verified(
+            checkpoint_dir, trainer.state, log=log)
+        trainer.state = state
+        restored = True
+        if on_event is not None:
+            for rejected, reason in skipped:
+                on_event({"kind": "ckpt_fallback", "rejected": rejected,
+                          "reason": reason})
+        log(f"[tpudp] resumed from {path}"
+            + (f" ({len(skipped)} newer checkpoint(s) skipped as corrupt)"
+               if skipped else ""))
+    emerg = emergency_dir(checkpoint_dir)
+    if emerg:
+        try:
+            trainer.state = restore_checkpoint(emerg, trainer.state,
+                                               verify=True)
+            restored = True
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            log(f"[tpudp] WARNING: emergency dump {emerg} failed "
+                f"verification ({e}); quarantined, using the step series")
+            quarantine_emergency(checkpoint_dir)
+        else:
+            consume_emergency(checkpoint_dir)
+            log(f"[tpudp] resumed mid-epoch state from emergency dump {emerg}")
+    if not restored:
+        return 0, 0
+    step = int(trainer.state.step)
+    return step // per_epoch_batches, step % per_epoch_batches
+
+
+class Supervisor:
+    """Runs ``Trainer._fit`` under the recovery loop.  One instance per
+    ``fit`` call; installs itself as ``trainer._resilience`` so the epoch
+    driver's (otherwise dormant) seams — window-loss observation, the
+    guarded batch iterator — report here."""
+
+    def __init__(self, trainer, policy: ResiliencePolicy):
+        import jax
+
+        if not policy.checkpoint_dir:
+            raise ValueError(
+                "ResiliencePolicy.checkpoint_dir is required: rollback and "
+                "step recovery restore from the step_N series under it")
+        if jax.process_count() > 1:
+            raise ValueError(
+                "resilience supervision is single-host for now: recovery "
+                "makes per-process restore/rollback/quarantine decisions, "
+                "and without a cross-host agreement protocol two hosts "
+                "could resume different epochs (docs/RESILIENCE.md)")
+        self.trainer = trainer
+        self.policy = policy
+        trainer.stats.update(rollbacks=0, step_retries=0, ckpt_fallbacks=0,
+                             loader_restarts=0, events=[])
+        self._window_losses: deque[float] = deque(maxlen=policy.spike_window)
+        self._last_failed_step: int | None = None
+        self._consecutive_at_step = 0
+        self._per_epoch: int | None = None
+
+    # -- event log ------------------------------------------------------
+    def record(self, kind: str, **fields) -> None:
+        event = {"kind": kind, **fields}
+        self.trainer.stats["events"].append(event)
+        if self.policy.on_event is not None:
+            self.policy.on_event(event)
+
+    # -- seams the Trainer calls ---------------------------------------
+    def observe_window_loss(self, loss: float, *, epoch: int,
+                            it: int) -> None:
+        """Called at every completed log window (value already
+        check_finite-verified).  Raises :class:`LossSpikeError` when the
+        window mean exceeds ``spike_factor`` x the trailing median; a
+        completed window is also the progress signal that clears the
+        consecutive-same-step failure tracking."""
+        self._last_failed_step = None
+        self._consecutive_at_step = 0
+        p = self.policy
+        if (p.spike_factor is not None
+                and len(self._window_losses) >= p.spike_min_history):
+            med = statistics.median(self._window_losses)
+            if med > 0 and loss > p.spike_factor * med:
+                step = epoch * (self._per_epoch or 0) + it
+                self.record("loss_spike", epoch=epoch, it=it, loss=loss,
+                            median=med, step=step)
+                raise LossSpikeError(loss, med, step)
+        self._window_losses.append(loss)
+
+    def guard_batches(self, loader, epoch: int, base):
+        """Wrap one epoch's batch iterator with loader containment: an
+        exception out of ``next()`` (the Prefetcher re-raises its worker's
+        exceptions there) restarts the pipeline and replays the already-
+        consumed draws, so the batch sequence — and every host-side RNG
+        draw behind it — is unchanged.  Bounded per epoch."""
+        t = self.trainer
+        beat = t.watchdog.beat if t.watchdog is not None else (lambda: None)
+        it, consumed, replay, restarts = base, 0, 0, 0
+        while True:
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            except StepHangError:
+                raise  # the watchdog's signal, not a loader fault
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                restarts += 1
+                if restarts > self.policy.max_loader_restarts:
+                    self.record("loader_escalation", epoch=epoch,
+                                restarts=restarts - 1, error=repr(e))
+                    raise ResilienceExhausted(
+                        f"loader failed {restarts} times in epoch {epoch}",
+                        e) from e
+                self.trainer.stats["loader_restarts"] += 1
+                self.record("loader_restart", epoch=epoch, offset=consumed,
+                            error=repr(e))
+                t.log(f"[tpudp] resilience: loader failed at batch "
+                      f"{consumed} of epoch {epoch} ({e!r}); restarting "
+                      "the pipeline and replaying to the exact offset")
+                if hasattr(it, "close"):
+                    it.close()  # generator close -> Prefetcher stop event
+                if hasattr(loader, "set_epoch"):
+                    loader.set_epoch(epoch)
+                it = iter(loader)
+                replay = consumed
+                continue
+            if replay:
+                replay -= 1  # discarded re-draw: host RNG replays
+                beat()
+                continue
+            consumed += 1
+            yield item
+
+    # -- recovery paths -------------------------------------------------
+    def _resume_position(self) -> tuple[int, int]:
+        step = int(self.trainer.state.step)
+        return step // self._per_epoch, step % self._per_epoch
+
+    def _restore_verified(self):
+        from tpudp.utils.checkpoint import restore_latest_verified
+
+        if self.policy.checkpoint_writer is not None:
+            # Drain any in-flight async epoch-end save first (mirrors the
+            # dump path): a half-materialized newest dir would otherwise
+            # be misread as corrupt, spuriously falling back (and
+            # replaying) one epoch further than necessary.
+            self.policy.checkpoint_writer.wait()
+        state, path, skipped = restore_latest_verified(
+            self.policy.checkpoint_dir, self.trainer.state,
+            log=self.trainer.log)
+        self.trainer.stats["ckpt_fallbacks"] += len(skipped)
+        for p, reason in skipped:
+            self.record("ckpt_fallback", rejected=p, reason=reason)
+        self.trainer.state = state
+        return path
+
+    def _rollback(self, e: BaseException) -> tuple[int, int]:
+        stats = self.trainer.stats
+        if stats["rollbacks"] >= self.policy.max_rollbacks:
+            self.record("rollback_escalation", error=repr(e),
+                        rollbacks=stats["rollbacks"])
+            self.trainer.log(
+                f"[tpudp] resilience: rollback budget "
+                f"({self.policy.max_rollbacks}) exhausted; escalating")
+            raise e  # escalate with the ORIGINAL error
+        stats["rollbacks"] += 1
+        path = self._restore_verified()
+        self._window_losses.clear()
+        if self.trainer.watchdog is not None:
+            self.trainer.watchdog.arm()
+        epoch, skip = self._resume_position()
+        self.record("rollback", error=repr(e), restored=path,
+                    step=int(self.trainer.state.step), epoch=epoch,
+                    skip=skip)
+        self.trainer.log(
+            f"[tpudp] resilience: {type(e).__name__} ({e}); rolled back to "
+            f"{path} (epoch {epoch}, {skip} batches in) and replaying")
+        return epoch, skip
+
+    def _step_recover(self, e: BaseException) -> tuple[int, int]:
+        from tpudp.utils.checkpoint import restore_checkpoint
+
+        t, stats = self.trainer, self.trainer.stats
+        try:
+            failed_step = int(t.state.step)
+        except Exception:
+            failed_step = None  # donated/invalid buffers
+        if failed_step is not None and failed_step == self._last_failed_step:
+            self._consecutive_at_step += 1
+        else:
+            self._consecutive_at_step = 1
+        self._last_failed_step = failed_step
+        if self._consecutive_at_step > self.policy.max_step_retries:
+            self.record("step_escalation", error=repr(e), step=failed_step,
+                        consecutive=self._consecutive_at_step)
+            t.log(f"[tpudp] resilience: step {failed_step} failed "
+                  f"{self._consecutive_at_step} consecutive times; "
+                  "escalating")
+            raise e  # escalate with the ORIGINAL error
+        stats["step_retries"] += 1
+        # The existing emergency-dump path, then restore IN-PROCESS (what
+        # cli.py previously achieved only through a full relaunch).  The
+        # dump doubles as validation that the live state is fetchable; a
+        # donated/invalid state fails here and we fall back to the newest
+        # verified checkpoint instead.
+        dump = make_emergency_dump(
+            self.policy.checkpoint_dir, lambda: t.state, self._per_epoch,
+            async_writer=self.policy.checkpoint_writer, log=t.log)
+        restored_from = None
+        try:
+            dump()
+            emerg = os.path.join(self.policy.checkpoint_dir, "emergency")
+            t.state = restore_checkpoint(emerg, t.state, verify=True)
+            restored_from = emerg
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as dump_err:
+            t.log(f"[tpudp] resilience: emergency dump/restore failed "
+                  f"({dump_err!r}); falling back to the newest verified "
+                  "checkpoint")
+            restored_from = self._restore_verified()
+        else:
+            # Consume the dump (mirrors cli resume): recovery succeeded
+            # in-process, so a LATER relaunch must resume from the step
+            # series (which only advances), not this now-stale snapshot.
+            # Outside the try: a housekeeping failure here must never
+            # discard the restore that just succeeded.
+            try:
+                from tpudp.utils.checkpoint import consume_emergency
+
+                consume_emergency(self.policy.checkpoint_dir)
+            except OSError as e:
+                t.log(f"[tpudp] WARNING: could not consume emergency "
+                      f"dump after recovery: {e}")
+        if t.watchdog is not None:
+            t.watchdog.arm()  # clears a recorded hang; re-arms monitoring
+        epoch, skip = self._resume_position()
+        self.record("step_retry", error=repr(e), step=failed_step,
+                    hang=isinstance(e, StepHangError),
+                    restored=restored_from, epoch=epoch, skip=skip)
+        t.log(f"[tpudp] resilience: {type(e).__name__} ({e}); restored "
+              f"{restored_from} and continuing in-process at epoch "
+              f"{epoch}, {skip} batches in")
+        return epoch, skip
+
+    # -- the supervision loop ------------------------------------------
+    def _ensure_initial_checkpoint(self, start_epoch: int,
+                                   skip_first: int) -> None:
+        """A rollback needs a restore target even before the first epoch
+        checkpoint lands: save ``step_<start_epoch>`` of the initial state
+        if the series is empty.  Skipped on a mid-epoch resume (the state
+        would not be an epoch boundary, and the step_N series' name
+        contract is 'state after epoch N')."""
+        from tpudp.utils.checkpoint import latest_step_dir, save_checkpoint
+
+        if skip_first or latest_step_dir(self.policy.checkpoint_dir):
+            return
+        path = os.path.join(self.policy.checkpoint_dir,
+                            f"step_{start_epoch}")
+        save_checkpoint(path, self.trainer.state)
+        self.record("initial_checkpoint", path=path)
+
+    def run(self, train_loader, test_loader, epochs: int, start_epoch: int,
+            epoch_end_fn, skip_first: int) -> None:
+        t = self.trainer
+        self._per_epoch = len(train_loader)
+        # Highest epoch whose epoch-end hook COMPLETED: a fault during
+        # eval or the hook itself resumes at the NEXT epoch boundary
+        # (state.step is already there), which would silently skip the
+        # missed hook — and with it the epoch's checkpoint save.  The
+        # loop below replays it before re-entering _fit.
+        self._epoch_end_done = start_epoch - 1
+
+        def epoch_end(epoch: int) -> None:
+            if epoch_end_fn is not None:
+                epoch_end_fn(epoch)
+            if self.policy.save_epoch_checkpoints:
+                from tpudp.utils.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    os.path.join(self.policy.checkpoint_dir,
+                                 f"step_{epoch + 1}"), t.state)
+            self._epoch_end_done = max(self._epoch_end_done, epoch)
+
+        self._ensure_initial_checkpoint(start_epoch, skip_first)
+        t._resilience = self
+        if t.watchdog is not None:
+            t.watchdog.arm()
+        cur_start, cur_skip = start_epoch, skip_first
+        try:
+            while True:
+                try:
+                    missed = cur_start - 1
+                    if (cur_skip == 0 and start_epoch <= missed
+                            and missed > self._epoch_end_done):
+                        # Recovery landed on an epoch boundary whose tail
+                        # (eval + epoch-end hook) never completed: replay
+                        # it, inside the try so a repeated failure goes
+                        # through the same recovery/escalation machinery
+                        # (state.step is unchanged through the tail, so a
+                        # second failure there escalates as same-step).
+                        if test_loader is not None:
+                            t.evaluate(test_loader, epoch=missed)
+                        epoch_end(missed)
+                    t._fit(train_loader, test_loader, epochs, cur_start,
+                           epoch_end, cur_skip)
+                    return
+                except ResilienceExhausted as e:
+                    raise e.original from e
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except (FloatingPointError, LossSpikeError) as e:
+                    cur_start, cur_skip = self._rollback(e)
+                except Exception as e:
+                    cur_start, cur_skip = self._step_recover(e)
+        finally:
+            t._resilience = None
+            if t.watchdog is not None:
+                t.watchdog.disarm()

@@ -756,12 +756,25 @@ class Trainer:
         metrics_jsonl: str | None = None,
         compress: str | None = None,
         verify_replicas: bool = False,
+        step_fault_hook: Callable[[str, int], None] | None = None,
     ):
         self.model = model
         self.mesh = mesh
         self.sync = sync
         self.strategy = strategy
         self.watchdog = watchdog  # tpudp.utils.watchdog.Watchdog or None
+        # Typed recovery counters/events, populated only when fit() runs
+        # under a ResiliencePolicy (tpudp.resilience); stays {} otherwise.
+        self.stats: dict = {}
+        # The active fit's Supervisor (tpudp.resilience) or None; guards
+        # the loss-spike observation and loader-containment seams below so
+        # the default path pays nothing.
+        self._resilience = None
+        # Deterministic fault seam (tpudp.training_faults): called as
+        # hook(kind, index) right before each jitted device call — the
+        # trainer analogue of serve's Engine(step_fault_hook=).
+        self.step_fault_hook = step_fault_hook
+        self._device_calls = 0  # monotonic: a retried step gets a NEW index
         # Post-epoch DP desync detector (tpudp.utils.consistency): torch
         # DDP's _verify_params_across_processes analogue, opt-in because
         # it fetches every replicated shard to the host.
@@ -936,6 +949,10 @@ class Trainer:
         prev_loss_sum = float(self.state.loss_sum)
         beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         batches = iter(loader)
+        if self._resilience is not None:
+            # Loader containment: pipeline exceptions restart + replay to
+            # the exact batch offset instead of killing the run.
+            batches = self._resilience.guard_batches(loader, epoch, batches)
         if skip_batches:
             skipped = 0
             for skipped, _discard in enumerate(batches, start=1):
@@ -950,6 +967,13 @@ class Trainer:
         for it, (images, labels, _w) in enumerate(batches, start=1):
             window_samples += _host_local_rows(images)
             images, labels = self._device_batch(images, labels)
+            if self.step_fault_hook is not None:
+                # Fault seam (tpudp.training_faults): raising here lands
+                # exactly where a real device-step failure would — inside
+                # the supervisor's step-recovery region; sleeping here
+                # simulates a wedged step for the watchdog.
+                self._device_calls += 1
+                self.step_fault_hook("train", self._device_calls)
             if self.timing_mode == "split":
                 # fetch_fence, not block_until_ready: under relay transports
                 # the latter can return before compute completes
@@ -978,6 +1002,9 @@ class Trainer:
                 cum = float(self.state.loss_sum)
                 losses.append(check_finite(
                     (cum - prev_loss_sum) / self.log_every, step=it))
+                if self._resilience is not None:
+                    self._resilience.observe_window_loss(
+                        losses[-1], epoch=epoch, it=it)
                 prev_loss_sum = cum
                 self.log(
                     "Training loss after {} iterations is {}".format(it, losses[-1])
@@ -1011,25 +1038,42 @@ class Trainer:
             cum = float(self.state.loss_sum)
             losses.append(check_finite(
                 (cum - prev_loss_sum) / (it % self.log_every), step=it))
+            if self._resilience is not None:
+                self._resilience.observe_window_loss(
+                    losses[-1], epoch=epoch, it=it)
             beat()
         return float(np.mean(losses)) if losses else 0.0
 
-    def evaluate(self, loader) -> tuple[float, float]:
-        """Full test pass; returns (avg_loss_per_sample, accuracy)."""
+    def evaluate(self, loader, *, epoch: int | None = None
+                 ) -> tuple[float, float]:
+        """Full test pass; returns (avg_loss_per_sample, accuracy).
+
+        The accumulated eval loss runs through ``check_finite`` like the
+        train windows do: a NaN eval means diverged/corrupted weights and
+        must fail loudly (with epoch + iteration context) instead of
+        reporting a garbage accuracy number."""
         # accumulate on device; fetch once at the end (async-dispatch friendly)
         self._install_place_hook(loader)
         beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         loss_sum = correct = count = jnp.zeros((), jnp.float32)
+        it = 0
         for images, labels, weights in loader:
             images, labels = self._device_batch(images, labels)
             if self._put is not None:
                 weights = self._put(weights)
+            if self.step_fault_hook is not None:
+                self._device_calls += 1
+                self.step_fault_hook("eval", self._device_calls)
             ls, c, n = self.eval_step(self.state, images, labels, weights)
             loss_sum, correct, count = loss_sum + ls, correct + c, count + n
+            it += 1
             beat()
         loss_sum, correct, count = (float(loss_sum), float(correct),
                                     max(float(count), 1.0))
-        avg_loss = loss_sum / count
+        avg_loss = check_finite(
+            loss_sum / count, step=int(self.state.step), what="eval loss",
+            context=(f"epoch {epoch}, " if epoch is not None else "")
+            + f"{it} eval batches")
         accuracy = correct / count
         self.log(
             "Test set: Average loss: {:.4f}, Accuracy: {}/{} ({:.0f}%)\n".format(
@@ -1042,7 +1086,7 @@ class Trainer:
 
     def fit(self, train_loader, test_loader=None, epochs: int = 1,
             *, start_epoch: int = 0, epoch_end_fn=None,
-            skip_batches_first_epoch: int = 0) -> None:
+            skip_batches_first_epoch: int = 0, resilience=None) -> None:
         """The reference's epoch loop (``src/Part 2a/main.py:64-68``).
         ``start_epoch`` supports checkpoint resume; ``epoch_end_fn(epoch)``
         runs after each epoch's eval (checkpoint hook);
@@ -1054,7 +1098,21 @@ class Trainer:
         monitoring: every train/eval iteration beats, so any blocking host
         call in between (window fetch, epoch barrier, eval) is covered —
         the timeout bounds the gap between completed iterations and must
-        exceed one full log window plus the first-step compile."""
+        exceed one full log window plus the first-step compile.
+
+        ``resilience`` (a ``tpudp.resilience.ResiliencePolicy``) runs the
+        loop under the in-process fault supervisor: divergence rollback,
+        step/hang retry, verified-checkpoint fallback, and loader
+        containment, with typed recovery accounting in ``self.stats``
+        (docs/RESILIENCE.md).  The default ``None`` is byte-for-byte the
+        unsupervised behavior above."""
+        if resilience is not None:
+            from tpudp.resilience import Supervisor
+
+            Supervisor(self, resilience).run(
+                train_loader, test_loader, epochs, start_epoch,
+                epoch_end_fn, skip_batches_first_epoch)
+            return
         if self.watchdog is not None:
             self.watchdog.arm()
         try:
@@ -1104,6 +1162,6 @@ class Trainer:
                              + (", cross-process fingerprints equal)"
                                 if jax.process_count() > 1 else ")"))
             if test_loader is not None:
-                self.evaluate(test_loader)
+                self.evaluate(test_loader, epoch=epoch)
             if epoch_end_fn is not None:
                 epoch_end_fn(epoch)

@@ -1,10 +1,19 @@
-"""Checkpoint / resume via orbax.
+"""Checkpoint / resume via orbax, with per-leaf integrity manifests.
 
 The reference has NO checkpointing (SURVEY.md §5: "training state lives and
 dies with the process") — this is a beyond-reference capability: save and
 restore the full :class:`tpudp.train.TrainState` (params, BatchNorm stats,
 optimizer state, step counter) so training resumes exactly where it stopped.
 Sharded arrays round-trip with their shardings on multi-device meshes.
+
+Integrity (the resilience layer's restore oracle, docs/RESILIENCE.md):
+every save also writes a per-leaf crc32 manifest beside the checkpoint
+directory (``<path>.manifest.json``).  ``restore_checkpoint(...,
+verify=True)`` recomputes the checksums on the restored arrays and raises
+:class:`CheckpointCorruptError` on any mismatch, and
+:func:`restore_latest_verified` walks the ``step_N`` series newest→oldest
+so a torn or bit-flipped newest checkpoint falls back to the previous
+intact one instead of crash-looping every resume.
 """
 
 from __future__ import annotations
@@ -23,24 +32,120 @@ except ImportError:  # pragma: no cover - orbax is baked into this image
     HAVE_ORBAX = False
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint restored cleanly but its bytes do not match the
+    per-leaf checksum manifest written at save time — silent corruption
+    (bit flip, torn write orbax did not catch).  Typed so resume flows can
+    fall back to an older checkpoint instead of crashing."""
+
+
 def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save_checkpoint(path: str | os.PathLike, state: Any, *, force: bool = True) -> str:
-    """Write ``state`` (any pytree, e.g. TrainState) to ``path``."""
+def manifest_path(path: str | os.PathLike) -> str:
+    """The integrity manifest lives BESIDE the checkpoint directory (not
+    inside it): orbax's item-free restore (:func:`restore_params`) scans
+    the directory to infer the tree, and a foreign file inside would be
+    misread as a leaf."""
+    return os.path.abspath(os.fspath(path)) + ".manifest.json"
+
+
+def leaf_checksums(state: Any) -> dict:
+    """Per-leaf crc32/dtype/shape over the pytree, keyed by
+    ``jax.tree_util.keystr`` path.  Leaves that are not fully addressable
+    on this process (multi-host shards) are recorded as skipped — a
+    checksum over a partial host view would be topology-dependent."""
+    import zlib
+
+    import numpy as np
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = jax.tree_util.keystr(path)
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            out[key] = {"skipped": "not fully addressable"}
+            continue
+        arr = np.asarray(leaf)
+        out[key] = {"crc32": zlib.crc32(arr.tobytes()) & 0xFFFFFFFF,
+                    "dtype": str(arr.dtype), "shape": list(arr.shape)}
+    return out
+
+
+def write_manifest(path: str | os.PathLike, state: Any) -> str:
+    """Write the per-leaf checksum manifest for the checkpoint at ``path``
+    (process 0 only on multi-host — one writer per file)."""
+    import json
+
+    mpath = manifest_path(path)
+    if jax.process_index() != 0:
+        return mpath
+    with open(mpath, "w") as f:
+        json.dump({"format": 1, "leaves": leaf_checksums(state)}, f)
+    return mpath
+
+
+def read_manifest(path: str | os.PathLike) -> dict | None:
+    """The manifest payload for the checkpoint at ``path``, or None if
+    absent/unreadable (checkpoints saved before manifests existed)."""
+    import json
+
+    try:
+        with open(manifest_path(path)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return None
+
+
+def verify_restored(path: str | os.PathLike, state: Any) -> tuple[bool, str]:
+    """Compare ``state`` (a freshly restored pytree) against the manifest
+    written when ``path`` was saved.  Returns ``(ok, detail)``; a missing
+    manifest verifies vacuously (legacy checkpoints carry none)."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return True, "no manifest (unverified legacy checkpoint)"
+    want = manifest.get("leaves", {})
+    have = leaf_checksums(state)
+    for key, rec in want.items():
+        if "crc32" not in rec:
+            continue  # skipped at save time (non-addressable leaf)
+        got = have.get(key)
+        if got is None:
+            return False, f"leaf {key} missing from restored tree"
+        if got.get("crc32") != rec["crc32"]:
+            return False, (f"leaf {key} checksum mismatch "
+                           f"(saved {rec['crc32']}, restored {got.get('crc32')})")
+    return True, f"{len(want)} leaves verified"
+
+
+def save_checkpoint(path: str | os.PathLike, state: Any, *,
+                    force: bool = True, manifest: bool = True) -> str:
+    """Write ``state`` (any pytree, e.g. TrainState) to ``path``.
+
+    ``manifest=True`` (default) also writes the per-leaf checksum manifest
+    beside the directory, making this checkpoint verifiable by
+    ``restore_checkpoint(..., verify=True)`` and eligible as a fallback
+    target for :func:`restore_latest_verified`."""
     if not HAVE_ORBAX:
         raise RuntimeError("orbax-checkpoint is not installed")
     path = os.path.abspath(os.fspath(path))
     _checkpointer().save(path, state, force=force)
+    if manifest:
+        write_manifest(path, state)
     return path
 
 
-def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
+def restore_checkpoint(path: str | os.PathLike, target: Any, *,
+                       verify: bool = False) -> Any:
     """Restore a pytree saved by :func:`save_checkpoint`.
 
     ``target`` is a matching pytree (e.g. a freshly built TrainState) used
     for structure, dtypes, and shardings; its values are not read.
+
+    ``verify=True`` recomputes per-leaf checksums on the restored arrays
+    against the save-time manifest and raises
+    :class:`CheckpointCorruptError` on mismatch (a checkpoint without a
+    manifest passes vacuously — there is nothing to compare).
     """
     if not HAVE_ORBAX:
         raise RuntimeError("orbax-checkpoint is not installed")
@@ -68,8 +173,85 @@ def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
 
     abstract = jax.tree.map(as_abstract, target)
     restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
-    return _checkpointer().restore(path, item=abstract,
-                                   restore_args=restore_args)
+    restored = _checkpointer().restore(path, item=abstract,
+                                       restore_args=restore_args)
+    if verify:
+        ok, detail = verify_restored(path, restored)
+        if not ok:
+            raise CheckpointCorruptError(f"checkpoint {path} corrupt: {detail}")
+    return restored
+
+
+def step_dirs_newest_first(root: str | os.PathLike) -> list[str]:
+    """All exact ``step_<digits>`` directories under ``root``, newest
+    (highest N) first — the fallback walk order for
+    :func:`restore_latest_verified`."""
+    root = os.fspath(root)
+    if not os.path.isdir(root):
+        return []
+    steps = sorted((int(m.group(1)), m.group(0))
+                   for d in os.listdir(root) if (m := _STEP_DIR.match(d)))
+    return [os.path.join(root, name) for _, name in reversed(steps)]
+
+
+def quarantine_step_dir(path: str) -> None:
+    """Move a rejected ``step_N`` dir (and its manifest) aside to
+    ``step_N.corrupt``, removing it from the step series: later walks must
+    not re-count the same corruption, ``latest_step_dir``/pruning must not
+    treat it as live state, and the bytes stay for forensics.  Rename
+    races (multi-host: every process walks the series) are tolerated —
+    whichever rename wins, the dir leaves the series."""
+    import shutil
+
+    target = path + ".corrupt"
+    try:
+        if os.path.isdir(target):
+            shutil.rmtree(target)
+        os.rename(path, target)
+    except OSError:
+        return
+    try:
+        os.replace(manifest_path(path), manifest_path(target))
+    except OSError:
+        pass
+
+
+def restore_latest_verified(root: str | os.PathLike, target: Any, *,
+                            log=print) -> tuple[Any, str, list[tuple[str, str]]]:
+    """Restore the newest INTACT ``step_N`` checkpoint under ``root``.
+
+    Walks the step series newest→oldest; a directory that fails to restore
+    (torn write, missing files) or fails its checksum manifest is
+    QUARANTINED (renamed ``step_N.corrupt`` — out of the series, so the
+    same corruption is never re-counted and pruning can't mistake it for
+    live state) with a logged warning, and the walk falls back to the
+    previous one — a corrupted newest checkpoint must never crash-loop
+    resume (docs/RESILIENCE.md).  Returns ``(state, path, skipped)``
+    where ``skipped`` lists ``(path, reason)`` for every rejected newer
+    checkpoint.  Raises FileNotFoundError if no step dirs exist and
+    RuntimeError if none of them is restorable."""
+    dirs = step_dirs_newest_first(root)
+    if not dirs:
+        raise FileNotFoundError(f"no step_N checkpoints under {os.fspath(root)!r}")
+    skipped: list[tuple[str, str]] = []
+    for path in dirs:
+        try:
+            state = restore_checkpoint(path, target, verify=True)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            reason = f"{type(e).__name__}: {e}"
+            skipped.append((path, reason))
+            log(f"[tpudp] WARNING: checkpoint {path} unrestorable "
+                f"({reason}); quarantining it and falling back to the "
+                "previous step dir")
+            quarantine_step_dir(path)
+            continue
+        return state, path, skipped
+    raise RuntimeError(
+        f"every step_N checkpoint under {os.fspath(root)!r} is corrupt or "
+        f"torn ({len(skipped)} tried); refusing to silently restart from "
+        "scratch — remove the directory to train fresh")
 
 
 class AsyncCheckpointWriter:
@@ -100,9 +282,17 @@ class AsyncCheckpointWriter:
         self._ckpt = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
 
     def save(self, path: str | os.PathLike, state: Any, *,
-             force: bool = True) -> str:
+             force: bool = True, manifest: bool = True) -> str:
         path = os.path.abspath(os.fspath(path))
         self._ckpt.save(path, state, force=force)
+        if manifest:
+            # Checksums must be computed NOW, before the caller's next
+            # donating step invalidates the device buffers (orbax staged
+            # its own device->host copy inside save for the same reason).
+            # The manifest may exist before the directory finalizes; a
+            # crash mid-write then leaves a torn dir whose verification
+            # fails, which is exactly the signal the fallback walk needs.
+            write_manifest(path, state)
         return path
 
     def wait(self) -> None:
@@ -173,6 +363,47 @@ def read_emergency_sentinel(root: str | os.PathLike) -> dict | None:
         return None
 
 
+def consume_emergency(root: str | os.PathLike) -> str:
+    """Consume a restored emergency dump: rename ``root/emergency`` to
+    ``emergency.restored`` (replacing any previous one) and clear the
+    sentinel, so later resumes fall back to the ``step_N`` series.  The
+    single implementation behind the CLI resume, ``auto_resume``, and the
+    supervisor's in-process step recovery."""
+    root = os.fspath(root)
+    emerg = os.path.join(root, "emergency")
+    consumed = emerg + ".restored"
+    if os.path.isdir(consumed):
+        import shutil
+
+        shutil.rmtree(consumed)
+    os.rename(emerg, consumed)
+    clear_emergency_sentinel(root)
+    return consumed
+
+
+def quarantine_emergency(root: str | os.PathLike) -> str | None:
+    """Move a corrupt/unverifiable emergency dump aside (to
+    ``emergency.corrupt``, bytes kept for forensics) and clear its
+    sentinel so resume falls back to the ``step_N`` series instead of
+    crash-looping.  Returns the quarantine path, or None if the rename
+    failed (the sentinel is still cleared, which alone stops the loop)."""
+    root = os.fspath(root)
+    emerg = os.path.join(root, "emergency")
+    target = emerg + ".corrupt"
+    moved = None
+    try:
+        if os.path.isdir(target):
+            import shutil
+
+            shutil.rmtree(target)
+        os.rename(emerg, target)
+        moved = target
+    except OSError:
+        pass
+    clear_emergency_sentinel(root)
+    return moved
+
+
 def emergency_dir(root: str | os.PathLike) -> str | None:
     """Return the watchdog's emergency-dump directory if a COMPLETE one
     exists.
@@ -223,28 +454,52 @@ def prune_step_dirs(root: str | os.PathLike, keep: int) -> list[str]:
     """Delete all but the newest ``keep`` ``step_N`` checkpoints under
     ``root``; returns the deleted paths.  Only exact ``step_<digits>``
     directories are candidates — orbax tmp dirs and the emergency dump are
-    never touched.  Multi-host callers should invoke this on process 0
-    only, after the save for the newest step has committed (the sync
-    saver and AsyncCheckpointWriter's serialized saves both guarantee the
-    PREVIOUS step is durable by then, so the retained set is always
-    restorable)."""
+    never touched, and the newest VERIFIABLE checkpoint (one carrying an
+    integrity manifest or orbax's finalization metadata) is never deleted
+    even when it falls outside the keep window: if the newer retained dirs
+    are all torn, that dir is the only restorable state left and pruning
+    it would make the next resume impossible (docs/RESILIENCE.md).
+    A pruned dir's manifest file is deleted with it.  Residual window:
+    SILENT rot of a never-yet-restored newest dir keeps its manifest, so
+    the protection can still pick it while ``keep=1`` deletes the intact
+    older dir — restore-time rejection quarantines corrupt dirs out of
+    the series, but only once a restore has actually run; prefer
+    ``keep >= 2`` when the storage is suspect.  Multi-host callers
+    should invoke this on process 0 only, after the save for the newest
+    step has committed (the sync saver and AsyncCheckpointWriter's
+    serialized saves both guarantee the PREVIOUS step is durable by then,
+    so the retained set is always restorable)."""
     import shutil
 
     root = os.fspath(root)
     if keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
-    if not os.path.isdir(root):
-        return []
-    steps = sorted((int(m.group(1)), m.group(0))
-                   for d in os.listdir(root) if (m := _STEP_DIR.match(d)))
+    newest_first = step_dirs_newest_first(root)  # the one scan the
+    # restore-fallback walk uses too — prune and restore can't disagree
+    # about what the series contains
+    protected = next(
+        (path for path in newest_first
+         if os.path.exists(manifest_path(path))
+         or os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))),
+        None)
     deleted = []
-    for _, name in steps[:-keep]:
-        path = os.path.join(root, name)
+    for path in list(reversed(newest_first))[:-keep]:
+        if path == protected:
+            continue
         try:
             shutil.rmtree(path)
         except OSError as e:
             print(f"[tpudp] WARNING: could not prune checkpoint {path}: {e}")
             continue
+        try:
+            os.unlink(manifest_path(path))
+        except FileNotFoundError:
+            pass
+        except OSError as e:  # same tolerance as the rmtree above: a
+            # housekeeping failure must never kill (or, under the
+            # supervisor, fault-retry) the training run
+            print(f"[tpudp] WARNING: could not remove manifest of pruned "
+                  f"checkpoint {path}: {e}")
         deleted.append(path)
     return deleted
 
@@ -292,12 +547,8 @@ def latest_step_dir(root: str | os.PathLike) -> str | None:
 
     Only exact ``step_<digits>`` names count — orbax leaves
     ``step_N.orbax-checkpoint-tmp-*`` directories behind after an
-    interrupted save, and those must never be selected (or parsed)."""
-    root = os.fspath(root)
-    if not os.path.isdir(root):
-        return None
-    steps = [m for d in os.listdir(root) if (m := _STEP_DIR.match(d))]
-    if not steps:
-        return None
-    best = max(steps, key=lambda m: int(m.group(1)))
-    return os.path.join(root, best.group(0))
+    interrupted save (and the resilience layer quarantines corrupt dirs
+    as ``step_N.corrupt``), and those must never be selected (or
+    parsed)."""
+    dirs = step_dirs_newest_first(root)
+    return dirs[0] if dirs else None

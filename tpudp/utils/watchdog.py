@@ -194,14 +194,20 @@ class Watchdog:
                     self._deadline = None
 
 
-def check_finite(loss_value: float, step: int | None = None) -> float:
+def check_finite(loss_value: float, step: int | None = None, *,
+                 what: str = "training loss",
+                 context: str | None = None) -> float:
     """Fail-fast divergence/corruption check (cheap; call at log windows
-    where the host already synchronized)."""
+    where the host already synchronized).  ``what``/``context`` label the
+    failure site — eval losses run through here too (a NaN eval must fail
+    loudly with epoch + iteration context, not report garbage accuracy)."""
     import math
 
     if not math.isfinite(loss_value):
         where = f" at step {step}" if step is not None else ""
+        if context:
+            where += f" ({context})"
         raise FloatingPointError(
-            f"non-finite training loss{where}: {loss_value!r} — diverged "
+            f"non-finite {what}{where}: {loss_value!r} — diverged "
             "or corrupted replica")
     return loss_value
