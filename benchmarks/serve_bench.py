@@ -64,13 +64,29 @@ headline ``value`` = uncached/cached TTFT p50 ratio.
 With ``--soak SEED1,SEED2`` (or SERVE_SOAK) the bench instead runs the
 fault-injection SOAK harness (one ``serve_soak`` row per seed): a
 deterministic per-seed mix of random cancels, impossible and tight
-deadlines, queue-limit sheds, a drafter that dies mid-run, and injected
-device-step faults (``tpudp.serve.faults``) against a small engine.  A
-seed PASSES only if the run never wedges (bounded step count), the
-engine ends empty (``no_leak`` — no slot or queue entry stranded), and
-every surviving completed request's greedy output is bit-identical to
-``generate()`` (``parity_ok``).  The gap gate
-(tools/bench_gaps.serve_soak_missing) retries anything less.
+deadlines, queue-limit sheds, a drafter that dies mid-run, injected
+device-step faults, and a PREEMPTION STORM — scheduled high-priority
+bursts (``tpudp.serve.faults.PreemptionStorm``) that evict low-tier
+in-flight slots through the tenancy layer's carry-over path — against a
+small tenant-aware engine.  A seed PASSES only if the run never wedges
+(bounded step count), the engine ends empty (``no_leak`` — no slot or
+queue entry stranded), and every surviving completed request's greedy
+output is bit-identical to ``generate()`` (``parity_ok``).  The gap
+gate (tools/bench_gaps.serve_soak_missing) retries anything less.
+
+With ``--tenants SEED1,SEED2`` (or SERVE_TENANCY) the bench instead
+runs the MULTI-TENANT mixed workload (one ``serve_tenancy`` row per
+seed): a small engine with a high-priority tier over two equal-priority
+weighted tiers (3:1).  Phase A measures the high tier's TTFT p99 with
+no other load; phase B saturates the low tiers well past capacity
+(their per-class queue_limits shed the excess) while the same high-tier
+arrival pattern rides on top, preempting low slots.  The row records
+per-tier TTFT and token-latency percentiles, measured fairness shares
+vs the configured weights, shed/preemption counts, and three gates the
+resume machinery keys on: ``p99_ok`` (high-tier overload TTFT p99 <=
+baseline p99 x TENANCY_P99_BOUND — the SLO priority scheduling exists
+to defend), ``parity_ok`` (every completed request, preempted or not,
+bit-identical to ``generate()``), and ``no_leak``.
 
 Runs on whatever device is attached; SERVE_PLATFORM=cpu pins the CPU
 smoke mode (tier-1 runs it at a trimmed geometry).  Knobs: SERVE_CONCURRENCY
@@ -84,6 +100,8 @@ SERVE_QUEUE_LIMIT, SERVE_DEADLINE_S, SERVE_TTFT_DEADLINE_S,
 SERVE_PREFIX_BLOCKS, SERVE_PREFIX_LEN, SERVE_PREFIX_CONCURRENCY,
 SERVE_PREFIX_USERS, SERVE_PREFIX_TURNS,
 SOAK_REQUESTS, SOAK_LAYERS, SOAK_DMODEL, SOAK_VOCAB,
+SERVE_TENANCY (seed subset), TENANCY_STEPS, TENANCY_HIGH, TENANCY_QL,
+TENANCY_P99_BOUND, TENANCY_LAYERS, TENANCY_DMODEL, TENANCY_VOCAB,
 SERVE_STRICT_LEVELS=1 (reject unregistered levels/seeds).
 """
 
@@ -97,12 +115,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
                               SERVE_PREFIX_WORKLOADS, SERVE_SOAK_SEEDS,
-                              SERVE_SPEC_KS)
+                              SERVE_SPEC_KS, SERVE_TENANCY_SEEDS)
 
 METRIC = "serve_tokens_per_sec"
 SPEC_METRIC = "serve_spec_tokens_per_sec"
 SOAK_METRIC = "serve_soak"
 PREFIX_METRIC = "serve_prefix"
+TENANCY_METRIC = "serve_tenancy"
 
 
 def _percentile(xs, q):
@@ -132,6 +151,12 @@ def main() -> None:
                          "(shared_prefix, multiturn); emits TTFT "
                          "cache-on/off rows instead of the concurrency "
                          "sweep (env: SERVE_PREFIX)")
+    ap.add_argument("--tenants", default=None,
+                    help="comma-separated multi-tenant seeds; runs the "
+                         "mixed-priority tenancy workload (per-tier "
+                         "p50/p99, fairness shares, sheds, preemptions) "
+                         "instead of the concurrency sweep "
+                         "(env: SERVE_TENANCY)")
     ap.add_argument("--queue-limit", default=None,
                     help="bound the engine queue in the concurrency "
                          "sweep; overload sheds with QueueFull and rows "
@@ -153,12 +178,14 @@ def main() -> None:
 
     from tpudp.models.generate import generate
     from tpudp.models.gpt2 import GPT2, GPT2Config
-    from tpudp.serve import Engine, NgramDrafter, QueueFull
+    from tpudp.serve import Engine, NgramDrafter, QueueFull, TenantClass
 
     spec_env = args.speculate_k or os.environ.get("SERVE_SPECULATE_K")
     spec_ks = _parse_levels(spec_env) if spec_env else []
     soak_env = args.soak or os.environ.get("SERVE_SOAK")
     soak_seeds = _parse_levels(soak_env) if soak_env else []
+    tenancy_env = args.tenants or os.environ.get("SERVE_TENANCY")
+    tenancy_seeds = _parse_levels(tenancy_env) if tenancy_env else []
     prefix_env = args.prefix_cache or os.environ.get("SERVE_PREFIX")
     prefix_workloads = ([w for w in prefix_env.split(",") if w]
                         if prefix_env else [])
@@ -174,7 +201,8 @@ def main() -> None:
               if levels_env else list(SERVE_CONCURRENCIES))
     if os.environ.get("SERVE_STRICT_LEVELS") == "1":
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
-        if not spec_ks and not soak_seeds and not prefix_workloads and bad:
+        if (not spec_ks and not soak_seeds and not prefix_workloads
+                and not tenancy_seeds and bad):
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
         bad_k = [k for k in spec_ks if k not in SERVE_SPEC_KS]
@@ -185,6 +213,11 @@ def main() -> None:
         if bad_s:
             raise SystemExit(f"error: unregistered soak seeds {bad_s} "
                              f"(registry: {list(SERVE_SOAK_SEEDS)})")
+        bad_t = [s for s in tenancy_seeds
+                 if s not in SERVE_TENANCY_SEEDS]
+        if bad_t:
+            raise SystemExit(f"error: unregistered tenancy seeds {bad_t} "
+                             f"(registry: {list(SERVE_TENANCY_SEEDS)})")
     n_requests = int(os.environ.get("SERVE_REQUESTS", 24))
     prompt_len = int(os.environ.get("SERVE_PROMPT_LEN", 16))
     max_new = int(os.environ.get("SERVE_MAX_NEW", 32))
@@ -241,9 +274,10 @@ def main() -> None:
         d_model=dm,
     )
     model = GPT2(cfg)
-    # Soak mode builds its own tiny model (it measures scheduling under
-    # faults, not FLOPs) — don't pay the ~93 MB default init for it.
-    params = (None if soak_seeds else
+    # Soak and tenancy modes build their own tiny models (they measure
+    # scheduling under faults/priorities, not FLOPs) — don't pay the
+    # ~93 MB default init for them.
+    params = (None if soak_seeds or tenancy_seeds else
               model.init(jax.random.PRNGKey(seed),
                          jnp.zeros((1, 8), jnp.int32))["params"])
     kind = jax.devices()[0].device_kind
@@ -325,7 +359,8 @@ def main() -> None:
     # against per-request generate() references, not throughput.
     seq_tps = per_req_s = None
     seq_latencies = []
-    if not spec_ks and not soak_seeds and not prefix_workloads:
+    if (not spec_ks and not soak_seeds and not prefix_workloads
+            and not tenancy_seeds):
         np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
                             max_new))
         t0 = time.perf_counter()
@@ -472,17 +507,21 @@ def main() -> None:
 
     def run_soak(soak_seed: int) -> None:
         """Fault-injection soak against the robustness layer, fully
-        deterministic per seed: a small engine (tiny config — the soak
-        exercises SCHEDULING under faults, not FLOPs) serves a workload
-        mixing free-running requests, impossible TTFT deadlines, tight
-        total deadlines, mid-stream client cancels, and queue-limit
-        sheds, while a drafter dies mid-run (quarantine) and two device
-        steps are injected to fail (requeue-once containment).  The row
-        passes only if nothing wedged (bounded step count), the engine
-        ended empty, and every surviving COMPLETE request's greedy
-        output is bit-identical to generate()."""
+        deterministic per seed: a small tenant-aware engine (tiny
+        config — the soak exercises SCHEDULING under faults, not FLOPs)
+        serves a workload mixing free-running requests, impossible TTFT
+        deadlines, tight total deadlines, mid-stream client cancels,
+        and queue-limit sheds, while a drafter dies mid-run
+        (quarantine), two device steps are injected to fail
+        (requeue-once containment), and a PREEMPTION STORM of scheduled
+        high-priority bursts evicts low-tier slots through the tenancy
+        carry-over path.  The row passes only if nothing wedged
+        (bounded step count), the engine ended empty, and every
+        surviving COMPLETE request's greedy output — storm and
+        preempted requests included — is bit-identical to generate()."""
         from tpudp.serve import FinishReason
-        from tpudp.serve.faults import FailingDrafter, FaultySteps
+        from tpudp.serve.faults import (FailingDrafter, FaultySteps,
+                                        PreemptionStorm)
 
         srng = np.random.default_rng(10_000 + soak_seed)
         s_cfg = GPT2Config(
@@ -501,23 +540,37 @@ def main() -> None:
                      .astype(np.int32) for _ in range(n)]
         hook = FaultySteps(
             fail_at=set(int(x) for x in srng.integers(5, 60, size=2)))
+        # The main workload rides the bounded "default" class; the storm
+        # submits into the unbounded high-priority "urgent" class, so
+        # every storm burst that lands while the slots are busy forces a
+        # preemption (bit-exact carry-over is part of the pass bar).
         eng = Engine(
             s_model, s_params, num_slots=4, max_len=32, prefill_chunk=8,
             speculate_k=2,
             drafter=FailingDrafter(inner=NgramDrafter(),
                                    ok_proposals=int(srng.integers(1, 8))),
-            queue_limit=6, drafter_timeout_s=30.0, step_fault_hook=hook)
+            drafter_timeout_s=30.0, step_fault_hook=hook,
+            tenants={"default": TenantClass(priority=0, queue_limit=6),
+                     "urgent": TenantClass(priority=1)})
         # Request mix by kind: 0 -> impossible TTFT deadline (expires
         # while queued), 1 -> tight total deadline (expires wherever the
         # clock catches it), 2 -> cancelled mid-stream, else free-run.
         kinds = srng.integers(0, 8, size=n)
         cancel_at = {i: int(srng.integers(1, s_new))
                      for i in range(n) if kinds[i] == 2}
+        storm_new = 4
+        storm = PreemptionStorm(
+            "urgent",
+            [srng.integers(0, s_cfg.vocab_size, size=p_len)
+             .astype(np.int32) for _ in range(3)],
+            at_steps=sorted(int(x) for x in srng.integers(4, 40, size=3)),
+            max_new=storm_new, seed=1_000 + soak_seed)
         handles: list = []
         submitted = 0
         steps = 0
         max_steps = 100 + 40 * n  # wedge guard: way past any honest run
-        while ((submitted < n or eng.slots_in_use or eng.queue_depth)
+        while ((submitted < n or eng.slots_in_use or eng.queue_depth
+                or not storm.done)
                and steps < max_steps):
             for _ in range(3):  # submit in waves: queue + admission churn
                 if submitted >= n:
@@ -536,6 +589,7 @@ def main() -> None:
                 submitted += 1
             eng.step()
             steps += 1
+            storm.tick(eng, steps)
             for i, h in enumerate(handles):
                 if (h is not None and not h.done and i in cancel_at
                         and len(h.tokens) >= cancel_at[i]):
@@ -553,12 +607,22 @@ def main() -> None:
                                       s_new))[0, p_len:]
             if h.tokens != ref.tolist():
                 parity_ok = False
+        for h in storm.handles:
+            if h is None or h.finish_reason is not FinishReason.COMPLETE:
+                continue
+            completed += 1
+            ref = np.asarray(generate(s_model, s_params,
+                                      jnp.asarray(h.prompt[None]),
+                                      storm_new))[0, p_len:]
+            if h.tokens != ref.tolist():
+                parity_ok = False
         emit({
             "metric": SOAK_METRIC,
             "seed": soak_seed,
             "value": completed,
             "unit": "completed_requests",
             "requests": n,
+            "storm_requests": storm.submitted,
             "steps": steps,
             "wedged": wedged,
             "no_leak": no_leak,
@@ -568,11 +632,204 @@ def main() -> None:
             "cancelled": int(eng.stats["cancelled"]),
             "errors": int(eng.stats["errors"]),
             "requeued": int(eng.stats["requeued"]),
+            "preempted": int(eng.stats["preempted"]),
             "step_failures": int(eng.stats["step_failures"]),
             "drafter_quarantined": int(eng.stats["drafter_quarantined"]),
             "num_layers": s_cfg.num_layers,
             "d_model": s_cfg.d_model,
             "vocab_size": s_cfg.vocab_size,
+            "device_kind": kind,
+        })
+
+    def run_tenancy(t_seed: int) -> None:
+        """Multi-tenant mixed workload: one high-priority tier over two
+        equal-priority weighted tiers (3:1), tiny model (the row
+        measures SCHEDULING — priorities, preemption, fair shares —
+        not FLOPs).
+
+        Phase A (baseline): the high tier alone, one request at a time,
+        records the no-load TTFT distribution.  Phase B (overload): the
+        low tiers are burst-submitted past their per-class queue_limits
+        every step (the excess sheds — that IS the overload evidence)
+        while the same high-tier arrivals ride on top, preempting
+        low-tier slots whenever none is free.  The row's gates:
+        ``p99_ok`` — high-tier TTFT p99 under overload held within
+        TENANCY_P99_BOUND x the phase-A p99; ``parity_ok`` — every
+        completed request (preempted, resumed, high or low) greedy-
+        bit-identical to generate(); ``no_leak`` — the engine ended
+        empty.  Fairness: admitted shares of the two low tiers vs their
+        configured 3:1 weights, within 10%."""
+        from tpudp.serve import FinishReason
+
+        trng = np.random.default_rng(20_000 + t_seed)
+        t_cfg = GPT2Config(
+            vocab_size=int(os.environ.get("TENANCY_VOCAB", 128)),
+            max_seq_len=64,
+            num_layers=int(os.environ.get("TENANCY_LAYERS", 2)),
+            num_heads=2,
+            d_model=int(os.environ.get("TENANCY_DMODEL", 64)),
+        )
+        t_model = GPT2(t_cfg)
+        t_params = t_model.init(jax.random.PRNGKey(t_seed),
+                                jnp.zeros((1, 8), jnp.int32))["params"]
+        p_len, t_new = 8, 8
+        n_high = int(os.environ.get("TENANCY_HIGH", 12))
+        phase_steps = int(os.environ.get("TENANCY_STEPS", 240))
+        ql = int(os.environ.get("TENANCY_QL", 4))
+        bound = float(os.environ.get("TENANCY_P99_BOUND", 5.0))
+        w_a, w_b = 3.0, 1.0
+
+        def make_engine():
+            return Engine(
+                t_model, t_params, num_slots=4, max_len=32,
+                prefill_chunk=8,
+                tenants={"high": TenantClass(priority=1),
+                         "lo_a": TenantClass(priority=0, weight=w_a,
+                                             queue_limit=ql),
+                         "lo_b": TenantClass(priority=0, weight=w_b,
+                                             queue_limit=ql)})
+
+        high_prompts = [trng.integers(0, t_cfg.vocab_size, size=p_len)
+                        .astype(np.int32) for _ in range(n_high)]
+        # Low traffic cycles a small prompt pool: scheduling doesn't
+        # care about prompt diversity, and the pool keeps the parity
+        # referee to a handful of generate() references (memoized).
+        low_pool = [trng.integers(0, t_cfg.vocab_size, size=p_len)
+                    .astype(np.int32) for _ in range(8)]
+        refs: dict = {}
+
+        def check(h) -> bool:
+            key = (h.prompt.tobytes(), h.max_new_tokens)
+            if key not in refs:
+                refs[key] = np.asarray(generate(
+                    t_model, t_params, jnp.asarray(h.prompt[None]),
+                    h.max_new_tokens))[0, h.prompt.size:].tolist()
+            return h.tokens == refs[key]
+
+        parity_ok = True
+
+        # Phase A: no-load baseline for the high tier's TTFT (one
+        # unmeasured warmup request first — compile time is not an SLO).
+        eng_a = make_engine()
+        warm = eng_a.submit(low_pool[0], t_new, tenant="high")
+        eng_a.run_until_complete()
+        parity_ok = check(warm) and parity_ok
+        base_ttfts = []
+        for i, p in enumerate(high_prompts):
+            h = eng_a.submit(p, t_new, seed=t_seed + i, tenant="high")
+            eng_a.run_until_complete()
+            base_ttfts.append(h.token_times[0] - h.submit_time)
+            parity_ok = check(h) and parity_ok
+        base_p99 = _percentile(base_ttfts, 99)
+
+        # Phase B: overload.  Fresh engine, same (cfg, params) tree —
+        # the step programs are already warm through the shared LRU.
+        eng = make_engine()
+        high_handles: list = []
+        low_handles: list = []
+        shed = 0
+        hi_sub = 0
+        low_seed = 0
+        high_every = max(phase_steps // n_high, 1)
+        steps = 0
+        max_steps = 4 * phase_steps + 200  # wedge guard
+        while ((steps < phase_steps or hi_sub < n_high
+                or eng.slots_in_use or eng.queue_depth)
+               and steps < max_steps):
+            if steps < phase_steps:
+                for name in ("lo_a", "lo_b"):
+                    for _ in range(2):  # burst past the bound -> sheds
+                        try:
+                            low_handles.append(eng.submit(
+                                low_pool[low_seed % len(low_pool)],
+                                t_new, seed=5_000 + low_seed,
+                                tenant=name))
+                        except QueueFull:
+                            shed += 1
+                        low_seed += 1
+            if hi_sub < n_high and steps % high_every == 0:
+                high_handles.append(eng.submit(
+                    high_prompts[hi_sub], t_new, seed=t_seed + hi_sub,
+                    tenant="high"))
+                hi_sub += 1
+            eng.step()
+            steps += 1
+        wedged = steps >= max_steps
+        no_leak = eng.slots_in_use == 0 and eng.queue_depth == 0
+
+        def tier_latency(handles):
+            ttfts, gaps = [], []
+            for h in handles:
+                if not h.token_times:
+                    continue
+                ttfts.append(h.token_times[0] - h.submit_time)
+                prev = h.submit_time
+                for t in h.token_times:
+                    gaps.append(t - prev)
+                    prev = t
+            return ttfts, gaps
+
+        hi_ttfts, hi_gaps = tier_latency(high_handles)
+        lo_ttfts, lo_gaps = tier_latency(low_handles)
+        completed_high = sum(
+            h.finish_reason is FinishReason.COMPLETE for h in high_handles)
+        completed_low = sum(
+            h.finish_reason is FinishReason.COMPLETE for h in low_handles)
+        for h in high_handles + low_handles:
+            if h.finish_reason is FinishReason.COMPLETE:
+                parity_ok = check(h) and parity_ok
+        hi_p99 = _percentile(hi_ttfts, 99)
+        p99_ok = (completed_high == n_high and hi_p99 is not None
+                  and base_p99 is not None and hi_p99 <= base_p99 * bound)
+        adm_a = int(eng.tenant_stats["lo_a"]["admitted"])
+        adm_b = int(eng.tenant_stats["lo_b"]["admitted"])
+        share = adm_a / (adm_a + adm_b) if adm_a + adm_b else None
+        share_cfg = w_a / (w_a + w_b)
+        fairness_ok = (share is not None
+                       and abs(share - share_cfg) <= 0.10)
+        emit({
+            "metric": TENANCY_METRIC,
+            "seed": t_seed,
+            "value": round(hi_p99 * 1e3, 3) if hi_p99 else 0.0,
+            "unit": "high_tier_overload_ttft_p99_ms",
+            "p99_ok": p99_ok,
+            "p99_bound": bound,
+            "ttft_p99_baseline_ms": (round(base_p99 * 1e3, 3)
+                                     if base_p99 else None),
+            "ttft_p50_ms_high": round(
+                _percentile(hi_ttfts, 50) * 1e3, 3) if hi_ttfts else None,
+            "ttft_p50_ms_low": round(
+                _percentile(lo_ttfts, 50) * 1e3, 3) if lo_ttfts else None,
+            "ttft_p99_ms_low": round(
+                _percentile(lo_ttfts, 99) * 1e3, 3) if lo_ttfts else None,
+            "p50_token_latency_ms_high": round(
+                _percentile(hi_gaps, 50) * 1e3, 3) if hi_gaps else None,
+            "p99_token_latency_ms_high": round(
+                _percentile(hi_gaps, 99) * 1e3, 3) if hi_gaps else None,
+            "p50_token_latency_ms_low": round(
+                _percentile(lo_gaps, 50) * 1e3, 3) if lo_gaps else None,
+            "p99_token_latency_ms_low": round(
+                _percentile(lo_gaps, 99) * 1e3, 3) if lo_gaps else None,
+            "fairness_share_measured": (round(share, 3)
+                                        if share is not None else None),
+            "fairness_share_configured": share_cfg,
+            "fairness_ok": fairness_ok,
+            "low_admitted_a": adm_a,
+            "low_admitted_b": adm_b,
+            "shed": shed,
+            "preempted": int(eng.stats["preempted"]),
+            "deadline_expired": int(eng.stats["deadline_expired"]),
+            "high_requests": n_high,
+            "completed_high": int(completed_high),
+            "completed_low": int(completed_low),
+            "steps": steps,
+            "wedged": wedged,
+            "no_leak": no_leak,
+            "parity_ok": parity_ok,
+            "queue_limit_low": ql,
+            "num_layers": t_cfg.num_layers,
+            "d_model": t_cfg.d_model,
+            "vocab_size": t_cfg.vocab_size,
             "device_kind": kind,
         })
 
@@ -690,6 +947,15 @@ def main() -> None:
 
     # One level crashing (OOM, transient backend fault) must not cost
     # the remaining rows — same isolation contract as matrix_bench.
+    if tenancy_seeds:
+        for s in tenancy_seeds:
+            try:
+                run_tenancy(s)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": TENANCY_METRIC, "seed": s,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        print(json.dumps({"serve_tenancy": results}))
+        return
     if soak_seeds:
         for s in soak_seeds:
             try:

@@ -20,6 +20,11 @@ this demo is about throughput and interleaving, not different text.
   # blocks instead of re-prefilling (outputs bit-identical either way):
   python examples/serve_gpt2.py --prefix-cache-blocks 64 --platform cpu
 
+  # Multi-tenant tiers: 2 high-priority requests ride over 6 low ones;
+  # the high tier preempts low in-flight slots, every preempted request
+  # resumes and finishes bit-identically (first listed = highest tier):
+  python examples/serve_gpt2.py --tenants high:2,low:6 --platform cpu
+
   # Restore a train_gpt2.py checkpoint (params-only, like generate_gpt2):
   python examples/serve_gpt2.py --checkpoint-dir ckpt --layers 4 ...
 
@@ -68,9 +73,33 @@ def main() -> None:
                         "requests sharing a prompt prefix copy cached "
                         "blocks instead of re-prefilling (0 = off; "
                         "output is identical either way)")
+    p.add_argument("--tenants", type=str, default=None,
+                   help="multi-tenant demo: comma-separated name:count "
+                        "pairs (e.g. high:2,low:6); each name becomes a "
+                        "TenantClass, FIRST LISTED = HIGHEST priority, "
+                        "and that many requests submit into it — the "
+                        "high tier preempts low in-flight slots and "
+                        "every preempted request resumes bit-identically "
+                        "(overrides --requests)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
+
+    tenant_spec: list[tuple[str, int]] = []
+    if args.tenants:
+        for part in args.tenants.split(","):
+            try:
+                name, count = part.split(":")
+                count = int(count)
+            except ValueError:
+                raise SystemExit(
+                    f"error: --tenants wants name:count pairs "
+                    f"(e.g. high:2,low:6), got {part!r}") from None
+            if not name or count < 1:
+                raise SystemExit(f"error: bad --tenants entry {part!r}")
+            tenant_spec.append((name, count))
+        if len({n for n, _ in tenant_spec}) != len(tenant_spec):
+            raise SystemExit("error: duplicate tenant name in --tenants")
 
     if args.temperature < 0:
         raise SystemExit(f"error: --temperature must be >= 0 (got "
@@ -98,7 +127,7 @@ def main() -> None:
     import numpy as np
 
     from tpudp.models.gpt2 import GPT2, GPT2Config
-    from tpudp.serve import Engine
+    from tpudp.serve import Engine, TenantClass
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     cfg = GPT2Config(
@@ -133,24 +162,40 @@ def main() -> None:
     # A chunk that divides --seq-len, so the Engine's round-down of the
     # arena never strands positions the flags say exist (same guard as
     # generate_gpt2.py --concurrent).
+    # First listed --tenants class gets the highest priority tier.
+    tenants = ({name: TenantClass(priority=len(tenant_spec) - 1 - i)
+                for i, (name, _) in enumerate(tenant_spec)}
+               if tenant_spec else None)
     engine = Engine(model, params, num_slots=args.num_slots,
                     prefill_chunk=math.gcd(args.prefill_chunk,
                                            args.seq_len),
                     speculate_k=args.speculate_k,
-                    prefix_cache_blocks=args.prefix_cache_blocks)
+                    prefix_cache_blocks=args.prefix_cache_blocks,
+                    tenants=tenants)
 
     # Mixed-length prompts from the training examples' deterministic
     # corpus draw (same generator family as train_gpt2.py).
     rng = np.random.default_rng(args.seed)
     base = rng.integers(0, args.vocab, size=4096)
+    # Without --tenants: --requests unclassed submits (tenant=None).
+    # With it: the LOW tiers submit first and grab the slots, then the
+    # higher tiers arrive and preempt — the demo shows the eviction.
+    plan = ([(None, args.requests)] if not tenant_spec
+            else list(reversed(tenant_spec)))
     handles = []
     t0 = time.perf_counter()
-    for i in range(args.requests):
-        plen = 4 + (3 * i) % 13
-        prompt = base[i * 16:i * 16 + plen].astype(np.int32)
-        handles.append(engine.submit(
-            prompt, args.max_new_tokens,
-            temperature=args.temperature, seed=args.seed + i))
+    i = 0
+    for tname, count in plan:
+        for _ in range(count):
+            plen = 4 + (3 * i) % 13
+            prompt = base[i * 16:i * 16 + plen].astype(np.int32)
+            handles.append(engine.submit(
+                prompt, args.max_new_tokens,
+                temperature=args.temperature, seed=args.seed + i,
+                tenant=tname))
+            i += 1
+        if tname is not None:
+            engine.step()  # let this tier occupy slots before the next
     # Stream request 0 token by token (iterating drives the engine — the
     # other requests decode in the same batched step).
     streamed = []
@@ -161,8 +206,14 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     for i, h in enumerate(handles):
-        print(f"[serve] request {i} (prompt {h.prompt.size} toks): "
-              f"{h.tokens}")
+        tier = f", tenant={h.tenant}" if h.tenant is not None else ""
+        pre = f", preempted x{h.preemptions}" if h.preemptions else ""
+        print(f"[serve] request {i} (prompt {h.prompt.size} toks{tier}"
+              f"{pre}): {h.tokens}")
+    if tenants:
+        for name, st in engine.tenant_stats.items():
+            print(f"[serve] tenant {name}: submitted={st['submitted']} "
+                  f"preempted={st['preempted']} tokens={st['tokens']}")
     total = sum(len(h.tokens) for h in handles)
     batched_steps = (engine.stats["decode_steps"]
                      + engine.stats["verify_steps"])
@@ -179,7 +230,7 @@ def main() -> None:
                  f"{engine.stats['prefix_hit_tokens']} "
                  f"(pool {engine.prefix_cache.used_blocks}"
                  f"/{args.prefix_cache_blocks} blocks)")
-    print(f"[serve] {args.requests} requests, {total} tokens in {dt:.3f}s "
+    print(f"[serve] {len(handles)} requests, {total} tokens in {dt:.3f}s "
           f"({total / dt:.1f} tokens/sec incl. compile) | "
           f"decode steps={engine.stats['decode_steps']} "
           f"prefill chunks={engine.stats['prefill_chunks']} "

@@ -260,6 +260,79 @@ def test_serve_prefix_gap_gate(tmp_path):
     assert serve_prefix_missing(d) == []  # banked history row counts
 
 
+def test_serve_tenancy_bench_row_parses():
+    """The serve_tenancy stage's CPU smoke (tier-1's guard on the
+    multi-tenant bench the TPU watcher resumes): at a trimmed geometry
+    the mixed-priority workload must emit a parseable row where the
+    high tier's p99 held under low-tier overload (p99_ok), preemptions
+    actually fired and resumed bit-exactly (parity_ok covers them), the
+    low tiers shed past their per-class bounds, measured fair shares
+    landed within 10% of the configured 3:1 weights, and the engine
+    ended empty."""
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu",
+        "SERVE_TENANCY": "0",
+        "TENANCY_STEPS": "60", "TENANCY_HIGH": "6",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byseed = {r["seed"]: r for r in rows
+              if r.get("metric") == "serve_tenancy" and "seed" in r}
+    assert set(byseed) == {0}, proc.stderr[-800:]
+    r = byseed[0]
+    assert "error" not in r, r
+    assert r["value"] > 0                      # a real p99 was measured
+    assert r["p99_ok"] is True                 # high tier held its SLO
+    assert r["parity_ok"] is True              # preempted+resumed bit-exact
+    assert r["no_leak"] is True and r["wedged"] is False
+    assert r["preempted"] > 0                  # the storm actually evicted
+    assert r["shed"] > 0                       # overload actually shed
+    assert r["fairness_ok"] is True
+    assert abs(r["fairness_share_measured"]
+               - r["fairness_share_configured"]) <= 0.10
+    assert r["completed_high"] == r["high_requests"]
+    # unregistered seeds fail fast, like the soak's seed registry
+    bad = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu", "SERVE_TENANCY": "9",
+        "SERVE_STRICT_LEVELS": "1"}, timeout=300)
+    assert bad.returncode != 0
+    assert "tenancy seeds" in (bad.stderr + bad.stdout)
+
+
+def test_serve_tenancy_gap_gate(tmp_path):
+    """tools/bench_gaps serve_tenancy stage: CPU smoke rows, error rows,
+    p99-blown rows, parity-broken rows, and leaking rows never close a
+    seed; banked TPU rows that passed every gate do (the watcher's
+    window-accumulation contract, same rules as the serve_soak
+    stage)."""
+    from tools.bench_gaps import SERVE_TENANCY_SEEDS, serve_tenancy_missing
+
+    d = str(tmp_path)
+    assert serve_tenancy_missing(d) == list(SERVE_TENANCY_SEEDS)
+    ok = {"metric": "serve_tenancy", "value": 9.1, "p99_ok": True,
+          "parity_ok": True, "no_leak": True}
+    rows = [
+        {**ok, "seed": 0, "device_kind": "cpu"},      # smoke: no
+        {"metric": "serve_tenancy", "seed": 1,
+         "error": "relay wedged"},                    # error: no
+        {**ok, "seed": 1, "p99_ok": False,
+         "device_kind": "TPU v5 lite"},               # p99 blown: no
+        {**ok, "seed": 2, "parity_ok": False,
+         "device_kind": "TPU v5 lite"},               # parity broken: no
+        {**ok, "seed": 2, "no_leak": False,
+         "device_kind": "TPU v5 lite"},               # leak: no
+        {**ok, "seed": 0, "device_kind": "TPU v5 lite"},  # real pass: yes
+    ]
+    with open(os.path.join(d, "serve_tenancy.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_tenancy_missing(d) == [1, 2]
+    with open(os.path.join(d, "serve_tenancy.history.jsonl"), "w") as f:
+        f.write(json.dumps(
+            {**ok, "seed": 2, "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_tenancy_missing(d) == [1]  # banked history row counts
+
+
 def test_train_soak_bench_row_parses():
     """The train_soak stage's CPU smoke (tier-1's guard on the kill/
     resume soak the TPU watcher resumes): a reduced 1-kill plan (loader

@@ -20,6 +20,9 @@ the missing work as arguments the benches accept:
     python tools/bench_gaps.py serve_prefix -> comma-separated prefix-
                                            caching workloads (TTFT
                                            cache-on/off rows missing)
+    python tools/bench_gaps.py serve_tenancy -> comma-separated multi-
+                                           tenant serving seeds (priority/
+                                           fairness rows missing)
     python tools/bench_gaps.py train_soak -> comma-separated kill/resume
                                            soak seeds (training-resilience
                                            rows missing)
@@ -55,11 +58,20 @@ SERVE_SPEC_KS = (2, 4, 8)
 # uncached engines.
 SERVE_PREFIX_WORKLOADS = ("shared_prefix", "multiturn")
 # Fault-injection soak seeds (serve_bench.py --soak: random cancels,
-# deadline mix, injected drafter/step faults against the serve engine's
+# deadline mix, injected drafter/step faults — and, since the tenancy
+# PR, a deterministic preemption storm — against the serve engine's
 # robustness layer) that must PASS on the TPU — a seed is closed only by
 # a row that completed with parity intact and no slot/queue leak; same
 # registry contract.
 SERVE_SOAK_SEEDS = (0, 1, 2)
+# Multi-tenant serving seeds (serve_bench.py --tenants: mixed-priority
+# workload with per-tier latency percentiles, weighted fair shares, and
+# per-class shedding under overload) that must PASS on the TPU — a seed
+# is closed only by a row where the high tier's p99 TTFT under overload
+# stayed within TENANCY_P99_BOUND x its no-overload p99 (p99_ok), every
+# surviving output was bit-exact (parity_ok), and the engine ended
+# empty (no_leak); same registry contract.
+SERVE_TENANCY_SEEDS = (0, 1, 2)
 # Kill/resume soak seeds for the TRAINING resilience layer
 # (benchmarks/resilience_bench.py: SIGKILL + relaunch, injected NaN/
 # spike/stall/step-raise/loader faults, checkpoint corruption against
@@ -220,6 +232,28 @@ def serve_soak_missing(d: str) -> list[int]:
     return [s for s in SERVE_SOAK_SEEDS if s not in done]
 
 
+def serve_tenancy_missing(d: str) -> list[int]:
+    """Tenancy seeds still lacking a PASSING real-TPU run.  A row
+    closes its seed only when it measured something (``value`` = the
+    high tier's overload p99 TTFT > 0), the high tier's p99 held under
+    overload (``p99_ok`` — the SLO the priority/preemption machinery
+    exists to defend), every surviving output matched generate()
+    bit-exactly (``parity_ok``), and the engine ended empty
+    (``no_leak``).  CPU smoke and error rows never close a seed (same
+    rules as serve_soak_missing)."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_tenancy.jsonl")):
+        if (r.get("metric") == "serve_tenancy"
+                and r.get("seed") in SERVE_TENANCY_SEEDS
+                and measured(r)
+                and r.get("p99_ok") is True
+                and r.get("parity_ok") is True
+                and r.get("no_leak") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["seed"])
+    return [s for s in SERVE_TENANCY_SEEDS if s not in done]
+
+
 def train_soak_missing(d: str) -> list[int]:
     """Kill/resume soak seeds still lacking a PASSING real-TPU run.  A
     row closes its seed only when it measured something (``value`` =
@@ -343,7 +377,8 @@ def main() -> None:
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_soak",
-                                     "serve_prefix", "train_soak"])
+                                     "serve_prefix", "serve_tenancy",
+                                     "train_soak"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -359,6 +394,9 @@ def main() -> None:
               end="")
     elif args.stage == "serve_soak":
         print(",".join(str(s) for s in serve_soak_missing(args.dir)),
+              end="")
+    elif args.stage == "serve_tenancy":
+        print(",".join(str(s) for s in serve_tenancy_missing(args.dir)),
               end="")
     elif args.stage == "train_soak":
         print(",".join(str(s) for s in train_soak_missing(args.dir)),

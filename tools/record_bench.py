@@ -233,6 +233,39 @@ def main() -> None:
                   f"{r.get('prefix_lookups')} lookups, parity intact) | "
                   f"`serve_bench.py --prefix-cache` | |")
 
+    # Multi-tenant rows render pass/fail on the tenancy gates: the high
+    # tier's overload TTFT p99 held within the bound of its no-load
+    # baseline, every completed request (preempted and resumed included)
+    # bit-exact, and no slot/queue leak — the same criteria as
+    # bench_gaps.serve_tenancy_missing, so recorder and gate can't
+    # disagree.
+    ten = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_tenancy.jsonl"))
+         if "seed" in r and r.get("metric") == "serve_tenancy"), "seed")
+    for r in sorted(ten.values(), key=lambda r: r.get("seed", 0)):
+        if (not measured(r) or not r.get("p99_ok")
+                or not r.get("parity_ok") or not r.get("no_leak")):
+            why = r.get("error") or ", ".join(
+                w for w, bad in (("high-tier p99 blew its bound",
+                                  not r.get("p99_ok")),
+                                 ("slot/queue leak", not r.get("no_leak")),
+                                 ("parity broken", not r.get("parity_ok")),
+                                 ("wedged", r.get("wedged")))
+                if bad) or "no real measurement"
+            print(f"| serve_tenancy seed={r.get('seed')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --tenants` | |")
+        else:
+            print(f"| multi-tenant serving seed={r['seed']} (high tier "
+                  f"over 2x low-tier overload) | PASS: high TTFT p99 "
+                  f"{r['value']} ms vs {r.get('ttft_p99_baseline_ms')} ms "
+                  f"no-load (bound {r.get('p99_bound')}x), "
+                  f"{r.get('preempted')} preemptions bit-exact, low tier "
+                  f"shed {r.get('shed')}, fair share "
+                  f"{r.get('fairness_share_measured')} vs "
+                  f"{r.get('fairness_share_configured')} configured "
+                  f"(ok: {r.get('fairness_ok')}) | "
+                  f"`serve_bench.py --tenants` | |")
+
     # Soak rows render pass/fail: a soak that wedged, leaked, or broke
     # parity is a robustness FAILURE even if it "measured" something —
     # the same criteria as bench_gaps.serve_soak_missing, so recorder

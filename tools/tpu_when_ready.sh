@@ -99,6 +99,10 @@ serve_prefix_ok() {
   local out; out=$(python tools/bench_gaps.py serve_prefix) || return 1
   [ -z "$out" ]
 }
+serve_tenancy_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_tenancy) || return 1
+  [ -z "$out" ]
+}
 train_soak_ok() {
   local out; out=$(python tools/bench_gaps.py train_soak) || return 1
   [ -z "$out" ]
@@ -365,6 +369,22 @@ while true; do
         > bench_results/serve_prefix.jsonl 2> bench_results/serve_prefix.err
       log "serve_prefix_bench rc=$? -> bench_results/serve_prefix.jsonl"
     fi
+    if serve_tenancy_ok; then
+      log "serve_tenancy.jsonl already good; skipping tenancy bench"
+    else
+      # Multi-tenant serving (priority tiers + bit-exact preemption,
+      # tpudp.serve.tenancy): high tier's TTFT p99 under 2x low-tier
+      # overload vs its no-load baseline, measured fairness shares vs
+      # configured weights, per-class sheds; a seed passes only with
+      # p99 held, parity bit-exact, and no slot/queue leak — resumes
+      # at seed granularity via bench_gaps, like the serve_soak stage.
+      bank bench_results/serve_tenancy.jsonl
+      ensure_window
+      SERVE_TENANCY="$(python tools/bench_gaps.py serve_tenancy)" \
+        timeout -k "$GRACE" "$(stage_t 900)" python benchmarks/serve_bench.py \
+        > bench_results/serve_tenancy.jsonl 2> bench_results/serve_tenancy.err
+      log "serve_tenancy rc=$? -> bench_results/serve_tenancy.jsonl"
+    fi
     if serve_soak_ok; then
       log "serve_soak.jsonl already good; skipping serve soak"
     else
@@ -425,7 +445,8 @@ while true; do
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
         && lever_ok && collective_ok && serve_ok && serve_spec_ok \
-        && serve_soak_ok && serve_prefix_ok && train_soak_ok; then
+        && serve_soak_ok && serve_prefix_ok && serve_tenancy_ok \
+        && train_soak_ok; then
       log "battery done"
       exit 0
     fi

@@ -1,6 +1,7 @@
 """tpudp.serve — continuous-batching inference (slot scheduler, chunked
 prefill, streaming decode, speculative decoding, prefix caching,
-robustness layer: bounded admission, deadlines, fault isolation,
+multi-tenant priority tiers with bit-exact preemption and co-resident
+models, robustness layer: bounded admission, deadlines, fault isolation,
 graceful drain).  See docs/SERVING.md; deterministic fault injectors
 live in ``tpudp.serve.faults``."""
 
@@ -9,7 +10,9 @@ from tpudp.serve.engine import (TRACE_COUNTS, Engine, EngineClosed,
                                 RequestFailed)
 from tpudp.serve.prefix_cache import PrefixCache
 from tpudp.serve.speculate import Drafter, DraftModelDrafter, NgramDrafter
+from tpudp.serve.tenancy import TenantClass, TenantScheduler
 
 __all__ = ["Engine", "Request", "TRACE_COUNTS", "Drafter",
            "DraftModelDrafter", "NgramDrafter", "FinishReason",
-           "PrefixCache", "QueueFull", "EngineClosed", "RequestFailed"]
+           "PrefixCache", "QueueFull", "EngineClosed", "RequestFailed",
+           "TenantClass", "TenantScheduler"]

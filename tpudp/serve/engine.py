@@ -112,6 +112,35 @@ forever on any fault):
     wraps every blocking device call in a scoped watchdog deadline
     (``tpudp.utils.watchdog.Watchdog.step``), so a wedged TPU step is
     detected from OUTSIDE the blocked call, mirroring the trainer.
+
+**Multi-tenancy layer** (``tpudp.serve.tenancy``; ``tenants=None`` — the
+default — is byte-for-byte the old engine, stats keys and trace counts
+included):
+
+  * **Tenant classes** — ``Engine(tenants={name: TenantClass(...)})``
+    plus ``submit(..., tenant=name)`` classes traffic into priority
+    tiers: per-class bounded queues shed with the same typed
+    :class:`QueueFull`, per-class ``default_deadline_s`` applies the
+    deadline machinery class-wide, and admission is strict-priority
+    across classes with deterministic stride (weighted fair) scheduling
+    among classes at equal priority.
+  * **Preemption** — when a higher-priority request waits and no slot
+    is free, the scheduler evicts the lowest-priority in-flight slot
+    through the SAME carry-over path as step-failure requeue: emitted
+    tokens and the per-slot PRNG chain ride along, the request resumes
+    at the front of its class queue and completes bit-identically, so
+    ``FinishReason.PREEMPTED`` is never user-visible (the handle's
+    ``finish_reason`` stays None until the request actually finishes).
+    Preemption changes array VALUES only — slot state and the arena
+    keep their shapes, so no preemption storm can ever recompile.
+  * **Co-resident models** — ``Engine(models={name: (model, params)})``
+    registers additional model/params pairs behind the same scheduler:
+    each gets its own slot arena and frozen-weight step programs (the
+    per-(cfg, params) LRU already shares compiled programs), a
+    ``TenantClass(model=name)`` routes its class there, and one host
+    loop batches each model's decoding slots through that model's own
+    step — per-request math is exactly the single-model engine's, so
+    greedy outputs stay bit-identical to each model's ``generate()``.
 """
 
 from __future__ import annotations
@@ -149,6 +178,13 @@ class FinishReason(str, enum.Enum):
     DEADLINE = "deadline"    # deadline_s / ttft_deadline_s expired
     ERROR = "error"          # a device-step failure exhausted the requeue
     SHED = "shed"            # queued work discarded by Engine.close()
+    PREEMPTED = "preempted"  # slot evicted for higher-priority work —
+    #                          NEVER user-visible: the request requeues
+    #                          with tokens + PRNG chain carried over and
+    #                          finishes bit-identically under a terminal
+    #                          reason (handle.finish_reason stays None
+    #                          while preempted; stats["preempted"] and
+    #                          Request.preemptions account it)
 
 
 # stats counter bumped per finish reason (COMPLETE and EOS share
@@ -161,6 +197,7 @@ _FINISH_COUNTER = {
     FinishReason.DEADLINE: "deadline_expired",
     FinishReason.ERROR: "errors",
     FinishReason.SHED: "shed",
+    FinishReason.PREEMPTED: "preempted",
 }
 
 
@@ -299,6 +336,31 @@ def _engine_steps(cfg, params):
     return steps
 
 
+class _ModelState:
+    """Per-model serving state behind the one scheduler: a slot KV
+    arena, the frozen-weight step programs, and (optionally) a prefix
+    cache.  The default model is ``_mstates[None]``; co-resident models
+    registered via ``Engine(models={name: (model, params)})`` get their
+    own instance each.  Every arena shares the engine's (num_slots,
+    max_len) geometry — a request occupies the SAME slot index in every
+    arena, but only its own model's rows ever hold its real KV; the
+    other arenas' copies of that row accumulate garbage that the
+    overwrite-before-visible rule makes unreadable, exactly like an
+    inactive slot's row."""
+
+    __slots__ = ("name", "model", "config", "params", "decode_step",
+                 "verify_step", "prefill_step", "cache", "prefix_cache")
+
+    def __init__(self, name, model, params, steps):
+        self.name = name
+        self.model = model
+        self.config = model.config
+        self.params = params
+        self.decode_step, self.verify_step, self.prefill_step = steps
+        self.cache = None
+        self.prefix_cache = None
+
+
 @jax.jit
 def _sample_row(logits, temp, top_k, top_p, key):
     """First-token sample after a finished prefill: one row through the
@@ -331,7 +393,8 @@ class Request:
                  max_new_tokens: int, temperature: float, top_k: int,
                  top_p: float, seed: int, eos_id: int | None,
                  deadline_s: float | None = None,
-                 ttft_deadline_s: float | None = None):
+                 ttft_deadline_s: float | None = None,
+                 tenant: str | None = None):
         self._engine = engine
         self.id = rid
         self.prompt = prompt
@@ -343,6 +406,12 @@ class Request:
         self.eos_id = eos_id
         self.deadline_s = deadline_s
         self.ttft_deadline_s = ttft_deadline_s
+        self.tenant = tenant       # class name (None: tenancy off)
+        self.preemptions = 0       # times this request lost its slot to
+        #                            higher-priority work (each resume is
+        #                            bit-identical, so this is latency
+        #                            accounting, never a correctness flag)
+        self._ms = None            # _ModelState this request decodes with
         self.tokens: list[int] = []
         self.token_times: list[float] = []
         self.submit_time = time.perf_counter()
@@ -444,6 +513,18 @@ class Engine:
     settable later) is called as ``hook(kind, index)`` immediately
     before each device call — the fault-injection seam
     ``tpudp.serve.faults`` plugs into.
+
+    Tenancy knobs (``tpudp.serve.tenancy``; module docstring
+    "Multi-tenancy layer"): ``tenants={name: TenantClass(...)}`` turns
+    on per-class bounded queues, priority preemption, and weighted
+    admission — ``submit(..., tenant=name)`` classes each request, and
+    with classes configured ``queue_limit`` bounds the TOTAL queued
+    across classes while each class's own ``queue_limit`` bounds its
+    share.  ``models={name: (model, params)}`` registers co-resident
+    models a ``TenantClass(model=name)`` can route to (requires
+    ``tenants``); every registered model must accommodate the engine's
+    ``max_len``.  ``tenants=None`` (the default) is byte-for-byte the
+    old single-tenant engine.
     """
 
     def __init__(self, model, params: dict, *, num_slots: int = 8,
@@ -453,7 +534,8 @@ class Engine:
                  queue_limit: int | None = None,
                  drafter_timeout_s: float | None = None,
                  watchdog=None, step_timeout_s: float | None = None,
-                 step_fault_hook=None):
+                 step_fault_hook=None, tenants: dict | None = None,
+                 models: dict | None = None):
         cfg = model.config
         validate_decode_config(cfg, "Engine")
         if num_slots < 1:
@@ -518,22 +600,48 @@ class Engine:
         self.prefill_chunk = prefill_chunk
         self.speculate_k = speculate_k
         self.drafter = drafter
-        (self._decode_step, self._verify_step,
-         self._prefill_step) = _engine_steps(cfg, params)
-        # Prefix cache: blocks sized to prefill_chunk so a cached block
-        # boundary is always a chunk boundary (imported lazily — the
-        # module imports TRACE_COUNTS from here, and the cache is
-        # optional).  None when off: every prefix-cache code path below
-        # is gated on it, so prefix_cache_blocks=0 is byte-for-byte the
-        # pre-cache engine (stats keys and trace counts included).
-        self.prefix_cache = None
-        if prefix_cache_blocks:
-            from tpudp.serve.prefix_cache import PrefixCache
+        self._prefix_cache_blocks = prefix_cache_blocks
+        # Per-model serving state (arena + frozen-weight programs +
+        # optional prefix cache), default model under key None.
+        # Co-resident models (key = registered name) each add their own
+        # _ModelState behind the same scheduler; with none registered
+        # this is exactly the old single-model engine state.
+        self._mstates: dict[str | None, _ModelState] = {}
+        self._add_model(None, model, params)
+        # Tenancy: per-class queues + priority/stride admission
+        # (tpudp.serve.tenancy).  None = the old single-FIFO engine.
+        self.tenants = tenants
+        self._sched = None
+        if tenants is not None:
+            from tpudp.serve.tenancy import TenantScheduler
 
-            self.prefix_cache = PrefixCache(cfg, prefix_cache_blocks,
-                                            prefill_chunk)
-
-        self._cache = KVCache.zeros(cfg, num_slots, self.max_len)
+            self._sched = TenantScheduler(tenants)
+        if models:
+            if self._sched is None:
+                raise ValueError(
+                    "models= (co-resident models) requires tenants= — "
+                    "requests route to a model through their "
+                    "TenantClass(model=name)")
+            for mname, pair in models.items():
+                if not isinstance(mname, str) or not mname:
+                    raise ValueError(
+                        f"model names must be non-empty strings, "
+                        f"got {mname!r}")
+                try:
+                    m, p = pair
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"models[{mname!r}] must be a (model, params) "
+                        f"pair") from None
+                self._add_model(mname, m, p)
+        if self._sched is not None:
+            for tname in self._sched.names:
+                route = self._sched.cls(tname).model
+                if route is not None and route not in self._mstates:
+                    raise ValueError(
+                        f"tenants[{tname!r}] routes to unregistered "
+                        f"model {route!r} (registered: "
+                        f"{sorted(k for k in self._mstates if k)})")
         self._keys = jnp.zeros((num_slots, 2), jnp.uint32)
         # Host-authoritative per-slot state, uploaded each step (tiny
         # arrays; values are data, never shapes).
@@ -560,6 +668,65 @@ class Engine:
         self.drafter_quarantine_reason: str | None = None
         self.last_step_error: BaseException | None = None
 
+    # -- model registry ------------------------------------------------
+
+    def _add_model(self, name: str | None, model, params) -> None:
+        """Register one model behind the scheduler: its own slot arena
+        (same (num_slots, max_len) geometry as every other model's),
+        frozen-weight step programs (shared through the per-(cfg,
+        params) LRU — two engines or two tenants over one tree compile
+        once), and its own prefix cache when caching is on (cached KV
+        is a function of MODEL and tokens; blocks must never cross
+        models)."""
+        cfg = model.config
+        if name is not None:
+            validate_decode_config(cfg, f"Engine(models[{name!r}])")
+            if cfg.max_seq_len < self.max_len:
+                raise ValueError(
+                    f"models[{name!r}] max_seq_len ({cfg.max_seq_len}) "
+                    f"is below the engine arena max_len "
+                    f"({self.max_len}) — co-resident models share the "
+                    f"slot geometry")
+            dcfg = getattr(self.drafter, "config", None)
+            if dcfg is not None and dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"drafter vocab_size ({dcfg.vocab_size}) must match "
+                    f"co-resident model {name!r}'s ({cfg.vocab_size}) — "
+                    f"speculation requires a shared tokenizer")
+        ms = _ModelState(name, model, params, _engine_steps(cfg, params))
+        # Prefix cache: blocks sized to prefill_chunk so a cached block
+        # boundary is always a chunk boundary (imported lazily — the
+        # module imports TRACE_COUNTS from here, and the cache is
+        # optional).  None when off: every prefix-cache code path below
+        # is gated on it, so prefix_cache_blocks=0 is byte-for-byte the
+        # pre-cache engine (stats keys and trace counts included).
+        if self._prefix_cache_blocks:
+            from tpudp.serve.prefix_cache import PrefixCache
+
+            ms.prefix_cache = PrefixCache(cfg, self._prefix_cache_blocks,
+                                          self.prefill_chunk)
+        ms.cache = KVCache.zeros(cfg, self.num_slots, self.max_len)
+        self._mstates[name] = ms
+
+    @property
+    def prefix_cache(self):
+        """The DEFAULT model's prefix cache (``None`` when caching is
+        off) — the public handle tests and tools inspect.  Co-resident
+        models each hold their own cache internally."""
+        return self._mstates[None].prefix_cache
+
+    @property
+    def tenant_stats(self) -> dict:
+        """Per-tenant counters (``{name: Counter}``): submitted,
+        admitted (fresh slot grants), readmitted (resumes after
+        preemption or step-failure requeue), shed, preempted, tokens,
+        plus one count per terminal finish reason.  Empty dict with
+        tenancy off."""
+        if self._sched is None:
+            return {}
+        return {name: self._sched.stats(name)
+                for name in self._sched.names}
+
     # -- submission ----------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
@@ -567,7 +734,8 @@ class Engine:
                top_p: float | None = None, seed: int = 0,
                eos_id: int | None = None,
                deadline_s: float | None = None,
-               ttft_deadline_s: float | None = None) -> Request:
+               ttft_deadline_s: float | None = None,
+               tenant: str | None = None) -> Request:
         """Queue one generation request; returns its streaming handle.
 
         Same sampling contract as ``generate()``: ``temperature=0`` is
@@ -584,6 +752,15 @@ class Engine:
         ``FinishReason.DEADLINE`` at the next scheduler iteration; its
         emitted tokens stay on the handle and its slot frees.
 
+        ``tenant`` names the request's admission class on a
+        tenant-aware engine (``Engine(tenants=...)``): the class's
+        ``queue_limit`` bounds ITS queue (typed :class:`QueueFull`),
+        its ``default_deadline_s`` fills in a missing ``deadline_s``,
+        and its ``model`` routes the request to a registered
+        co-resident model.  ``tenant=None`` routes to the class named
+        ``"default"`` when one exists; on a tenancy-off engine passing
+        ``tenant`` is an error.
+
         Raises :class:`EngineClosed` after :meth:`drain`/:meth:`close`,
         and :class:`QueueFull` when ``queue_limit`` queued requests are
         already waiting (the typed backpressure signal — checked before
@@ -592,16 +769,36 @@ class Engine:
             raise EngineClosed(
                 "Engine.drain()/close() was called; the engine no longer "
                 "accepts work")
+        tname = tc = None
+        if self._sched is not None:
+            tname = self._sched.resolve(tenant)
+            tc = self._sched.cls(tname)
+        elif tenant is not None:
+            raise ValueError(
+                "submit(tenant=...) requires Engine(tenants=...) — this "
+                "engine has no tenant classes configured")
         if (self.queue_limit is not None
-                and len(self._queue) >= self.queue_limit):
+                and self.queue_depth >= self.queue_limit):
             self.stats["shed"] += 1
+            if tname is not None:
+                self._sched.stats(tname)["shed"] += 1
             raise QueueFull(
                 f"queue_limit ({self.queue_limit}) queued requests "
                 f"already waiting; request refused (shed)")
+        if tc is not None and self._sched.full(tname):
+            self.stats["shed"] += 1
+            self._sched.stats(tname)["shed"] += 1
+            raise QueueFull(
+                f"tenant {tname!r} queue_limit ({tc.queue_limit}) "
+                f"queued requests already waiting; request refused "
+                f"(shed)")
+        if tc is not None and deadline_s is None:
+            deadline_s = tc.default_deadline_s  # class-wide SLO
+        ms = self._mstates[tc.model if tc is not None else None]
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must hold at least one token")
-        vocab = self.config.vocab_size
+        vocab = ms.config.vocab_size
         if prompt.min() < 0 or prompt.max() >= vocab:
             raise ValueError(f"prompt ids must be in [0, {vocab})")
         if max_new_tokens < 1:
@@ -635,9 +832,15 @@ class Engine:
         r = Request(self, self._next_id, prompt, max_new_tokens,
                     float(temperature), int(top_k or 0),
                     float(1.0 if top_p is None else top_p), seed, eos_id,
-                    deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s)
+                    deadline_s=deadline_s, ttft_deadline_s=ttft_deadline_s,
+                    tenant=tname)
+        r._ms = ms
         self._next_id += 1
-        self._queue.append(r)
+        if self._sched is not None:
+            self._sched.enqueue(r)
+            self._sched.stats(tname)["submitted"] += 1
+        else:
+            self._queue.append(r)
         self.stats["submitted"] += 1
         return r
 
@@ -672,11 +875,13 @@ class Engine:
     # -- scheduling ----------------------------------------------------
 
     def step(self) -> list[tuple[Request, int]]:
-        """One scheduler iteration: expire deadlines, admit queued
-        requests into free slots, run at most one prefill chunk (the
-        oldest admitted request still prefilling), then one batched
-        decode step — or, with speculation on, one batched draft+verify
-        window — for every decoding slot.  Returns the
+        """One scheduler iteration: expire deadlines, preempt
+        lower-priority slots for waiting higher-priority work (tenancy
+        only), admit queued requests into free slots, run at most one
+        prefill chunk (the oldest admitted request still prefilling;
+        highest tier first with tenancy on), then one batched decode
+        step — or, with speculation on, one batched draft+verify
+        window — for every model's decoding slots.  Returns the
         ``(request, token)`` pairs emitted.
 
         An exception escaping a device step is CONTAINED
@@ -697,16 +902,25 @@ class Engine:
             # Cache off, neither touches device state and this changes
             # nothing.
             self._expire_deadlines()
+            if self._sched is not None:
+                self._preempt_for_priority()
             self._admit()
             slot = self._next_prefill_slot()
             if slot is not None:
                 self._run_prefill_chunk(slot, emitted)
-            if any(r is not None and r._nfill == r._fill.size
-                   for r in self._slots):
+            # One batched decode (or draft+verify) per model with
+            # decoding slots — with no co-resident models registered
+            # this is exactly the old single decode step.
+            for ms in self._mstates.values():
+                active = np.array(
+                    [r is not None and r._nfill == r._fill.size
+                     and r._ms is ms for r in self._slots])
+                if not active.any():
+                    continue
                 if self.speculate_k and not self._drafter_quarantined:
-                    self._run_verify(emitted)
+                    self._run_verify(ms, active, emitted)
                 else:
-                    self._run_decode(emitted)
+                    self._run_decode(ms, active, emitted)
         except Exception as exc:  # noqa: BLE001 — containment by design
             self._contain_step_failure(exc)
         self.stats["steps"] += 1
@@ -724,21 +938,24 @@ class Engine:
             return False
         if request._slot is not None:
             self._retire(request._slot, FinishReason.CANCELLED)
+        elif self._sched is not None:
+            self._sched.remove(request)
+            self._finish(request, FinishReason.CANCELLED)
         else:
             self._queue.remove(request)
             self._finish(request, FinishReason.CANCELLED)
         return True
 
     def run_until_complete(self) -> None:
-        """Drive the engine until the queue and every slot are empty."""
-        while self._queue or any(r is not None for r in self._slots):
+        """Drive the engine until every queue and every slot is empty."""
+        while self.queue_depth or any(r is not None for r in self._slots):
             self.step()
 
     def drain(self) -> None:
         """Graceful shutdown: stop admission (``submit()`` raises
         :class:`EngineClosed` from now on), finish every queued and
-        in-flight request, then close.  Idempotent; safe after
-        :meth:`close`."""
+        in-flight request — across every tenant class — then close.
+        Idempotent; safe after :meth:`close`."""
         self._accepting = False
         self.run_until_complete()
         self._closed = True
@@ -746,8 +963,13 @@ class Engine:
     def close(self) -> None:
         """Immediate shutdown: stop admission, retire every in-flight
         request as ``CANCELLED`` (emitted tokens stay on the handles)
-        and every queued request as ``SHED``.  Idempotent."""
+        and every queued request as ``SHED`` — walking EVERY per-tenant
+        queue on a tenant-aware engine, so no handle in any class is
+        left pending.  Idempotent."""
         self._accepting = False
+        if self._sched is not None:
+            for r in self._sched.drain_all():
+                self._finish(r, FinishReason.SHED)
         while self._queue:
             self._finish(self._queue.popleft(), FinishReason.SHED)
         for s, r in enumerate(self._slots):
@@ -777,7 +999,10 @@ class Engine:
 
     @property
     def queue_depth(self) -> int:
-        """Requests submitted but not yet admitted to a slot."""
+        """Requests submitted but not yet admitted to a slot (summed
+        across every tenant class on a tenant-aware engine)."""
+        if self._sched is not None:
+            return self._sched.depth()
         return len(self._queue)
 
     @property
@@ -791,13 +1016,20 @@ class Engine:
 
     # -- internals -----------------------------------------------------
 
+    def _pop_next(self) -> Request | None:
+        """Next request to admit: plain FIFO without tenancy; highest
+        priority then weighted stride (``tpudp.serve.tenancy``) with."""
+        if self._sched is not None:
+            return self._sched.pop_next()
+        return self._queue.popleft() if self._queue else None
+
     def _admit(self) -> None:
         for s in range(self.num_slots):
-            if not self._queue:
-                break
             if self._slots[s] is not None:
                 continue
-            r = self._queue.popleft()
+            r = self._pop_next()
+            if r is None:
+                break
             r._slot = s
             r._order = self._admitted
             self._admitted += 1
@@ -806,18 +1038,28 @@ class Engine:
             self._temps[s] = r.temperature
             self._topk[s] = r.top_k
             self._topp[s] = r.top_p
-            # A step-failure requeue resumes the request's saved PRNG
-            # chain (already advanced once per committed token), so the
-            # retried request's remaining draws are bit-identical to an
-            # uninterrupted run.
+            # A step-failure requeue (or a preemption) resumes the
+            # request's saved PRNG chain (already advanced once per
+            # committed token), so the retried request's remaining
+            # draws are bit-identical to an uninterrupted run.
             key = (jnp.asarray(r._resume_key) if r._resume_key is not None
                    else jax.random.PRNGKey(r.seed))
             self._keys = self._keys.at[s].set(key)
             self.stats["admitted"] += 1
-            if self.prefix_cache is not None:
-                self._admit_prefix(s, r)
+            if r.tenant is not None:
+                # A resume (preemption or step-failure requeue —
+                # _resume_key set at vacate) is not a fresh grant: it
+                # counts as "readmitted" so the fairness oracle
+                # (measured admitted shares vs configured weights)
+                # isn't inflated for whichever class absorbs the
+                # preemptions.
+                self._sched.stats(r.tenant)[
+                    "readmitted" if r._resume_key is not None
+                    else "admitted"] += 1
+            if r._ms.prefix_cache is not None:
+                self._admit_prefix(r._ms, s, r)
 
-    def _admit_prefix(self, s: int, r: Request) -> None:
+    def _admit_prefix(self, ms: _ModelState, s: int, r: Request) -> None:
         """Cache-hit admission: copy the longest cached block-aligned
         prefix of the request's fill into its slot and skip that much
         prefill.  Never copies the WHOLE fill — the final chunk is
@@ -829,7 +1071,7 @@ class Engine:
         eviction scan can never free a block mid-reuse."""
         from tpudp.serve import prefix_cache as _pc
 
-        cache = self.prefix_cache
+        cache = ms.prefix_cache
         self.stats["prefix_lookups"] += 1
         blocks = cache.lookup(r._fill)
         n_copy = min(len(blocks), (r._fill.size - 1) // self.prefill_chunk)
@@ -840,8 +1082,8 @@ class Engine:
         cache.pin(blocks[:n_copy])
         try:
             for i in range(n_copy):
-                self._cache = self._device(
-                    "prefix_in", _pc.copy_block_in, self._cache,
+                ms.cache = self._device(
+                    "prefix_in", _pc.copy_block_in, ms.cache,
                     cache.pool, np.int32(blocks[i]), np.int32(s),
                     np.int32(i * self.prefill_chunk))
         finally:
@@ -849,7 +1091,8 @@ class Engine:
         r._nfill = hit
         self._len[s] = hit
 
-    def _publish_prefix(self, s: int, r: Request) -> None:
+    def _publish_prefix(self, ms: _ModelState, s: int,
+                        r: Request) -> None:
         """Retirement-time publish: insert the slot's block-aligned
         PREFILLED prefix into the pool (insert-or-ref) and copy the KV
         of any newly allocated blocks out of the arena.  Only
@@ -867,7 +1110,7 @@ class Engine:
 
         from tpudp.utils.watchdog import StepHangError
 
-        cache = self.prefix_cache
+        cache = ms.prefix_cache
         n_blocks = min(r._nfill, r._fill.size) // self.prefill_chunk
         if not n_blocks:
             return
@@ -875,7 +1118,7 @@ class Engine:
             new = cache.publish(r._fill, n_blocks)
             for block, start in new:
                 cache.pool = self._device(
-                    "prefix_out", _pc.copy_block_out, self._cache,
+                    "prefix_out", _pc.copy_block_out, ms.cache,
                     cache.pool, np.int32(block), np.int32(s),
                     np.int32(start))
             self.stats["prefix_published_blocks"] += len(new)
@@ -902,6 +1145,8 @@ class Engine:
         r.finish_reason = reason
         r.error = error
         self.stats[_FINISH_COUNTER[reason]] += 1
+        if r.tenant is not None:
+            self._sched.stats(r.tenant)[_FINISH_COUNTER[reason]] += 1
 
     def _deadline_passed(self, r: Request, now: float) -> bool:
         waited = now - r.submit_time
@@ -917,8 +1162,13 @@ class Engine:
         chunk.  Emitted tokens stay on the handle; freed slots serve the
         next queued request this same step."""
         now = time.perf_counter()
-        for r in [r for r in self._queue if self._deadline_passed(r, now)]:
-            self._queue.remove(r)
+        queued = (self._sched.queued() if self._sched is not None
+                  else self._queue)
+        for r in [r for r in queued if self._deadline_passed(r, now)]:
+            if self._sched is not None:
+                self._sched.remove(r)
+            else:
+                self._queue.remove(r)
             self._finish(r, FinishReason.DEADLINE)
         for s, r in enumerate(self._slots):
             if r is not None and self._deadline_passed(r, now):
@@ -958,60 +1208,64 @@ class Engine:
         self.last_step_error = exc
         if self._watchdog is not None:
             self._watchdog.acknowledge()  # handled; next scope may proceed
-        self._cache = KVCache.zeros(self.config, self.num_slots,
-                                    self.max_len)
-        # A rebuilt arena invalidates the published blocks wholesale:
-        # the failed call may have been a block copy with either buffer
-        # donated, and after an arbitrary device fault conservatism
-        # wins over proving which buffers survived — the cache re-warms
-        # from the traffic, correctness never depended on it.
-        if self.prefix_cache is not None:
-            self.prefix_cache.flush(reallocate=True)
-            self.stats["prefix_flushes"] += 1
+        for ms in self._mstates.values():
+            ms.cache = KVCache.zeros(ms.config, self.num_slots,
+                                     self.max_len)
+            # A rebuilt arena invalidates the published blocks
+            # wholesale: the failed call may have been a block copy
+            # with either buffer donated, and after an arbitrary device
+            # fault conservatism wins over proving which buffers
+            # survived — the cache re-warms from the traffic,
+            # correctness never depended on it.
+            if ms.prefix_cache is not None:
+                ms.prefix_cache.flush(reallocate=True)
+                self.stats["prefix_flushes"] += 1
         survivors: list[Request] = []
         for s in sorted(
                 (s for s, r in enumerate(self._slots) if r is not None),
                 key=lambda s: self._slots[s]._order):
-            r = self._slots[s]
-            # The keys array is NOT donated, so the chain survives the
-            # failed call (whose key update never committed).
-            key = np.asarray(self._keys[s])
-            self._slots[s] = None
-            self._len[s] = 0
-            self._temps[s] = 0.0
-            self._topk[s] = 0
-            self._topp[s] = 1.0
-            r._slot = None
+            r = self._vacate_slot(s)
             if r._requeued:
                 self._finish(r, FinishReason.ERROR, error=exc)
             else:
                 r._requeued = True
-                r._resume_key = key
-                r._nfill = 0
-                r._fill = np.concatenate(
-                    [r.prompt, np.asarray(r.tokens, np.int32)])
                 survivors.append(r)
                 self.stats["requeued"] += 1
         # Requeued work goes to the FRONT in admission order: it was
         # already accepted and partially served, and queue_limit never
         # applies to it (shedding admitted work would turn one transient
         # fault into data loss).
-        self._queue.extendleft(reversed(survivors))
+        if self._sched is not None:
+            for r in reversed(survivors):
+                self._sched.requeue_front(r)
+        else:
+            self._queue.extendleft(reversed(survivors))
 
     def _next_prefill_slot(self) -> int | None:
+        # Tenancy orders prefill by priority first (a just-admitted or
+        # just-resumed high-tier request must not wait behind a low-tier
+        # prompt's remaining chunks — TTFT is the tier's SLO), then by
+        # admission order; without tenants this is the original pure
+        # FIFO.
+        if self._sched is not None:
+            pending = [(-self._priority_of(r), r._order, s)
+                       for s, r in enumerate(self._slots)
+                       if r is not None and r._nfill < r._fill.size]
+            return min(pending)[2] if pending else None
         pending = [(r._order, s) for s, r in enumerate(self._slots)
                    if r is not None and r._nfill < r._fill.size]
         return min(pending)[1] if pending else None
 
     def _run_prefill_chunk(self, s: int, emitted) -> None:
         r = self._slots[s]
+        ms = r._ms
         fill = r._fill
         start = r._nfill
         end = min(start + self.prefill_chunk, fill.size)
         buf = np.zeros((1, self.prefill_chunk), np.int32)
         buf[0, :end - start] = fill[start:end]
-        last_logits, self._cache = self._device(
-            "prefill", self._prefill_step, self._cache, np.int32(s), buf,
+        last_logits, ms.cache = self._device(
+            "prefill", ms.prefill_step, ms.cache, np.int32(s), buf,
             np.int32(start), np.int32(end - start - 1))
         r._nfill = end
         self._len[s] = end
@@ -1028,13 +1282,10 @@ class Engine:
             self._keys = self._keys.at[s].set(carry)
             self._commit(s, int(tok), emitted)
 
-    def _run_decode(self, emitted) -> None:
-        active = np.array(
-            [r is not None and r._nfill == r._fill.size
-             for r in self._slots])
-        self._cache, toks, self._keys = self._device(
-            "decode", self._decode_step,
-            self._cache, self._last, self._len, active, self._temps,
+    def _run_decode(self, ms: _ModelState, active, emitted) -> None:
+        ms.cache, toks, self._keys = self._device(
+            "decode", ms.decode_step,
+            ms.cache, self._last, self._len, active, self._temps,
             self._topk, self._topp, self._keys)
         toks = np.asarray(toks)
         self.stats["decode_steps"] += 1
@@ -1058,7 +1309,7 @@ class Engine:
             r.draft_proposed += proposed
             self.stats["draft_tokens"] += proposed
 
-    def _gather_drafts(self, active, k):
+    def _gather_drafts(self, ms, active, k):
         """Host-side draft proposals for every decoding slot, behind the
         fault-isolation wall: a drafter that raises, returns non-integer
         or out-of-vocab tokens, or exceeds ``drafter_timeout_s`` per
@@ -1108,7 +1359,7 @@ class Engine:
                 return None
             if draft.size and (int(draft.min()) < 0
                                or int(draft.max())
-                               >= self.config.vocab_size):
+                               >= ms.config.vocab_size):
                 self._quarantine_drafter(
                     "propose() returned out-of-vocab token ids",
                     r, int(draft.size))
@@ -1122,7 +1373,7 @@ class Engine:
                 proposed.append((int(s), draft.astype(np.int32)))
         return proposed
 
-    def _run_verify(self, emitted) -> None:
+    def _run_verify(self, ms: _ModelState, active, emitted) -> None:
         """Draft host-side, verify device-side: up to ``speculate_k``
         proposed tokens per decoding slot ride the window with the row's
         last token; the accepted prefix (plus the verify forward's own
@@ -1140,12 +1391,9 @@ class Engine:
         the dispatch switches between two warm programs, it never
         creates a new one."""
         k = self.speculate_k
-        active = np.array(
-            [r is not None and r._nfill == r._fill.size
-             for r in self._slots])
-        proposed = self._gather_drafts(active, k)
+        proposed = self._gather_drafts(ms, active, k)
         if not proposed:  # nothing drafted, or the drafter just got cut
-            self._run_decode(emitted)
+            self._run_decode(ms, active, emitted)
             return
         tokens = np.zeros((self.num_slots, k + 1), np.int32)
         tokens[:, 0] = self._last
@@ -1154,9 +1402,9 @@ class Engine:
             tokens[s, 1:1 + draft.size] = draft  # validated in-vocab
             n_draft[s] = draft.size
             self._slots[s].draft_proposed += int(draft.size)
-        self._cache, out, n_emit, self._keys = self._device(
-            "verify", self._verify_step,
-            self._cache, tokens, self._len, active, n_draft, self._temps,
+        ms.cache, out, n_emit, self._keys = self._device(
+            "verify", ms.verify_step,
+            ms.cache, tokens, self._len, active, n_draft, self._temps,
             self._topk, self._topp, self._keys)
         out = np.asarray(out)
         n_emit = np.asarray(n_emit)
@@ -1184,10 +1432,91 @@ class Engine:
         self._last[s] = tok
         emitted.append((r, tok))
         self.stats["tokens"] += 1
+        if r.tenant is not None:
+            self._sched.stats(r.tenant)["tokens"] += 1
         if r.eos_id is not None and tok == r.eos_id:
             self._retire(s, FinishReason.EOS)
         elif len(r.tokens) >= r.max_new_tokens:
             self._retire(s, FinishReason.COMPLETE)
+
+    def _priority_of(self, r: Request) -> int:
+        return self._sched.cls(r.tenant).priority
+
+    def _preempt_for_priority(self) -> None:
+        """Evict lower-priority in-flight work when higher-priority
+        requests would otherwise wait.  For each queued request in
+        priority order (a snapshot — requests evicted below re-enter
+        their queues but never count as waiters this pass): consume a
+        free slot if one exists, otherwise evict the lowest-priority
+        in-flight slot whose priority is STRICTLY below the waiter's
+        (most recently admitted among equals — the least sunk cost).
+        Stops the moment no strictly-lower victim remains, so equal
+        priorities never preempt each other and the scan is bounded by
+        min(queued, num_slots) evictions per step."""
+        waiting = self._sched.waiting_by_priority()
+        if not waiting:
+            return
+        free = sum(r is None for r in self._slots)
+        for pri, count in waiting:
+            for _ in range(count):
+                if free:
+                    free -= 1
+                    continue
+                victims = [s for s, r in enumerate(self._slots)
+                           if r is not None and self._priority_of(r) < pri]
+                if not victims:
+                    return
+                self._preempt_slot(max(
+                    victims,
+                    key=lambda s: (-self._priority_of(self._slots[s]),
+                                   self._slots[s]._order)))
+                # the freed slot is spoken for by this waiter
+
+    def _preempt_slot(self, s: int) -> None:
+        """Evict slot ``s`` for higher-priority work via the SAME
+        carry-over path as step-failure requeue: emitted tokens and the
+        per-slot PRNG chain ride along, the request re-enters the FRONT
+        of its class queue, and on re-admission it re-prefills
+        ``prompt + tokens`` under the saved chain — continuing
+        bit-identically, which is why ``FinishReason.PREEMPTED`` never
+        reaches a handle.  Unlike containment, nothing failed: the
+        arena stays live (the vacated row's stale KV is covered by
+        overwrite-before-visible, like any recycled slot), the requeue
+        budget is untouched (preemption must be repeatable without
+        burning the fault budget), and the prefilled prefix is
+        published first when caching is on, so the resume's re-prefill
+        collapses to block copies plus the final chunk."""
+        r = self._slots[s]
+        if r._ms.prefix_cache is not None and self._accepting:
+            self._publish_prefix(r._ms, s, r)
+        self._vacate_slot(s)
+        r.preemptions += 1
+        self.stats["preempted"] += 1
+        self._sched.stats(r.tenant)["preempted"] += 1
+        self._sched.requeue_front(r)
+
+    def _vacate_slot(self, s: int) -> Request:
+        """Clear slot ``s``'s per-slot state and prepare its request
+        for a bit-identical resume: the per-slot PRNG chain — the keys
+        array is never donated, so it holds the chain as of the last
+        COMMITTED token — is saved on the handle, and the refill
+        becomes ``prompt + tokens``.  The one carry-over path shared by
+        step-failure requeue and preemption: both resume under the same
+        contract, so a new per-slot array added to one must by
+        construction be cleared for the other."""
+        r = self._slots[s]
+        key = np.asarray(self._keys[s])
+        self._slots[s] = None
+        self._len[s] = 0
+        self._temps[s] = 0.0
+        self._topk[s] = 0
+        self._topp[s] = 1.0
+        r._slot = None
+        r._resume_key = key
+        r._nfill = 0
+        r._fill = np.concatenate([r.prompt,
+                                  np.asarray(r.tokens, np.int32)])
+        return r
 
     def _retire(self, s: int, reason: FinishReason,
                 error: BaseException | None = None) -> None:
@@ -1199,8 +1528,8 @@ class Engine:
         # prefix is exactly as good as a completed one's).  Skipped
         # once drain()/close() has begun — device copies to warm a pool
         # no future request can ever read would only slow shutdown.
-        if self.prefix_cache is not None and self._accepting:
-            self._publish_prefix(s, r)
+        if r._ms.prefix_cache is not None and self._accepting:
+            self._publish_prefix(r._ms, s, r)
         r._slot = None
         self._slots[s] = None
         self._len[s] = 0  # slot recycled; the next prefill overwrites from 0

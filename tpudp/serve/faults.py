@@ -29,8 +29,17 @@ Two injection seams, both first-class engine API:
     error, preempted TPU); sleeping simulates a wedged step for the
     watchdog to catch.
 
-Used by ``tests/test_serve_robustness.py`` and the ``serve_soak`` stage
-(``benchmarks/serve_bench.py --soak``, registered in
+A third seam exercises the TENANCY layer rather than a fault contract:
+:class:`PreemptionStorm` submits short bursts into a high-priority
+tenant class at fixed scheduler-step indices, forcing the engine to
+evict low-priority in-flight slots through the preemption path over and
+over.  Preemption is not a fault — every evicted request must resume
+and finish bit-identically — so the storm's referee is the same as the
+soak's: no wedge, no slot leak, survivors bit-exact.
+
+Used by ``tests/test_serve_robustness.py``, ``tests/test_tenancy.py``,
+and the ``serve_soak``/``serve_tenancy`` stages
+(``benchmarks/serve_bench.py --soak`` / ``--tenants``, registered in
 ``tools/bench_gaps.py``).
 """
 
@@ -39,6 +48,8 @@ from __future__ import annotations
 import time
 
 import numpy as np
+
+from tpudp.serve.engine import QueueFull
 
 
 class InjectedFault(RuntimeError):
@@ -135,6 +146,58 @@ class FaultySteps:
             self.fired.append((kind, index))
             raise InjectedFault(
                 f"injected step fault at {kind} call {index}")
+
+
+class PreemptionStorm:
+    """Deterministic preemption pressure for a tenant-aware engine:
+    submits one short request into ``tenant`` (a HIGH-priority class)
+    each time the driver's step counter crosses the next entry of
+    ``at_steps``, forcing the scheduler to evict lower-priority
+    in-flight slots through the preemption/carry-over path.  The
+    schedule, prompts, and seeds are fixed by constructor arguments, so
+    a storm that exposes a leak or a parity break replays exactly.
+
+    The driver calls :meth:`tick` once per scheduler iteration (the
+    storm deliberately does NOT hook the engine — submission timing is
+    scheduler-visible behavior, not a device fault).  Handles land in
+    ``handles`` (``None`` where the class's own queue_limit shed the
+    burst — a storm must obey bounded admission like any tenant);
+    ``submitted`` counts the requests actually accepted."""
+
+    def __init__(self, tenant: str, prompts, at_steps, max_new: int = 2,
+                 seed: int = 0):
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        self.tenant = tenant
+        self.prompts = [np.asarray(p, np.int32).reshape(-1)
+                        for p in prompts]
+        if not self.prompts:
+            raise ValueError("prompts must be non-empty")
+        self.at_steps = sorted(int(s) for s in at_steps)
+        self.max_new = max_new
+        self.seed = seed
+        self.handles: list = []
+        self.submitted = 0
+        self._next = 0
+
+    @property
+    def done(self) -> bool:
+        """Every scheduled burst has been submitted (or shed)."""
+        return self._next >= len(self.at_steps)
+
+    def tick(self, engine, step_index: int) -> None:
+        """Submit every burst whose scheduled step has arrived."""
+        while (self._next < len(self.at_steps)
+               and self.at_steps[self._next] <= step_index):
+            i = self._next
+            self._next += 1
+            try:
+                self.handles.append(engine.submit(
+                    self.prompts[i % len(self.prompts)], self.max_new,
+                    seed=self.seed + i, tenant=self.tenant))
+                self.submitted += 1
+            except QueueFull:
+                self.handles.append(None)
 
 
 class SlowSteps:
