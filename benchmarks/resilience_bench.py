@@ -35,11 +35,26 @@ counts, ``parity_ok``, ``accounted``, and ``device_kind`` — the
 ``tools/record_bench.py`` / ``tools/tpu_when_ready.sh``; CPU smoke rows
 are pinned by ``tests/test_bench_smoke.py``.
 
+``--multihost`` runs the POD-SCALE variant instead (metric
+``train_soak_multihost``, seeds via TRAIN_SOAK_MULTIHOST): each launch
+is TRAIN_SOAK_HOSTS worker processes x TRAIN_SOAK_DEVICES_PER virtual
+CPU devices under the COORDINATED supervisor (docs/RESILIENCE.md
+"Multi-host recovery") — a NaN drives a voted all-host rollback, ONE
+worker is SIGKILLed mid-epoch (the survivor must hard-exit via the
+bounded vote instead of hanging), one host's checkpoint shard is
+byte-flipped between relaunches (the per-host crc32 manifests must
+reject the dir for ALL hosts), a stall exercises coordinated hang
+recovery, and the final relaunch runs at a REDUCED host geometry
+(elastic verified restore).  Same merciless referee: final params
+bit-identical to an uninterrupted run, every fault accounted.
+
 Env knobs: TRAIN_SOAK (comma seeds; default the registry),
 TRAIN_SOAK_PLATFORM (e.g. ``cpu``), TRAIN_SOAK_EPOCHS (3),
 TRAIN_SOAK_PER_EPOCH (6 batches), TRAIN_SOAK_BATCH (8),
 TRAIN_SOAK_KILLS (2), TRAIN_SOAK_WD_TIMEOUT (8s; the stall sleeps 1.75x
-that), TRAIN_SOAK_LOG_EVERY (2).
+that), TRAIN_SOAK_LOG_EVERY (2); multihost adds TRAIN_SOAK_MULTIHOST
+(seeds), TRAIN_SOAK_HOSTS (2), TRAIN_SOAK_DEVICES_PER (2),
+TRAIN_SOAK_VOTE_TIMEOUT (30s).
 """
 
 from __future__ import annotations
@@ -56,7 +71,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tools.bench_gaps import TRAIN_SOAK_SEEDS  # noqa: E402
+from tools.bench_gaps import (TRAIN_SOAK_MULTIHOST_SEEDS,  # noqa: E402
+                              TRAIN_SOAK_SEEDS)
 
 
 def _cfg() -> dict:
@@ -67,6 +83,16 @@ def _cfg() -> dict:
         "kills": int(os.environ.get("TRAIN_SOAK_KILLS", 2)),
         "wd_timeout": float(os.environ.get("TRAIN_SOAK_WD_TIMEOUT", 8.0)),
         "log_every": int(os.environ.get("TRAIN_SOAK_LOG_EVERY", 2)),
+        # Multi-host soak geometry: the pod runs TRAIN_SOAK_HOSTS OS
+        # processes x TRAIN_SOAK_DEVICES_PER virtual CPU devices; the
+        # reduced-geometry relaunch and the uninterrupted reference run
+        # 1 process x (hosts * devices_per) devices — same global mesh,
+        # fewer hosts, which the geometry-invariant config below keeps
+        # bit-identical.
+        "hosts": int(os.environ.get("TRAIN_SOAK_HOSTS", 2)),
+        "devices_per": int(os.environ.get("TRAIN_SOAK_DEVICES_PER", 2)),
+        "vote_timeout": float(os.environ.get("TRAIN_SOAK_VOTE_TIMEOUT",
+                                             30.0)),
     }
 
 
@@ -75,11 +101,43 @@ def _cfg() -> dict:
 # ---------------------------------------------------------------------------
 
 def _worker() -> int:
+    # Pod mode (the multi-host soak): TRAIN_SOAK_NPROC names the host
+    # count of THIS launch (1 = the reduced-geometry / reference shape).
+    # Geometry env must land before the first backend touch.
+    nproc = int(os.environ.get("TRAIN_SOAK_NPROC", 0))
+    rank = int(os.environ.get("TRAIN_SOAK_RANK", 0))
+    devices = int(os.environ.get("TRAIN_SOAK_DEVICES", 0))
+    if devices:
+        # --xla_cpu_multi_thread_eigen=false: Eigen's intra-op thread
+        # pool splits conv/matmul reductions by the PER-PROCESS device
+        # budget, so a 2-host x D and 1-host x 2D pod accumulate in
+        # different orders (~1 ulp/step — measured) and the elastic
+        # bit-exactness oracle would fail for reasons that have nothing
+        # to do with recovery.  Single-threaded Eigen pins the reduction
+        # order; CPU-smoke-only (a real TPU pod never sets
+        # TRAIN_SOAK_DEVICES).
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices} "
+            "--xla_cpu_multi_thread_eigen=false")
     if os.environ.get("TRAIN_SOAK_PLATFORM"):
         import jax
 
         jax.config.update("jax_platforms",
                           os.environ["TRAIN_SOAK_PLATFORM"])
+    if nproc > 1:
+        from tpudp.mesh import initialize_distributed
+
+        initialize_distributed("127.0.0.1", nproc, rank,
+                               port=int(os.environ["TRAIN_SOAK_PORT"]))
+        # First collective of the pod, ALONE: establishes every gloo TCP
+        # pair with one lone symmetric op before real work dispatches
+        # possibly-concurrent, differently-sized collectives — racing
+        # two fresh ops on a just-built pair intermittently dies with a
+        # gloo preamble-size mismatch (observed ~1/10 launches at the
+        # 2-proc CPU smoke geometry, always before the first event).
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tpudp_pod_startup")
     import flax.linen as nn
     import jax
     import numpy as np
@@ -96,7 +154,10 @@ def _worker() -> int:
     cfg = _cfg()
     outdir = os.environ["TRAIN_SOAK_OUT"]
     ckpt = os.path.join(outdir, "ckpt")
-    events_path = os.path.join(outdir, "events.jsonl")
+    # One event log per host; the referee reads rank 0's (recovery
+    # decisions are coordinated, so rank 0's log accounts the pod).
+    events_path = os.path.join(
+        outdir, "events.jsonl" if rank == 0 else f"events.rank{rank}.jsonl")
 
     def emit(ev: dict) -> None:
         with open(events_path, "a") as f:
@@ -118,8 +179,25 @@ def _worker() -> int:
             return nn.Dense(10)(x)
 
     ds = _synthetic(cfg["per_epoch"] * cfg["batch"], seed=17)
-    loader = DataLoader(ds, cfg["batch"], train=True, seed=5,
-                        backend="numpy")
+    if nproc:
+        # Pod mode must be GEOMETRY-INVARIANT so the kill-one-host story
+        # can relaunch smaller and still bit-match the reference: the
+        # batch-contiguous sampler keeps each assembled global batch a
+        # pure function of (seed, epoch) regardless of host count, and
+        # train=False drops augmentation (its host-local RNG stream
+        # would differ by geometry).  The mesh'd trainer below completes
+        # the invariance with the gather-based 'coordinator' sync.
+        from tpudp.data.sampler import ShardedSampler
+
+        loader = DataLoader(
+            ds, cfg["batch"] // nproc,
+            sampler=ShardedSampler(len(ds.images), nproc, rank,
+                                   shuffle=True, seed=5,
+                                   batch_contiguous=cfg["batch"]),
+            train=False, backend="numpy")
+    else:
+        loader = DataLoader(ds, cfg["batch"], train=True, seed=5,
+                            backend="numpy")
     nan_at, spike_at = _idx("TRAIN_SOAK_NAN_AT"), _idx("TRAIN_SOAK_SPIKE_AT")
     loader_at = _idx("TRAIN_SOAK_LOADER_AT")
     if nan_at or spike_at:
@@ -149,15 +227,28 @@ def _worker() -> int:
     watchdog = Watchdog(timeout_s=cfg["wd_timeout"], kill=False,
                         poll_s=0.2).start() if stall_at else None
 
-    trainer = Trainer(SoakNet(), None, "none", spmd_mode="single",
-                      log_every=cfg["log_every"], log_fn=lambda s: None,
-                      watchdog=watchdog, step_fault_hook=hook)
+    if nproc:
+        from tpudp.mesh import make_mesh
+
+        # 'coordinator' sync (all-gather -> local mean) is the
+        # geometry-invariant reduction: no cross-device arithmetic in
+        # flight, so a 2-host x D and 1-host x 2D mesh produce
+        # bit-identical updates (psum's reduction order is not).
+        trainer = Trainer(SoakNet(), make_mesh(), "coordinator",
+                          log_every=cfg["log_every"], log_fn=lambda s: None,
+                          watchdog=watchdog, step_fault_hook=hook)
+    else:
+        trainer = Trainer(SoakNet(), None, "none", spmd_mode="single",
+                          log_every=cfg["log_every"], log_fn=lambda s: None,
+                          watchdog=watchdog, step_fault_hook=hook)
     os.makedirs(ckpt, exist_ok=True)
     start_epoch, skip = auto_resume(trainer, ckpt, cfg["per_epoch"],
                                     log=lambda s: None, on_event=emit)
-    emit({"kind": "relaunch_resume", "epoch": start_epoch, "skip": skip})
+    emit({"kind": "relaunch_resume", "epoch": start_epoch, "skip": skip,
+          "nproc": nproc or 1})
     policy = ResiliencePolicy(checkpoint_dir=ckpt, spike_factor=3.0,
-                              spike_min_history=1, on_event=emit)
+                              spike_min_history=1, on_event=emit,
+                              vote_timeout_s=cfg["vote_timeout"])
 
     def epoch_end(epoch: int) -> None:
         # The harness's kill marker: one line per epoch THIS launch
@@ -165,9 +256,10 @@ def _worker() -> int:
         # fn returns; the harness's kill grace covers that write), so
         # SIGKILLs land after the launch's first full epoch — after its
         # in-process faults have fired and recovered — never during
-        # startup.
-        with open(os.path.join(outdir, "epoch_end.marker"), "a") as f:
-            f.write(f"{epoch}\n")
+        # startup.  Rank 0 only: one marker per pod.
+        if rank == 0:
+            with open(os.path.join(outdir, "epoch_end.marker"), "a") as f:
+                f.write(f"{epoch}\n")
 
     trainer.fit(prefetch, epochs=cfg["epochs"], start_epoch=start_epoch,
                 skip_batches_first_epoch=skip, epoch_end_fn=epoch_end,
@@ -176,14 +268,22 @@ def _worker() -> int:
     if watchdog is not None:
         watchdog.stop()
 
-    flat = np.concatenate([np.asarray(leaf).ravel()
-                           for leaf in jax.tree.leaves(trainer.state.params)])
-    np.save(os.path.join(outdir, "params.npy"), flat)
-    with open(os.path.join(outdir, "done.json"), "w") as f:
-        json.dump({"device_kind": jax.devices()[0].device_kind,
-                   "steps": int(trainer.state.step),
-                   "stats": {k: v for k, v in trainer.stats.items()
-                             if k != "events"}}, f)
+    if rank == 0:
+        # Replicated params: rank 0's bytes are the pod's bytes (the
+        # supervisor asserted the cross-host fingerprint after every
+        # coordinated restore).
+        flat = np.concatenate([np.asarray(leaf).ravel()
+                               for leaf in jax.tree.leaves(
+                                   trainer.state.params)])
+        np.save(os.path.join(outdir, "params.npy"), flat)
+        with open(os.path.join(outdir, "done.json"), "w") as f:
+            json.dump({"device_kind": jax.devices()[0].device_kind,
+                       "steps": int(trainer.state.step),
+                       "nproc": nproc or 1,
+                       "stats": {k: v for k, v in trainer.stats.items()
+                                 if k != "events"}}, f)
+    if nproc > 1:
+        jax.distributed.shutdown()
     return 0
 
 
@@ -279,6 +379,255 @@ def _events(outdir: str) -> list[dict]:
             except json.JSONDecodeError:
                 pass
     return out
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_pod(outdir: str, faults: dict[str, str], nproc: int,
+                devices_per: int) -> list[subprocess.Popen]:
+    """Launch one pod: ``nproc`` worker processes (rank K's stderr to
+    ``worker.r<K>.err``) that rendezvous over a fresh localhost port; a
+    single-process pod (the reference / reduced-geometry shape) skips
+    the rendezvous but keeps the mesh'd geometry-invariant config."""
+    env = dict(os.environ)
+    env["TRAIN_SOAK_OUT"] = outdir
+    for k in ("TRAIN_SOAK_NAN_AT", "TRAIN_SOAK_SPIKE_AT",
+              "TRAIN_SOAK_RAISE_AT", "TRAIN_SOAK_STALL_AT",
+              "TRAIN_SOAK_LOADER_AT"):
+        env.pop(k, None)
+    env.pop("XLA_FLAGS", None)  # workers pin their own device count
+    # Pod workers always run the CPU backend: they are N co-located OS
+    # processes, and two processes cannot share one host's libtpu — on a
+    # TPU VM the second worker would fail to acquire the chips and the
+    # stage could never pass.  The pod soak proves the COORDINATION
+    # protocol (votes, two-phase commit, elastic restore), which is
+    # platform-independent; real multi-VM TPU pods are launched by a
+    # scheduler, not this script.
+    env.setdefault("TRAIN_SOAK_PLATFORM", "cpu")
+    env.update(faults)
+    env["TRAIN_SOAK_NPROC"] = str(nproc)
+    env["TRAIN_SOAK_DEVICES"] = str(devices_per)
+    env["TRAIN_SOAK_PORT"] = str(_free_port())
+    procs = []
+    for r in range(nproc):
+        renv = dict(env)
+        renv["TRAIN_SOAK_RANK"] = str(r)
+        with open(os.path.join(outdir, f"worker.r{r}.err"), "wb") as errf:
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                env=renv, cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=errf))
+    return procs
+
+
+def _pod_stderr_tail(outdir: str, nproc: int, n: int = 500) -> str:
+    parts = []
+    for r in range(nproc):
+        try:
+            with open(os.path.join(outdir, f"worker.r{r}.err"), "rb") as f:
+                parts.append(f"r{r}: "
+                             + f.read().decode(errors="replace")[-n:])
+        except OSError:
+            pass
+    return " | ".join(parts)
+
+
+def _reap_pod(procs: list[subprocess.Popen], grace_s: float) -> list[int]:
+    """Wait up to ``grace_s`` for every worker to exit, then SIGKILL the
+    stragglers (a host wedged in a collective whose peer died — the
+    scheduler-reap analogue).  Returns the return codes."""
+    deadline = time.monotonic() + grace_s
+    for p in procs:
+        try:
+            p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+    return [p.returncode for p in procs]
+
+
+def run_soak_multihost(seed: int, workdir: str) -> dict:
+    """The pod-scale kill/resume soak (docs/RESILIENCE.md "Multi-host
+    recovery").  One seed's schedule:
+
+      launch 1 (H hosts): NaN batch in epoch 0 — the pmean'd loss makes
+              every host see it, the vote agrees on DIVERGENCE, and all
+              hosts roll back together; SIGKILL ONE worker after the
+              epoch-1 checkpoint lands.  The survivor must NOT hang: its
+              next collective (or recovery vote) fails against the dead
+              peer and it hard-exits for relaunch.
+      (one host's shard of the newest checkpoint is byte-flipped)
+      launch 2 (H hosts, SAME geometry): the coordinated resume must
+              reject the flipped dir for ALL hosts and fall back; a
+              stalling step under the kill=False watchdog then exercises
+              coordinated hang recovery; SIGKILL a different worker.
+      launch 3 (1 host, REDUCED geometry): elastic verified restore of
+              the H-host checkpoint, a loss spike in-process, runs to
+              completion.
+
+    Passes only if the final params are BIT-IDENTICAL to an
+    uninterrupted single-launch run and every fault kind is accounted
+    in rank 0's event log."""
+    cfg = _cfg()
+    rng = random.Random(seed * 6007 + 29)
+    per, total_s = cfg["per_epoch"], 900.0
+    hosts, devices_per = cfg["hosts"], cfg["devices_per"]
+    all_devices = hosts * devices_per
+    ref_dir = os.path.join(workdir, f"mh_ref_{seed}")
+    chaos_dir = os.path.join(workdir, f"mh_chaos_{seed}")
+    os.makedirs(ref_dir, exist_ok=True)
+    os.makedirs(chaos_dir, exist_ok=True)
+
+    # Uninterrupted oracle: the reduced geometry (1 process, full mesh).
+    rcs = _reap_pod(_launch_pod(ref_dir, {}, 1, all_devices), total_s)
+    if rcs != [0]:
+        return {"seed": seed, "error": "reference run failed: "
+                + _pod_stderr_tail(ref_dir, 1)}
+
+    ckpt = os.path.join(chaos_dir, "ckpt")
+    kills = 0
+    survivor_exits = []
+
+    # Launch 1: NaN early in epoch 0 (coordinated rollback), then kill
+    # worker 1 after the first epoch checkpoint of this launch commits.
+    # Launch 2: stall mid-way through the launch's FIRST epoch (device
+    # calls restart at 1 per process, so index 2..per-1 always lands
+    # before the epoch-end marker arms the kill — the hang recovery has
+    # completed by the time the SIGKILL can fire), then kill worker 0 —
+    # the coordinator this time, so both orphan-directions are covered.
+    schedules = [
+        ({"TRAIN_SOAK_NAN_AT": str(rng.randrange(1, per - 1))}, 1),
+        ({"TRAIN_SOAK_STALL_AT": str(rng.randrange(2, per))}, 0),
+    ]
+    for i, (faults, victim) in enumerate(schedules):
+        # The kill trigger is "a NEW committed step_N (N >= 1) landed
+        # since this launch started" — NOT the epoch-end marker alone:
+        # the marker can grow before the epoch's checkpoint finishes its
+        # commit barrier, and a kill in that window can leave the series
+        # at step_0 only (the reduced-geometry phase would then resume
+        # from scratch — bit-exact, but proving nothing about elastic
+        # restore).  Keying on the commit marker's mtime guarantees a
+        # multi-host-saved checkpoint >= step_1 survives every launch,
+        # so launch 3 ALWAYS has one to restore elastically (the launch's
+        # in-process faults have fired and recovered by then too — the
+        # first epoch checkpoint commits after the first full epoch).
+        from tpudp.utils.checkpoint import (commit_marker_path,
+                                            step_dirs_newest_first)
+
+        start_ns = time.time_ns()
+        procs = _launch_pod(chaos_dir, faults, hosts, devices_per)
+
+        def grew() -> bool:
+            for d in step_dirs_newest_first(ckpt):
+                if int(os.path.basename(d).rsplit("_", 1)[1]) < 1:
+                    continue
+                try:
+                    if os.stat(commit_marker_path(d)).st_mtime_ns > start_ns:
+                        return True
+                except OSError:
+                    continue
+            return False
+
+        if _wait_for(grew, procs[victim], total_s):
+            time.sleep(0.4)  # past the epoch-end save, into the epoch
+            if procs[victim].poll() is None:
+                procs[victim].send_signal(signal.SIGKILL)
+                kills += 1
+        rcs = _reap_pod(procs, grace_s=3 * cfg["vote_timeout"])
+        survivor_exits.append([rc for r, rc in enumerate(rcs)
+                               if r != victim])
+        if kills != i + 1:
+            return {"seed": seed, "error":
+                    f"pod launch {i + 1} finished before its kill "
+                    f"(rcs={rcs}): " + _pod_stderr_tail(chaos_dir, hosts)}
+        if i == 0:
+            # Byte-flip one host's shard payload of the newest COMMITTED
+            # checkpoint (never the only one — the walk's all-corrupt
+            # refusal would rightly abort the soak).
+            from tpudp.utils.checkpoint import (is_committed,
+                                                step_dirs_newest_first)
+
+            committed = [d for d in step_dirs_newest_first(ckpt)
+                         if is_committed(d)]
+            if len(committed) >= 2:
+                from tpudp.training_faults import corrupt_checkpoint
+
+                corrupt_checkpoint(committed[0], mode="flip_shard")
+
+    # Relaunch at the REDUCED geometry until done: elastic verified
+    # restore of the 2-host series on 1 host, spike in the first resumed
+    # epoch, fault-free after that.
+    final_faults = {"TRAIN_SOAK_SPIKE_AT": str(rng.randrange(2, per - 1))}
+    relaunches = 0
+    while not os.path.exists(os.path.join(chaos_dir, "done.json")):
+        relaunches += 1
+        if relaunches > 6:
+            return {"seed": seed, "error": "multihost soak did not "
+                    "converge in 6 reduced-geometry relaunches"}
+        rcs = _reap_pod(_launch_pod(
+            chaos_dir, final_faults if relaunches == 1 else {},
+            1, all_devices), total_s)
+        if rcs != [0]:
+            return {"seed": seed, "error":
+                    f"reduced-geometry launch rc={rcs}: "
+                    + _pod_stderr_tail(chaos_dir, 1)}
+
+    # Referee: bit-exact parity + typed-event accounting (rank 0's log —
+    # recovery decisions are coordinated, so it accounts the pod).
+    ref_params = open(os.path.join(ref_dir, "params.npy"), "rb").read()
+    chaos_params = open(os.path.join(chaos_dir, "params.npy"), "rb").read()
+    parity_ok = ref_params == chaos_params
+    events = _events(chaos_dir)
+    counts = {}
+    for e in events:
+        counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+    nan_rollbacks = sum(1 for e in events if e["kind"] == "rollback"
+                        and "FloatingPointError" in e.get("error", ""))
+    spike_rollbacks = sum(1 for e in events if e["kind"] == "loss_spike")
+    hang_retries = sum(1 for e in events
+                       if e["kind"] == "step_retry" and e.get("hang"))
+    coordinated = sum(1 for e in events if e.get("coordinated"))
+    resumes = [e for e in events if e["kind"] == "relaunch_resume"]
+    elastic = [e for e in resumes
+               if e.get("nproc") == 1 and (e["epoch"] > 0 or e["skip"] > 0)]
+    done = json.load(open(os.path.join(chaos_dir, "done.json")))
+    accounted = (nan_rollbacks >= 1            # coordinated NaN rollback
+                 and hang_retries >= 1         # coordinated hang recovery
+                 and spike_rollbacks >= 1      # reduced-geometry spike
+                 and counts.get("ckpt_fallback", 0) >= 1  # the shard flip
+                 and coordinated >= 2
+                 and kills == 2
+                 and len(elastic) >= 1         # 2-host ckpt resumed at 1
+                 and len(resumes) >= kills + 1)
+    recoveries = (counts.get("rollback", 0) + counts.get("step_retry", 0)
+                  + counts.get("ckpt_fallback", 0)
+                  + counts.get("loader_restart", 0) + kills)
+    return {
+        "metric": "train_soak_multihost", "seed": seed, "value": recoveries,
+        "unit": "recoveries", "parity_ok": parity_ok,
+        "accounted": accounted, "kills": kills,
+        "hosts": hosts, "devices_per_host": devices_per,
+        "relaunches": len(resumes), "elastic_resumes": len(elastic),
+        "survivor_exits": survivor_exits,
+        "rollbacks": counts.get("rollback", 0),
+        "nan_rollbacks": nan_rollbacks, "spike_rollbacks": spike_rollbacks,
+        "step_retries": counts.get("step_retry", 0),
+        "hang_retries": hang_retries,
+        "coordinated_recoveries": coordinated,
+        "ckpt_fallbacks": counts.get("ckpt_fallback", 0),
+        "vote_timeouts": counts.get("vote_timeout", 0),
+        "steps": done.get("steps"),
+        "epochs": cfg["epochs"], "per_epoch": per, "batch": cfg["batch"],
+        "device_kind": done.get("device_kind"),
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+    }
 
 
 def run_soak(seed: int, workdir: str) -> dict:
@@ -436,32 +785,43 @@ def main() -> None:
     ap.add_argument("--soak", type=str, default=None,
                     help="comma-separated seeds (env: TRAIN_SOAK; default "
                          "the registry)")
+    ap.add_argument("--multihost", action="store_true",
+                    help="run the POD-SCALE soak instead: N worker "
+                         "processes per launch, SIGKILL one of them "
+                         "mid-epoch, byte-flip one host's shard, relaunch "
+                         "at the same and at a reduced host geometry "
+                         "(seeds via --soak / env TRAIN_SOAK_MULTIHOST)")
     ap.add_argument("--workdir", type=str, default=None,
                     help="scratch root (default: a fresh temp dir)")
     args = ap.parse_args()
     if args.worker:
         raise SystemExit(_worker())
-    soak_env = args.soak or os.environ.get("TRAIN_SOAK")
+    registry = (TRAIN_SOAK_MULTIHOST_SEEDS if args.multihost
+                else TRAIN_SOAK_SEEDS)
+    env_name = "TRAIN_SOAK_MULTIHOST" if args.multihost else "TRAIN_SOAK"
+    soak_env = args.soak or os.environ.get(env_name)
     if soak_env is not None and not soak_env.strip():
         return  # the gap helper said: nothing missing
     seeds = ([int(s) for s in soak_env.split(",") if s]
-             if soak_env else list(TRAIN_SOAK_SEEDS))
-    bad = [s for s in seeds if s not in TRAIN_SOAK_SEEDS]
+             if soak_env else list(registry))
+    bad = [s for s in seeds if s not in registry]
     if bad:
         raise SystemExit(f"error: unregistered soak seeds {bad} "
-                         f"(registry: {list(TRAIN_SOAK_SEEDS)})")
+                         f"(registry: {list(registry)})")
     workdir = args.workdir
     if workdir is None:
         import tempfile
 
         workdir = tempfile.mkdtemp(prefix="tpudp_train_soak_")
+    runner = run_soak_multihost if args.multihost else run_soak
+    metric = "train_soak_multihost" if args.multihost else "train_soak"
     for seed in seeds:
         try:
-            row = run_soak(seed, workdir)
+            row = runner(seed, workdir)
         except Exception as e:  # crash isolation: one seed, one row
             row = {"seed": seed, "error": f"{type(e).__name__}: {e}"}
         if "error" in row:
-            row.setdefault("metric", "train_soak")
+            row.setdefault("metric", metric)
             row.setdefault("value", 0)
         print(json.dumps(row), flush=True)
 

@@ -13,12 +13,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, env_extra, timeout=900):
+def _run(script, env_extra, timeout=900, args=()):
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS",)}
     env.update(env_extra)
     return subprocess.run(
-        [sys.executable, os.path.join(REPO, script)],
+        [sys.executable, os.path.join(REPO, script), *args],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
 
 
@@ -425,6 +425,68 @@ def test_train_soak_gap_gate(tmp_path):
              "parity_ok": True, "accounted": True,
              "device_kind": "TPU v5 lite"}) + "\n")
     assert train_soak_missing(d) == [1]  # banked history row counts
+
+
+@pytest.mark.slow
+def test_train_soak_multihost_row():
+    """The pod-scale soak end-to-end on the CPU smoke geometry (2 hosts
+    x 2 virtual devices): NaN -> coordinated rollback, SIGKILL one
+    worker, shard byte-flip, coordinated hang recovery, second kill,
+    reduced-geometry (1-host) elastic resume with a spike — final params
+    bit-identical to an uninterrupted run and every fault accounted
+    (the acceptance oracle for docs/RESILIENCE.md "Multi-host
+    recovery")."""
+    proc = _run("benchmarks/resilience_bench.py", {
+        "TRAIN_SOAK_PLATFORM": "cpu",
+        "TRAIN_SOAK_EPOCHS": "3",
+        "TRAIN_SOAK_PER_EPOCH": "4",
+        "TRAIN_SOAK_WD_TIMEOUT": "6",
+        "TRAIN_SOAK_VOTE_TIMEOUT": "20",
+        "TRAIN_SOAK_MULTIHOST": "0",
+    }, args=["--multihost"], timeout=900)
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    r = next(r for r in rows if r.get("metric") == "train_soak_multihost")
+    assert "error" not in r, r
+    assert r["parity_ok"] is True and r["accounted"] is True
+    assert r["kills"] == 2 and r["hosts"] == 2
+    assert r["nan_rollbacks"] >= 1 and r["hang_retries"] >= 1
+    assert r["coordinated_recoveries"] >= 2
+    assert r["ckpt_fallbacks"] >= 1 and r["spike_rollbacks"] >= 1
+    assert r["elastic_resumes"] >= 1          # 2-host ckpt resumed at 1
+
+
+def test_train_soak_multihost_gap_gate(tmp_path):
+    """tools/bench_gaps train_soak_multihost stage: same closing rules
+    as train_soak (no error/diverged/unaccounted rows) plus the elastic
+    rung — a row that never resumed at a reduced geometry does not close
+    its seed.  Unlike the other stages, cpu rows DO close it: the pod
+    workers run the CPU backend by construction (co-located processes
+    cannot share one libtpu), and the protocol the soak certifies is
+    platform-independent."""
+    from tools.bench_gaps import (TRAIN_SOAK_MULTIHOST_SEEDS,
+                                  train_soak_multihost_missing)
+
+    d = str(tmp_path)
+    assert (train_soak_multihost_missing(d)
+            == list(TRAIN_SOAK_MULTIHOST_SEEDS))
+    ok = {"metric": "train_soak_multihost", "value": 6, "parity_ok": True,
+          "accounted": True, "elastic_resumes": 1, "device_kind": "cpu"}
+    rows = [
+        {"metric": "train_soak_multihost", "seed": 1,
+         "error": "pod wedged", "value": 0},              # error: no
+        {**ok, "seed": 1, "parity_ok": False},            # diverged: no
+        {**ok, "seed": 2, "elastic_resumes": 0},          # no elastic: no
+        {**ok, "seed": 0},                                # cpu pass: yes
+    ]
+    with open(os.path.join(d, "train_soak_multihost.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert train_soak_multihost_missing(d) == [1, 2]
+    with open(os.path.join(d, "train_soak_multihost.history.jsonl"),
+              "w") as f:
+        f.write(json.dumps({**ok, "seed": 2}) + "\n")
+    assert train_soak_multihost_missing(d) == [1]  # banked row counts
 
 
 def test_bad_param_dtype_fails_fast():

@@ -159,3 +159,47 @@ def test_ensure_writable_probe(tmp_path, monkeypatch):
     monkeypatch.setattr(ck, "HAVE_ORBAX", False)
     with pytest.raises(RuntimeError, match="orbax"):
         ck.ensure_writable(tmp_path / "other")
+
+
+def test_multihost_sidecars_leave_with_the_dump(tmp_path):
+    """Multi-host integrity sidecars (per-host shard manifests, COMMITTED
+    marker) must travel with the emergency dump on consume/quarantine,
+    and a later SINGLE-host save over the same name must clear any
+    stragglers: a stale host manifest left at the base name would be
+    verified against the next dump/save's bytes (e.g. after the pod
+    shrank) and reject every future one at this root forever."""
+    from tpudp.utils import checkpoint as ck
+
+    state = {"w": np.arange(4.0)}
+    root = str(tmp_path)
+    emerg = os.path.join(root, "emergency")
+    ck.save_checkpoint(emerg, state)
+    # fabricate the sidecars a 2-host dump would have left
+    for fabricate in (ck.host_manifest_path(emerg, 1),
+                      ck.commit_marker_path(emerg)):
+        with open(fabricate, "w") as f:
+            f.write("{}")
+    consumed = ck.consume_emergency(root)
+    assert not os.path.exists(ck.host_manifest_path(emerg, 1))
+    assert not os.path.exists(ck.commit_marker_path(emerg))
+    assert os.path.exists(ck.host_manifest_path(consumed, 1))
+
+    # quarantine path too
+    ck.save_checkpoint(emerg, state)
+    with open(ck.host_manifest_path(emerg, 1), "w") as f:
+        f.write("{}")
+    ck.quarantine_emergency(root)
+    assert not os.path.exists(ck.host_manifest_path(emerg, 1))
+    assert os.path.exists(ck.host_manifest_path(emerg + ".corrupt", 1))
+
+    # a fresh single-host save clears stale multi-host sidecars under
+    # its name, and then verifies cleanly
+    step = str(tmp_path / "step_1")
+    for fabricate in (ck.host_manifest_path(step, 0),
+                      ck.commit_marker_path(step)):
+        with open(fabricate, "w") as f:
+            f.write("{}")
+    ck.save_checkpoint(step, state)
+    assert not os.path.exists(ck.host_manifest_path(step, 0))
+    assert not os.path.exists(ck.commit_marker_path(step))
+    ck.restore_checkpoint(step, state, verify=True)

@@ -27,6 +27,43 @@ def test_sampler_epoch_reshuffle_and_determinism():
     np.testing.assert_array_equal(frozen.indices(0), frozen.indices(5))
 
 
+def test_sampler_batch_contiguous_is_geometry_invariant():
+    """batch_contiguous: the global batch sequence reassembled from any
+    shard count equals the 1-shard canonical sequence — the property
+    elastic restore's bit-exact replay rests on (the strided default
+    permutes rows within each batch as the host count changes)."""
+    import pytest
+
+    n, B = 48, 8
+    canonical = ShardedSampler(n, 1, 0, shuffle=True, seed=3,
+                               batch_contiguous=B).indices(epoch=1)
+    for shards in (2, 4):
+        per = B // shards
+        parts = [ShardedSampler(n, shards, k, shuffle=True, seed=3,
+                                batch_contiguous=B).indices(epoch=1)
+                 for k in range(shards)]
+        rebuilt = np.concatenate(
+            [np.concatenate([p[b * per:(b + 1) * per] for p in parts])
+             for b in range(n // B)])
+        np.testing.assert_array_equal(canonical, rebuilt)
+        # every shard also sees its usual sample count
+        assert all(len(p) == n // shards for p in parts)
+    # identity at 1 shard: the canonical order IS the plain shuffle
+    plain = ShardedSampler(n, 1, 0, shuffle=True, seed=3).indices(epoch=1)
+    np.testing.assert_array_equal(canonical, plain)
+    # wrap-around padding stays masked for eval weighting (43 samples
+    # pad to 44; the one padded slot is position 43 = batch 10 offset 3,
+    # which the contiguous layout hands to shard 1)
+    _, valid = ShardedSampler(43, 2, 1, shuffle=False,
+                              batch_contiguous=4).indices_and_mask(0)
+    assert valid.sum() == 21 and len(valid) == 22
+    # misfit geometries fail loudly, not silently reorder
+    with pytest.raises(ValueError, match="split evenly"):
+        ShardedSampler(48, 3, 0, batch_contiguous=8)
+    with pytest.raises(ValueError, match="whole number of global batches"):
+        ShardedSampler(42, 2, 0, batch_contiguous=8)
+
+
 def test_normalize_matches_reference_constants():
     img = np.full((1, 32, 32, 3), 255, np.uint8)
     out = normalize_batch(img)

@@ -32,8 +32,23 @@ def test_moe_mlp_shapes_and_aux():
     assert y.shape == x.shape
     load = inter["intermediates"]["moe_load"][0]
     np.testing.assert_allclose(float(load.sum()), 1.0, rtol=1e-6)
+    # Switch aux = E * sum(f_e * P_e) with f the ARGMAX-derived load
+    # fractions: bounded by (0, E] (sum f_e P_e <= max_e P_e <= 1), but
+    # NOT bounded below by 1 — that lower bound only holds when f and P
+    # are similarly ordered (Chebyshev's sum inequality), which argmax
+    # counts under a random gate need not satisfy (a former assertion
+    # here claimed aux >= 1 and failed on exactly such a draw).
     aux = float(inter["intermediates"]["moe_aux"][0])
-    assert aux >= 1.0 - 1e-6  # Switch aux loss is minimized at 1 (uniform)
+    assert 0.0 < aux <= layer.num_experts + 1e-6
+    # The exact anchor the loss is designed around: a perfectly UNIFORM
+    # router (zero gate -> P_e = 1/E) gives aux = E * sum(f_e / E) =
+    # sum(f_e) = 1 identically, for any routing tie-break.
+    uniform = jax.tree_util.tree_map(jnp.zeros_like, variables)
+    uniform["params"]["experts_w1"] = variables["params"]["experts_w1"]
+    uniform["params"]["experts_w2"] = variables["params"]["experts_w2"]
+    _, inter_u = layer.apply(uniform, x, mutable=["intermediates"])
+    np.testing.assert_allclose(
+        float(inter_u["intermediates"]["moe_aux"][0]), 1.0, rtol=1e-6)
 
 
 def test_dropped_tokens_output_zero():

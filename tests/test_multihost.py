@@ -62,6 +62,155 @@ def _run_workers(nproc: int, local_devices: int, out: str,
         return json.load(f)
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _events(outdir, rank=0):
+    name = "events.jsonl" if rank == 0 else f"events.rank{rank}.jsonl"
+    path = os.path.join(outdir, name)
+    if not os.path.exists(path):
+        return []
+    return [json.loads(l) for l in open(path) if l.strip().startswith("{")]
+
+
+def _pod_env(extra):
+    base = {"TRAIN_SOAK_PLATFORM": "cpu", "TRAIN_SOAK_EPOCHS": "3",
+            "TRAIN_SOAK_PER_EPOCH": "4", "TRAIN_SOAK_BATCH": "8",
+            "TRAIN_SOAK_PACE_S": "0", "TRAIN_SOAK_VOTE_TIMEOUT": "30"}
+    base.update(extra)
+    return base
+
+
+def _run_pod(outdir, extra_env, nproc, devices_per, timeout_s=600,
+             faults=None):
+    """Launch one soak-worker pod (benchmarks/resilience_bench.py
+    --worker, the multihost_worker.py subprocess pattern grown into the
+    supervised trainer) and reap it; returns per-rank return codes.
+    ``faults`` rides _launch_pod's injection channel (it deliberately
+    strips TRAIN_SOAK_*_AT from the inherited environment)."""
+    import sys as _sys
+
+    _sys.path.insert(0, REPO)
+    from benchmarks.resilience_bench import _launch_pod, _reap_pod
+
+    saved = {k: os.environ.get(k) for k in _pod_env(extra_env)}
+    os.environ.update(_pod_env(extra_env))
+    try:
+        return _reap_pod(
+            _launch_pod(outdir, faults or {}, nproc, devices_per),
+            timeout_s)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.slow
+def test_nan_on_one_host_rolls_back_every_host(tmp_path):
+    """Coordinated divergence rollback: a NaN batch poisons the pmean'd
+    loss, so BOTH hosts catch it, vote, and roll back to the same step —
+    and the recovered 2-host trajectory is bit-identical to a clean
+    single-process run of the same global schedule (the pod analogue of
+    the single-host bit-exact oracle)."""
+    chaos = str(tmp_path / "chaos")
+    clean = str(tmp_path / "clean")
+    os.makedirs(chaos), os.makedirs(clean)
+    assert _run_pod(chaos, {}, 2, 2,
+                    faults={"TRAIN_SOAK_NAN_AT": "2"}) == [0, 0]
+    assert _run_pod(clean, {}, 1, 4) == [0]
+    assert (open(os.path.join(chaos, "params.npy"), "rb").read()
+            == open(os.path.join(clean, "params.npy"), "rb").read())
+    for rank in (0, 1):
+        ev = _events(chaos, rank)
+        rb = [e for e in ev if e["kind"] == "rollback"]
+        assert rb and rb[0].get("coordinated") is True, (rank, ev)
+        assert "FloatingPointError" in rb[0]["error"]
+        votes = [e for e in ev if e["kind"] == "vote"]
+        assert votes and votes[0]["worst"] == "divergence"
+    # both hosts restored the SAME step
+    assert (_events(chaos, 0)[
+        [e["kind"] for e in _events(chaos, 0)].index("rollback")]["step"]
+        == _events(chaos, 1)[
+        [e["kind"] for e in _events(chaos, 1)].index("rollback")]["step"])
+
+
+@pytest.mark.slow
+def test_elastic_restore_skips_flipped_shard(tmp_path):
+    """Kill-one-host-and-relaunch-smaller, the steady-state pod event:
+    a 2-host run's checkpoints restore at 1 host (elastic), the walk
+    rejects a checkpoint whose SHARD bytes were flipped (caught by the
+    per-host crc32 manifests on the reassembled view), and the final
+    params still match a never-interrupted single-process run."""
+    from tpudp.training_faults import corrupt_checkpoint
+    from tpudp.utils.checkpoint import is_committed
+
+    chaos = str(tmp_path / "chaos")
+    clean = str(tmp_path / "clean")
+    os.makedirs(chaos), os.makedirs(clean)
+    # Phase 1: the pod trains 2 of 3 epochs at 2 hosts, then "dies".
+    assert _run_pod(chaos, {"TRAIN_SOAK_EPOCHS": "2"}, 2, 2) == [0, 0]
+    ckpt = os.path.join(chaos, "ckpt")
+    newest = os.path.join(ckpt, "step_2")
+    assert is_committed(newest)  # two-phase commit completed
+    os.unlink(os.path.join(chaos, "done.json"))  # it "didn't finish"
+    corrupt_checkpoint(newest, mode="flip_shard")
+    # Phase 2: relaunch at HALF the hosts — must reject the flipped
+    # dir for the elastic restore too, fall back, and replay.
+    assert _run_pod(chaos, {}, 1, 4) == [0]
+    assert _run_pod(clean, {}, 1, 4) == [0]
+    assert (open(os.path.join(chaos, "params.npy"), "rb").read()
+            == open(os.path.join(clean, "params.npy"), "rb").read())
+    ev = _events(chaos)
+    fallbacks = [e for e in ev if e["kind"] == "ckpt_fallback"]
+    assert fallbacks and "step_2" in fallbacks[0]["rejected"]
+    assert os.path.isdir(os.path.join(ckpt, "step_2.corrupt"))
+    resumes = [e for e in ev if e["kind"] == "relaunch_resume"]
+    assert resumes[-1]["nproc"] == 1 and resumes[-1]["epoch"] == 1
+
+
+@pytest.mark.slow
+def test_vote_timeout_routes_to_hard_exit(tmp_path):
+    """A host whose recovery vote nobody answers (its peer is wedged in
+    a device collective, or dead) must NOT hang: the bounded wait hard-
+    exits with VOTE_TIMEOUT_EXIT so the scheduler can relaunch the pod
+    into the coordinated resume path.  Rank 0 alone gets a step fault —
+    its peer never reaches a vote."""
+    import subprocess
+    import sys as _sys
+
+    from tpudp.resilience import VOTE_TIMEOUT_EXIT
+
+    outdir = str(tmp_path)
+    bench = os.path.join(REPO, "benchmarks", "resilience_bench.py")
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update(_pod_env({"TRAIN_SOAK_VOTE_TIMEOUT": "6",
+                         "TRAIN_SOAK_OUT": outdir,
+                         "TRAIN_SOAK_NPROC": "2",
+                         "TRAIN_SOAK_DEVICES": "2",
+                         "TRAIN_SOAK_PORT": str(port)}))
+    procs = []
+    for rank in range(2):
+        renv = dict(env)
+        renv["TRAIN_SOAK_RANK"] = str(rank)
+        if rank == 0:  # only rank 0 faults: an ASYMMETRIC failure
+            renv["TRAIN_SOAK_RAISE_AT"] = "2"
+        procs.append(subprocess.Popen(
+            [_sys.executable, bench, "--worker"], env=renv, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    try:
+        rc0 = procs[0].wait(timeout=300)
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
+    assert rc0 == VOTE_TIMEOUT_EXIT, rc0
+    ev = _events(outdir)
+    assert any(e["kind"] == "vote_timeout" for e in ev), ev
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("sync", ["allreduce", "ring"])
 def test_two_process_matches_single_process(tmp_path, sync):

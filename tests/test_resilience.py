@@ -257,6 +257,183 @@ def test_prune_never_deletes_last_verified(tmp_path):
     assert not os.path.exists(manifest_path(tmp_path / "step_1"))
 
 
+def test_torn_multihost_commit_rejected_by_walk(tmp_path):
+    """Two-phase commit: a step dir carrying per-host shard manifests
+    but NO COMMITTED marker is a torn multi-host save — the verified
+    walk must reject it (quarantining every sidecar with the dir) even
+    though its bytes would verify, and accept it again once the marker
+    exists.  Runs single-process: the marker rule keys off the dir's
+    sidecars, not the current process count, so an elastic single-host
+    resume of a torn pod save is refused identically."""
+    import json
+
+    from tpudp.utils import checkpoint as ck
+
+    tr = _run(tmp_path)  # step_0..step_2, single-host manifests
+    state = tr.state
+    # Rewrite step_2's sidecars the way a 2-host save would have:
+    # per-host shard manifests instead of the plain manifest.
+    path = str(tmp_path / "step_2")
+    os.unlink(ck.manifest_path(path))
+    shard_manifest = {"format": 2, "host": 0, "nprocs": 2,
+                      "leaves": ck.leaf_shard_checksums(state)}
+    with open(ck.host_manifest_path(path, 0), "w") as f:
+        json.dump(shard_manifest, f)
+    # no COMMITTED marker -> torn -> walk falls back to step_1
+    _s, used, skipped = ck.restore_latest_verified(
+        str(tmp_path), state, log=lambda s: None)
+    assert used.endswith("step_1")
+    assert len(skipped) == 1 and "uncommitted" in skipped[0][1]
+    quarantined = tmp_path / "step_2.corrupt"
+    assert quarantined.is_dir()
+    # every sidecar left the series with the dir
+    assert os.path.exists(
+        ck.host_manifest_path(str(quarantined), 0))
+    assert not os.path.exists(ck.host_manifest_path(path, 0))
+
+    # marker present -> the same shard manifests verify and the dir is
+    # the restore target again
+    os.rename(quarantined, path)
+    os.rename(ck.host_manifest_path(str(quarantined), 0),
+              ck.host_manifest_path(path, 0))
+    with open(ck.commit_marker_path(path), "w") as f:
+        json.dump({"nprocs": 2}, f)
+    _s, used2, skipped2 = ck.restore_latest_verified(
+        str(tmp_path), state, log=lambda s: None)
+    assert used2.endswith("step_2") and skipped2 == []
+    # ...and a tampered shard checksum rejects it for real
+    shard_manifest["leaves"][next(iter(shard_manifest["leaves"]))][
+        "shards"][0]["crc32"] ^= 1
+    with open(ck.host_manifest_path(path, 0), "w") as f:
+        json.dump(shard_manifest, f)
+    _s, used3, skipped3 = ck.restore_latest_verified(
+        str(tmp_path), state, log=lambda s: None)
+    assert used3.endswith("step_1")
+    assert any("checksum mismatch" in r for _p, r in skipped3)
+
+
+def test_prune_guards_cross_host_races(tmp_path, monkeypatch):
+    """Multi-host prune satellites: a dir with host manifests but no
+    COMMITTED marker may still be mid-write by a peer — never deleted;
+    a committed dir prunes WITH all its sidecars; and only process 0
+    deletes at all (the rank guard is enforced inside prune, so a
+    caller that forgets it cannot race N deleters)."""
+    import json
+
+    import jax
+
+    from tpudp.utils import checkpoint as ck
+
+    state = {"w": np.arange(8.0)}
+    for step in (1, 2, 3, 4):
+        ck.save_checkpoint(tmp_path / f"step_{step}", state)
+    # step_1: simulate a committed 2-host save; step_2: an in-flight one
+    for step, committed in ((1, True), (2, False)):
+        path = str(tmp_path / f"step_{step}")
+        os.unlink(ck.manifest_path(path))
+        with open(ck.host_manifest_path(path, 1), "w") as f:
+            json.dump({"format": 2, "host": 1, "leaves": {}}, f)
+        if committed:
+            with open(ck.commit_marker_path(path), "w") as f:
+                json.dump({"nprocs": 2}, f)
+
+    # a non-zero rank must delete nothing
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert ck.prune_step_dirs(tmp_path, keep=1) == []
+    assert (tmp_path / "step_1").is_dir() and (tmp_path / "step_2").is_dir()
+    monkeypatch.undo()
+
+    deleted = ck.prune_step_dirs(tmp_path, keep=1)
+    # committed step_1 pruned (sidecars and all); UNCOMMITTED step_2
+    # skipped — a peer may still be writing it
+    assert sorted(os.path.basename(d) for d in deleted) == [
+        "step_1", "step_3"]
+    assert not os.path.exists(ck.host_manifest_path(
+        str(tmp_path / "step_1"), 1))
+    assert not os.path.exists(ck.commit_marker_path(
+        str(tmp_path / "step_1")))
+    assert (tmp_path / "step_2").is_dir()
+    assert (tmp_path / "step_4").is_dir()
+
+
+def test_divergent_listing_skips_without_quarantine(tmp_path, monkeypatch):
+    """Cross-host walk alignment: a step dir a PEER cannot see
+    (shared-FS listing lag — the bytes may be perfectly healthy, only
+    the peer's listing is stale) is skipped WITHOUT quarantine, and the
+    walk restores the newest step every host sees.  A peer whose series
+    is exhausted aborts ALL hosts together (typed RuntimeError) instead
+    of leaving them parked in a collective nobody will join.  Drives the
+    walk's protocol seams directly (gather/vote monkeypatched) so the
+    scenario runs single-process."""
+    import jax as real_jax
+
+    from tpudp.utils import checkpoint as ck
+
+    tr = _run(tmp_path)  # step_0..step_2, all healthy
+    state = tr.state
+
+    class _TwoHostJax:
+        """Real jax, except the walk believes it is host 0 of 2."""
+
+        def __getattr__(self, name):
+            return getattr(real_jax, name)
+
+        @staticmethod
+        def process_count():
+            return 2
+
+        @staticmethod
+        def process_index():
+            return 0
+
+    monkeypatch.setattr(ck, "jax", _TwoHostJax())
+    # The peer's newest visible step is 1 — it never saw step_2 land.
+    monkeypatch.setattr(ck, "gather_host_values",
+                        lambda v: [int(v), min(int(v), 1)])
+    monkeypatch.setattr(ck, "all_hosts_ok", lambda ok, value=0: ok)
+    _s, used, skipped = ck.restore_latest_verified(
+        str(tmp_path), state, log=lambda s: None)
+    assert used.endswith("step_1")
+    assert len(skipped) == 1 and "not visible on every host" in skipped[0][1]
+    # the unseen dir was NOT quarantined — it is healthy, and the next
+    # resume (peer listing caught up) may restore it
+    assert (tmp_path / "step_2").is_dir()
+    assert not (tmp_path / "step_2.corrupt").exists()
+
+    # peer exhausted from the start: every host aborts together, typed
+    monkeypatch.setattr(ck, "gather_host_values", lambda v: [int(v), -1])
+    with pytest.raises(RuntimeError, match="restorable on every host"):
+        ck.restore_latest_verified(str(tmp_path), state, log=lambda s: None)
+
+
+def test_outcome_reduction_and_single_host_vote_identity(tmp_path):
+    """The agreement protocol's pure core: worst severity wins, and on a
+    single process the vote is the identity (no collective, no thread,
+    byte-for-byte the old behavior)."""
+    from tpudp.resilience import (OUTCOME_DIVERGENCE, OUTCOME_HANG,
+                                  OUTCOME_OK, OUTCOME_STEP_FAULT,
+                                  ResiliencePolicy, Supervisor,
+                                  reduce_outcomes)
+    from tpudp.utils.checkpoint import all_hosts_ok
+
+    assert (OUTCOME_OK < OUTCOME_STEP_FAULT < OUTCOME_HANG
+            < OUTCOME_DIVERGENCE)
+    assert reduce_outcomes([OUTCOME_OK, OUTCOME_OK]) == OUTCOME_OK
+    assert reduce_outcomes(
+        [OUTCOME_OK, OUTCOME_DIVERGENCE]) == OUTCOME_DIVERGENCE
+    assert reduce_outcomes(
+        [OUTCOME_HANG, OUTCOME_STEP_FAULT]) == OUTCOME_HANG
+
+    sup = Supervisor(_trainer(),
+                     ResiliencePolicy(checkpoint_dir=str(tmp_path)))
+    assert not sup._multihost
+    for code in (OUTCOME_OK, OUTCOME_DIVERGENCE):
+        assert sup._vote(code) == code
+    assert sup._vote_seq == 0  # no protocol round was consumed
+    # single-process unanimity vote is the identity too
+    assert all_hosts_ok(True) and not all_hosts_ok(False)
+
+
 def test_eval_nan_fails_loudly_with_context():
     """Satellite: a NaN eval must raise with epoch + iteration context,
     not report a garbage accuracy number."""

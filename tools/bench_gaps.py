@@ -26,6 +26,10 @@ the missing work as arguments the benches accept:
     python tools/bench_gaps.py train_soak -> comma-separated kill/resume
                                            soak seeds (training-resilience
                                            rows missing)
+    python tools/bench_gaps.py train_soak_multihost -> comma-separated
+                                           pod-scale kill-one-host soak
+                                           seeds (multi-host resilience
+                                           rows missing)
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -80,6 +84,19 @@ SERVE_TENANCY_SEEDS = (0, 1, 2)
 # run (parity_ok) with every recovery accounted in the typed event log
 # (accounted); same registry contract.
 TRAIN_SOAK_SEEDS = (0, 1, 2)
+# Pod-scale kill-one-host soak seeds (resilience_bench.py --multihost:
+# N worker processes under the coordinated supervisor, SIGKILL one
+# mid-epoch, byte-flip one host's checkpoint shard, relaunch at the
+# same and at a REDUCED host geometry) that must PASS — same closing
+# bar as train_soak (parity_ok + accounted), plus the row must have
+# resumed the multi-host checkpoint at the reduced geometry
+# (elastic_resumes > 0).  Unlike the other stages there is NO real-TPU
+# device gate: the pod is N co-located OS processes on the CPU backend
+# by construction (two processes cannot share one host's libtpu; real
+# multi-VM TPU pods are launched by a scheduler, not this script), and
+# what the soak certifies — the coordination protocol — is
+# platform-independent.
+TRAIN_SOAK_MULTIHOST_SEEDS = (0, 1, 2)
 
 
 def history_path(path: str) -> str:
@@ -275,6 +292,27 @@ def train_soak_missing(d: str) -> list[int]:
     return [s for s in TRAIN_SOAK_SEEDS if s not in done]
 
 
+def train_soak_multihost_missing(d: str) -> list[int]:
+    """Pod-scale soak seeds still lacking a PASSING run.  Same rules as
+    train_soak_missing, plus the row must prove the ELASTIC step — the
+    multi-host checkpoint actually restored at the reduced geometry
+    (``elastic_resumes > 0``); a soak that only ever relaunched at the
+    save-time host count proved nothing about shrinking.  No real-TPU
+    device gate (see the registry comment): the pod workers run the CPU
+    backend by construction, and the protocol the soak certifies is
+    platform-independent."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "train_soak_multihost.jsonl")):
+        if (r.get("metric") == "train_soak_multihost"
+                and r.get("seed") in TRAIN_SOAK_MULTIHOST_SEEDS
+                and measured(r)
+                and r.get("parity_ok") is True
+                and r.get("accounted") is True
+                and r.get("elastic_resumes", 0) > 0):
+            done.add(r["seed"])
+    return [s for s in TRAIN_SOAK_MULTIHOST_SEEDS if s not in done]
+
+
 def epoch_missing(d: str) -> bool:
     return not any(
         r.get("metric") == "vgg11_epoch_images_per_sec" and measured(r)
@@ -378,7 +416,7 @@ def main() -> None:
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_soak",
                                      "serve_prefix", "serve_tenancy",
-                                     "train_soak"])
+                                     "train_soak", "train_soak_multihost"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -400,6 +438,10 @@ def main() -> None:
               end="")
     elif args.stage == "train_soak":
         print(",".join(str(s) for s in train_soak_missing(args.dir)),
+              end="")
+    elif args.stage == "train_soak_multihost":
+        print(",".join(str(s)
+                       for s in train_soak_multihost_missing(args.dir)),
               end="")
     elif args.stage == "serve_prefix":
         print(",".join(serve_prefix_missing(args.dir)), end="")

@@ -324,6 +324,40 @@ def main() -> None:
                   f"{r.get('loader_restarts')} loader restarts) | "
                   f"`resilience_bench.py` | |")
 
+    # Pod-scale kill-one-host soak rows: same pass/fail contract as
+    # train_soak, plus the elastic rung — the row must have restored the
+    # multi-host checkpoint at the reduced geometry (mirrors
+    # bench_gaps.train_soak_multihost_missing).
+    mhsoak = _dedupe(
+        (r for r in _rows(os.path.join(args.dir,
+                                       "train_soak_multihost.jsonl"))
+         if "seed" in r and r.get("metric") == "train_soak_multihost"),
+        "seed")
+    for r in sorted(mhsoak.values(), key=lambda r: r.get("seed", 0)):
+        if (not measured(r) or not r.get("parity_ok")
+                or not r.get("accounted")
+                or not r.get("elastic_resumes", 0) > 0):
+            why = r.get("error") or ", ".join(
+                w for w, bad in (("params diverged", not r.get("parity_ok")),
+                                 ("recovery unaccounted",
+                                  not r.get("accounted")),
+                                 ("no elastic resume",
+                                  not r.get("elastic_resumes", 0) > 0))
+                if bad) or "no real measurement"
+            print(f"| train_soak_multihost seed={r.get('seed')} | FAILED: "
+                  f"{str(why)[:120]} | `resilience_bench.py --multihost` "
+                  "| |")
+        else:
+            print(f"| multihost soak seed={r['seed']} "
+                  f"({r.get('hosts')}x{r.get('devices_per_host')} kill-one-"
+                  f"host) | PASS: bit-exact params after {r['value']} "
+                  f"recoveries ({r.get('kills')} SIGKILLs, "
+                  f"{r.get('nan_rollbacks')} coordinated NaN rollbacks, "
+                  f"{r.get('hang_retries')} coordinated hang retries, "
+                  f"{r.get('ckpt_fallbacks')} shard-corruption fallbacks, "
+                  f"{r.get('elastic_resumes')} reduced-geometry resumes) | "
+                  f"`resilience_bench.py --multihost` | |")
+
     flash = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
          if "t" in r), "t")

@@ -107,6 +107,10 @@ train_soak_ok() {
   local out; out=$(python tools/bench_gaps.py train_soak) || return 1
   [ -z "$out" ]
 }
+train_soak_multihost_ok() {
+  local out; out=$(python tools/bench_gaps.py train_soak_multihost) || return 1
+  [ -z "$out" ]
+}
 mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
@@ -416,6 +420,27 @@ while true; do
         > bench_results/train_soak.jsonl 2> bench_results/train_soak.err
       log "train_soak rc=$? -> bench_results/train_soak.jsonl"
     fi
+    if train_soak_multihost_ok; then
+      log "train_soak_multihost.jsonl already good; skipping pod soak"
+    else
+      # Pod-scale kill-one-host soak (docs/RESILIENCE.md "Multi-host
+      # recovery"): N worker processes under the coordinated supervisor,
+      # SIGKILL one mid-epoch, byte-flip one host's checkpoint shard,
+      # relaunch at the same and at a REDUCED host geometry; a seed
+      # passes only with final params bit-identical to the uninterrupted
+      # run, every fault accounted, and at least one elastic resume —
+      # resumes at seed granularity via bench_gaps.  Workers run the CPU
+      # backend even on the TPU VM (co-located processes cannot share
+      # one libtpu; the protocol being certified is platform-
+      # independent), so this stage closes on this host's cpu rows.
+      bank bench_results/train_soak_multihost.jsonl
+      ensure_window
+      TRAIN_SOAK_MULTIHOST="$(python tools/bench_gaps.py train_soak_multihost)" \
+        timeout -k "$GRACE" "$(stage_t 1800)" python benchmarks/resilience_bench.py \
+        --multihost \
+        > bench_results/train_soak_multihost.jsonl 2> bench_results/train_soak_multihost.err
+      log "train_soak_multihost rc=$? -> bench_results/train_soak_multihost.jsonl"
+    fi
     if flash_ok; then
       log "flash.jsonl already good; skipping flash bench"
     else
@@ -446,7 +471,7 @@ while true; do
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
         && lever_ok && collective_ok && serve_ok && serve_spec_ok \
         && serve_soak_ok && serve_prefix_ok && serve_tenancy_ok \
-        && train_soak_ok; then
+        && train_soak_ok && train_soak_multihost_ok; then
       log "battery done"
       exit 0
     fi

@@ -229,15 +229,11 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     world = 1 if mesh is None else mesh.size
     num_hosts = jax.process_count()
     host_id = jax.process_index()
-    if args.resilience and num_hosts > 1:
-        raise SystemExit(
-            "error: --resilience is single-host for now: recovery makes "
-            "per-process restore/rollback decisions, and without a "
-            "cross-host agreement protocol two hosts could resume "
-            "different epochs (divergent replicas, wedged collectives). "
-            "Multi-host keeps the watchdog's exit-and-relaunch posture "
-            "(--step-timeout without --resilience); coordinated rollback "
-            "is future work (docs/RESILIENCE.md)")
+    # --resilience runs multi-host too: the supervisor's recovery
+    # decisions are COORDINATED (allgathered outcome votes, worst
+    # severity wins; the verified-restore walk votes per step dir), so
+    # every host resumes the same state — docs/RESILIENCE.md
+    # "Multi-host recovery".
 
     if args.batch_size % world or args.batch_size % num_hosts:
         raise SystemExit(
@@ -319,37 +315,39 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
     if args.checkpoint_dir:
         import os
 
-        from tpudp.utils.checkpoint import (emergency_dir, latest_step_dir,
-                                            restore_checkpoint,
+        from tpudp.utils.checkpoint import (coordinated_any, emergency_dir,
+                                            latest_step_dir,
                                             restore_latest_verified,
                                             save_checkpoint)
 
-        latest = latest_step_dir(args.checkpoint_dir)
-        if latest and jax.process_count() == 1:
+        # Entry into each collective restore protocol is itself a
+        # collective decision (coordinated_any): a per-host listing probe
+        # deciding entry would leave the host that sees a checkpoint
+        # alone inside an allgather its stale-listing peer never joins.
+        if coordinated_any(latest_step_dir(args.checkpoint_dir)
+                           is not None):
             # Verified restore with fallback: a torn or bit-flipped newest
             # checkpoint (killed mid-save, disk rot) must never crash-loop
             # the resume — walk back to the newest intact step dir
             # (tpudp/utils/checkpoint.py::restore_latest_verified).
+            # Multi-host, the walk is COORDINATED: hosts align on the
+            # newest step every host sees, then vote per step dir
+            # (unanimity) so every process resumes the SAME checkpoint —
+            # a shard corrupt on one host rejects the dir for all, and
+            # process 0 alone quarantines it.
             trainer.state, used, _skipped = restore_latest_verified(
                 args.checkpoint_dir, trainer.state, log=print)
             start_epoch = int(used.rsplit("_", 1)[1])
             restored = True
             print(f"[tpudp] resumed from {used} (epoch {start_epoch})")
-        elif latest:
-            # Multi-host: per-process fallback/quarantine decisions could
-            # put hosts on DIFFERENT epochs (divergent replicas, wedged
-            # collectives) — keep the uniform-outcome legacy restore; a
-            # corrupt checkpoint crashes every process identically and
-            # the scheduler relaunches.  Coordinated multi-host fallback
-            # is future work (docs/RESILIENCE.md).
-            trainer.state = restore_checkpoint(latest, trainer.state)
-            start_epoch = int(latest.rsplit("_", 1)[1])
-            restored = True
-            print(f"[tpudp] resumed from {latest} (epoch {start_epoch})")
         # An emergency dump (watchdog-triggered, mid-epoch) is newer than any
         # epoch checkpoint: prefer its weights, then consume it so later
         # resumes fall back to the regular epoch series.
         emerg = emergency_dir(args.checkpoint_dir)
+        if coordinated_any(emerg is not None) and emerg is None:
+            # Stale listing on this host; the dump's location is fixed,
+            # and the voted restore below decides its fate for all hosts.
+            emerg = os.path.join(args.checkpoint_dir, "emergency")
         if emerg:
             # Refuse a mismatched relaunch BEFORE the dump is consumed:
             # the fast-forward below maps the optimizer-step counter onto
@@ -373,25 +371,22 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                     "Relaunch with the original configuration, or remove "
                     "the dump directory to restart the epoch from the "
                     "last step_N checkpoint.")
-            try:
-                # verify=True (single-host): the dump carries a checksum
-                # manifest; a dump whose sentinel committed but whose
-                # bytes rotted must fall back to the step series, never
-                # crash-loop the resume.  Multi-host keeps the legacy
-                # unverified restore: a per-process quarantine decision
-                # could leave hosts resuming different states.
-                trainer.state = restore_checkpoint(
-                    emerg, trainer.state, verify=jax.process_count() == 1)
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:
-                print(f"[tpudp] WARNING: emergency dump {emerg} failed "
-                      f"restore/verification ({e}); quarantining it and "
-                      "falling back to the epoch checkpoint series")
-                if jax.process_index() == 0:
-                    from tpudp.utils.checkpoint import quarantine_emergency
+            # verify=True: the dump carries a checksum manifest (per-host
+            # shard manifests on multi-host); a dump whose sentinel
+            # committed but whose bytes rotted must fall back to the step
+            # series, never crash-loop the resume.  Multi-host, the
+            # accept/quarantine decision is UNANIMOUS: a shard corrupt on
+            # one host rejects the dump for all, so no per-process
+            # decision can leave hosts resuming different states
+            # (tpudp/utils/checkpoint.py::restore_emergency_voted — the
+            # same protocol auto_resume uses).
+            from tpudp.utils.checkpoint import restore_emergency_voted
 
-                    quarantine_emergency(args.checkpoint_dir)
+            dump_state = restore_emergency_voted(
+                args.checkpoint_dir, emerg, trainer.state, log=print)
+            if dump_state is not None:
+                trainer.state = dump_state
+            else:
                 emerg = None
         if emerg:
             restored = True

@@ -21,6 +21,21 @@ import numpy as np
 
 
 class ShardedSampler:
+    """``batch_contiguous`` (a GLOBAL batch size, or None) switches the
+    shard layout from DistributedSampler's strided slice
+    (``indices[rank::num_shards]``) to per-batch CONTIGUOUS slices: shard
+    ``k`` of ``H`` takes rows ``[k*B/H, (k+1)*B/H)`` of every global
+    batch drawn from the canonical order.  The strided layout PERMUTES
+    rows within each assembled global batch as the host count changes
+    (host 0 of 2 holds rows 0,2,4,... — at 1 host they are 0,1,2,...),
+    so a trajectory is only reproducible at the exact save-time host
+    geometry; the contiguous layout makes the assembled global batch a
+    pure function of ``(seed, epoch)``, independent of how many hosts
+    contribute — the property elastic restore (a 2-host run resumed at
+    1 host, docs/RESILIENCE.md) needs for bit-exact replay.  Requires
+    the padded total size to divide into whole global batches and the
+    batch to split evenly across shards."""
+
     def __init__(
         self,
         dataset_size: int,
@@ -30,6 +45,7 @@ class ShardedSampler:
         shuffle: bool = True,
         seed: int = 0,
         reshuffle_each_epoch: bool = True,
+        batch_contiguous: int | None = None,
     ):
         if not 0 <= shard_index < num_shards:
             raise ValueError(f"shard_index {shard_index} out of range [0, {num_shards})")
@@ -43,6 +59,17 @@ class ShardedSampler:
         # (DistributedSampler pads by wrapping around).
         self.num_samples = -(-dataset_size // num_shards)  # ceil
         self.total_size = self.num_samples * num_shards
+        self.batch_contiguous = batch_contiguous
+        if batch_contiguous is not None:
+            if batch_contiguous % num_shards:
+                raise ValueError(
+                    f"batch_contiguous={batch_contiguous} must split evenly "
+                    f"across {num_shards} shards")
+            if self.total_size % batch_contiguous:
+                raise ValueError(
+                    f"padded dataset size {self.total_size} is not a whole "
+                    f"number of global batches of {batch_contiguous} — the "
+                    "contiguous layout has no canonical final batch")
 
     def indices(self, epoch: int = 0) -> np.ndarray:
         return self.indices_and_mask(epoch)[0]
@@ -63,6 +90,15 @@ class ShardedSampler:
             pad = self.total_size - self.dataset_size
             order = np.concatenate([order, order[:pad]])
             valid[self.dataset_size :] = False
+        if self.batch_contiguous is not None:
+            # Geometry-invariant layout: rows [k*B/H, (k+1)*B/H) of every
+            # global batch in canonical order (see class docstring).
+            per = self.batch_contiguous // self.num_shards
+            lo = self.shard_index * per
+            order = order.reshape(-1, self.batch_contiguous)
+            valid = valid.reshape(-1, self.batch_contiguous)
+            return (order[:, lo:lo + per].reshape(-1),
+                    valid[:, lo:lo + per].reshape(-1))
         sel = slice(self.shard_index, None, self.num_shards)
         return order[sel], valid[sel]
 

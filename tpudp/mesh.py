@@ -33,11 +33,35 @@ def initialize_distributed(
     """
     if coordinator is None and num_processes in (None, 1):
         return
+    _enable_cpu_cross_process_collectives()
     jax.distributed.initialize(
         coordinator_address=f"{coordinator}:{port}",
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def _enable_cpu_cross_process_collectives() -> None:
+    """Multi-process runs on the CPU backend (the dry-run/soak rungs:
+    N OS processes, each with virtual CPU devices) need a cross-process
+    collectives implementation — the default ``'none'`` computes only
+    intra-process and a 2-process psum silently reduces half the mesh.
+    Select gloo unless a non-CPU platform was EXPLICITLY requested
+    (those bring their own fabric): an unset platform on a CPU-only
+    machine auto-selects the cpu backend, and skipping it there would
+    leave the silent half-mesh psum in place.  The option only
+    configures the CPU backend, so setting it under a TPU auto-select
+    is inert.  Best-effort (older jax has no such option)."""
+    import os
+
+    platforms = str(getattr(jax.config, "jax_platforms", None)
+                    or os.environ.get("JAX_PLATFORMS", "") or "")
+    if platforms and "cpu" not in platforms:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # option or backend absent
+        pass
 
 
 def make_mesh(num_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:

@@ -42,6 +42,29 @@ counters (``rollbacks`` / ``step_retries`` / ``ckpt_fallbacks`` /
 fault.  ``Trainer.fit(..., resilience=None)`` — the default — is
 byte-for-byte today's behavior: no supervisor, no extra host work, the
 original crash semantics.
+
+Multi-host (``jax.process_count() > 1``) supervision is COORDINATED
+(docs/RESILIENCE.md "Multi-host recovery").  Per-process recovery
+decisions could diverge replicas (one host resuming epoch 3 while its
+peer resumes epoch 4 deadlocks the next collective), so at every
+recovery decision point the hosts allgather a per-host outcome code
+(:func:`reduce_outcomes`: worst severity wins) and execute ONE agreed
+action — a NaN window on any host rolls every host back, and the
+restore target is the newest checkpoint EVERY host verifies (the
+coordinated walk in ``tpudp/utils/checkpoint.py`` votes per step dir,
+so the restore step is effectively the min over hosts' newest
+verified).  Step faults and hangs recover from the newest verified
+checkpoint rather than an emergency dump: the dump path is a collective
+save, and a host cannot unilaterally start a collective while its peer
+is wedged.  The vote itself is BOUNDED: a host whose peers never join
+(SIGKILLed worker, torn network) hard-exits with
+:data:`VOTE_TIMEOUT_EXIT` so the scheduler relaunches the pod into the
+coordinated resume path — mirroring the CLI watchdog's generation-
+tracked hard-exit backstop, which keeps covering hosts wedged INSIDE a
+device collective (those never reach a vote).  After any coordinated
+restore, all hosts must agree on the state fingerprint
+(``tpudp/utils/consistency.py``) before training resumes; divergence
+raises :class:`~tpudp.utils.consistency.ReplicaDivergenceError`.
 """
 
 from __future__ import annotations
@@ -53,6 +76,31 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from tpudp.utils.watchdog import StepHangError
+
+# Per-host outcome codes for the multi-host recovery vote, ordered by
+# severity: the allgathered codes reduce to their MAX (worst wins), so
+# e.g. a divergence on one host outranks a peer's clean completion and
+# every host executes the rollback.
+OUTCOME_OK = 0
+OUTCOME_STEP_FAULT = 1
+OUTCOME_HANG = 2
+OUTCOME_DIVERGENCE = 3
+
+OUTCOME_NAMES = {OUTCOME_OK: "ok", OUTCOME_STEP_FAULT: "step_fault",
+                 OUTCOME_HANG: "hang", OUTCOME_DIVERGENCE: "divergence"}
+
+# Exit code when a recovery vote times out or its collective fails (a
+# peer host is dead or wedged): the process exits for the scheduler,
+# exactly like the CLI watchdog's hard-exit backstop (which uses 42) —
+# distinct so the soak can attribute the exit to the vote path.
+VOTE_TIMEOUT_EXIT = 43
+
+
+def reduce_outcomes(codes) -> int:
+    """Deterministically reduce per-host outcome codes to ONE action:
+    worst severity wins.  Every host computes this over the same
+    allgathered vector, so all hosts execute the same recovery."""
+    return max(int(c) for c in codes)
 
 
 class LossSpikeError(RuntimeError):
@@ -92,7 +140,13 @@ class ResiliencePolicy:
     supervisor then never double-writes.  ``checkpoint_writer`` is the
     driver's AsyncCheckpointWriter if one is active: the supervisor calls
     ``wait()`` on it before any emergency dump so an overlapped epoch-end
-    write can never interleave with the dump in the same root."""
+    write can never interleave with the dump in the same root.
+
+    ``vote_timeout_s`` (multi-host only) bounds the wait at each recovery
+    vote: if no peer joins the allgather within it — the peer is dead,
+    not merely recovering — the process hard-exits with
+    :data:`VOTE_TIMEOUT_EXIT` so the scheduler relaunches the pod into
+    the coordinated resume path instead of hanging forever."""
 
     checkpoint_dir: str
     max_rollbacks: int = 3
@@ -104,6 +158,7 @@ class ResiliencePolicy:
     save_epoch_checkpoints: bool = True
     checkpoint_writer: Any = None
     on_event: Callable[[dict], None] | None = None
+    vote_timeout_s: float = 120.0
 
 
 def make_emergency_dump(checkpoint_dir: str, get_state,
@@ -138,17 +193,33 @@ def auto_resume(trainer, checkpoint_dir: str, per_epoch_batches: int,
     does — emergency dump preferred (then consumed), else the newest
     VERIFIED ``step_N`` — and return ``(start_epoch, skip_batches)``.
 
-    Single-host distillation of tpudp.cli's resume block for supervised
-    workers (the soak's relaunch loop, tests); position is derived from
-    the restored optimizer-step counter, so any restore point continues
-    the exact batch grid."""
-    from tpudp.utils.checkpoint import (consume_emergency, emergency_dir,
-                                        latest_step_dir, quarantine_emergency,
-                                        restore_checkpoint,
+    Distillation of tpudp.cli's resume block for supervised workers (the
+    soak's relaunch loop, tests); position is derived from the restored
+    optimizer-step counter, so any restore point continues the exact
+    batch grid.  Multi-host resume is COORDINATED: the verified walk
+    votes per step dir, and the emergency dump's accept/quarantine
+    decision is unanimous (``restore_emergency_voted``) so every host resumes the
+    same state — process 0 alone consumes/quarantines, behind a
+    barrier."""
+    import jax
+
+    from tpudp.utils.checkpoint import (consume_emergency, coordinated_any,
+                                        emergency_dir, latest_step_dir,
+                                        restore_emergency_voted,
                                         restore_latest_verified)
 
+    def _barrier(tag: str) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)
+
     restored = False
-    if latest_step_dir(checkpoint_dir):
+    # Entry into each collective restore protocol is itself a collective
+    # decision (coordinated_any): a per-host listing probe deciding entry
+    # would leave the host that sees a checkpoint alone inside an
+    # allgather its stale-listing peer never joins.
+    if coordinated_any(latest_step_dir(checkpoint_dir) is not None):
         state, path, skipped = restore_latest_verified(
             checkpoint_dir, trainer.state, log=log)
         trainer.state = state
@@ -161,19 +232,20 @@ def auto_resume(trainer, checkpoint_dir: str, per_epoch_batches: int,
             + (f" ({len(skipped)} newer checkpoint(s) skipped as corrupt)"
                if skipped else ""))
     emerg = emergency_dir(checkpoint_dir)
-    if emerg:
-        try:
-            trainer.state = restore_checkpoint(emerg, trainer.state,
-                                               verify=True)
+    if coordinated_any(emerg is not None):
+        if emerg is None:
+            # Stale listing on this host; the dump's location is fixed,
+            # and the voted restore below decides its fate for all.
+            emerg = os.path.join(checkpoint_dir, "emergency")
+        dump_state = restore_emergency_voted(checkpoint_dir, emerg,
+                                             trainer.state, log=log)
+        if dump_state is not None:
+            trainer.state = dump_state
             restored = True
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except Exception as e:
-            log(f"[tpudp] WARNING: emergency dump {emerg} failed "
-                f"verification ({e}); quarantined, using the step series")
-            quarantine_emergency(checkpoint_dir)
-        else:
-            consume_emergency(checkpoint_dir)
+            _barrier("tpudp_emergency_consume")  # all read before rank 0
+            # renames the directory out from under them
+            if jax.process_index() == 0:
+                consume_emergency(checkpoint_dir)
             log(f"[tpudp] resumed mid-epoch state from emergency dump {emerg}")
     if not restored:
         return 0, 0
@@ -194,14 +266,15 @@ class Supervisor:
             raise ValueError(
                 "ResiliencePolicy.checkpoint_dir is required: rollback and "
                 "step recovery restore from the step_N series under it")
-        if jax.process_count() > 1:
-            raise ValueError(
-                "resilience supervision is single-host for now: recovery "
-                "makes per-process restore/rollback/quarantine decisions, "
-                "and without a cross-host agreement protocol two hosts "
-                "could resume different epochs (docs/RESILIENCE.md)")
         self.trainer = trainer
         self.policy = policy
+        # Multi-host supervision runs the agreement protocol: every
+        # recovery decision is an allgathered vote reduced to one action
+        # (worst severity wins), every restore is the coordinated
+        # verified walk, and a vote nobody joins hard-exits for the
+        # scheduler (VOTE_TIMEOUT_EXIT).
+        self._multihost = jax.process_count() > 1
+        self._vote_seq = 0
         trainer.stats.update(rollbacks=0, step_retries=0, ckpt_fallbacks=0,
                              loader_restarts=0, events=[])
         self._window_losses: deque[float] = deque(maxlen=policy.spike_window)
@@ -395,6 +468,148 @@ class Supervisor:
               f"{epoch}, {skip} batches in")
         return epoch, skip
 
+    # -- multi-host agreement protocol ---------------------------------
+    def _vote(self, code: int) -> int:
+        """One round of the agreement protocol: allgather this host's
+        outcome ``code`` (plus a protocol sequence number) and reduce to
+        the worst severity — the ONE action every host executes.
+
+        The wait is BOUNDED by ``policy.vote_timeout_s``: a peer that
+        never joins (SIGKILLed worker) or a collective that errors out
+        (torn TCP to a dead peer) means in-process recovery is
+        impossible, and the host hard-exits with
+        :data:`VOTE_TIMEOUT_EXIT` so the scheduler relaunches the pod
+        into the coordinated resume path — the vote-layer mirror of the
+        CLI watchdog's hard-exit backstop, which keeps covering hosts
+        wedged inside a DEVICE collective (those never reach a vote)."""
+        if not self._multihost:
+            return code
+        import threading
+
+        self._vote_seq += 1
+        seq, result = self._vote_seq, {}
+
+        def gather() -> None:
+            try:
+                import jax.numpy as jnp
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                flags = np.asarray(multihost_utils.process_allgather(
+                    jnp.asarray([code, seq], jnp.int32)))
+                result["codes"] = [int(c) for c in flags[:, 0]]
+                result["seqs"] = [int(s) for s in flags[:, 1]]
+            except BaseException as e:  # gloo/XLA surface various types
+                result["error"] = e
+
+        th = threading.Thread(target=gather, daemon=True,
+                              name="tpudp-recovery-vote")
+        th.start()
+        th.join(self.policy.vote_timeout_s)
+        if "codes" not in result:
+            why = (f"vote collective failed: {result['error']!r}"
+                   if "error" in result else
+                   f"no peer joined within {self.policy.vote_timeout_s}s")
+            self.record("vote_timeout", outcome=OUTCOME_NAMES.get(code),
+                        seq=seq, reason=why)
+            self.trainer.log(
+                f"[tpudp] resilience: recovery vote {seq} got no answer "
+                f"({why}); peer host dead or wedged — hard-exiting for "
+                f"scheduler relaunch (exit {VOTE_TIMEOUT_EXIT})")
+            os._exit(VOTE_TIMEOUT_EXIT)
+        if any(s != seq for s in result["seqs"]):
+            # Hosts disagree about WHICH decision this is — the protocol
+            # itself desynced (e.g. one host recovered locally where
+            # another voted).  Continuing would pair future votes with
+            # the wrong decisions; relaunching resumes coordinated.
+            self.record("vote_desync", seq=seq, seqs=result["seqs"])
+            self.trainer.log(
+                f"[tpudp] resilience: vote sequence desync (local {seq}, "
+                f"peers {result['seqs']}); hard-exiting for scheduler "
+                f"relaunch (exit {VOTE_TIMEOUT_EXIT})")
+            os._exit(VOTE_TIMEOUT_EXIT)
+        worst = reduce_outcomes(result["codes"])
+        self.record("vote", seq=seq, outcome=OUTCOME_NAMES.get(code),
+                    worst=OUTCOME_NAMES.get(worst), codes=result["codes"])
+        return worst
+
+    def _assert_replicas_agree(self) -> None:
+        """Post-restore assertion (multi-host): every host must agree on
+        the restored state's fingerprint BEFORE training resumes —
+        replicas that restored different bytes would train a model that
+        belongs to nobody and deadlock or silently desync the next
+        collectives.  Raises ReplicaDivergenceError (typed, from
+        tpudp/utils/consistency.py); single-host is a no-op."""
+        if not self._multihost:
+            return
+        from tpudp.utils.consistency import verify_across_processes
+
+        verify_across_processes({"state": self.trainer.state})
+
+    def _coordinated_recover(self, worst: int,
+                             e: BaseException | None) -> tuple[int, int]:
+        """Execute the voted recovery action on EVERY host: restore the
+        newest checkpoint all hosts verify (the coordinated walk) and
+        replay.  ``e`` is this host's local error (None on a host that
+        voted OK and merely learned of a peer's fault).  Same budgets and
+        escalation semantics as the single-host paths — the counters
+        advance in lockstep on all hosts (every host executes every
+        coordinated recovery), so escalation fires on all hosts in the
+        same round."""
+        t, stats = self.trainer, self.trainer.stats
+        original = e if e is not None else RuntimeError(
+            "a peer host faulted; this host joined the coordinated "
+            "recovery")
+        if worst == OUTCOME_DIVERGENCE:
+            if stats["rollbacks"] >= self.policy.max_rollbacks:
+                self.record("rollback_escalation", error=repr(original),
+                            rollbacks=stats["rollbacks"])
+                t.log(f"[tpudp] resilience: rollback budget "
+                      f"({self.policy.max_rollbacks}) exhausted; escalating")
+                raise original
+            stats["rollbacks"] += 1
+        else:
+            try:
+                failed_step = int(t.state.step)
+            except Exception:
+                failed_step = None  # donated/invalid buffers
+            if (failed_step is not None
+                    and failed_step == self._last_failed_step):
+                self._consecutive_at_step += 1
+            else:
+                self._consecutive_at_step = 1
+            self._last_failed_step = failed_step
+            if self._consecutive_at_step > self.policy.max_step_retries:
+                self.record("step_escalation", error=repr(original),
+                            step=failed_step,
+                            consecutive=self._consecutive_at_step)
+                t.log(f"[tpudp] resilience: step {failed_step} failed "
+                      f"{self._consecutive_at_step} consecutive times; "
+                      "escalating")
+                raise original
+            stats["step_retries"] += 1
+        path = self._restore_verified()
+        self._window_losses.clear()
+        if t.watchdog is not None:
+            t.watchdog.arm()
+        self._assert_replicas_agree()
+        epoch, skip = self._resume_position()
+        if worst == OUTCOME_DIVERGENCE:
+            self.record("rollback", error=repr(original), restored=path,
+                        step=int(t.state.step), epoch=epoch, skip=skip,
+                        coordinated=True)
+        else:
+            self.record("step_retry", error=repr(original),
+                        step=self._last_failed_step,
+                        hang=worst == OUTCOME_HANG, restored=path,
+                        epoch=epoch, skip=skip, coordinated=True)
+        t.log(f"[tpudp] resilience: coordinated "
+              f"{OUTCOME_NAMES.get(worst)} recovery "
+              f"({type(original).__name__}: {original}); all hosts "
+              f"restored {path} (epoch {epoch}, {skip} batches in) and "
+              "replaying")
+        return epoch, skip
+
     # -- the supervision loop ------------------------------------------
     def _ensure_initial_checkpoint(self, start_epoch: int,
                                    skip_first: int) -> None:
@@ -402,10 +617,16 @@ class Supervisor:
         checkpoint lands: save ``step_<start_epoch>`` of the initial state
         if the series is empty.  Skipped on a mid-epoch resume (the state
         would not be an epoch boundary, and the step_N series' name
-        contract is 'state after epoch N')."""
-        from tpudp.utils.checkpoint import latest_step_dir, save_checkpoint
+        contract is 'state after epoch N').  The is-the-series-empty
+        probe is COORDINATED: a multi-host save is collective, so one
+        host deciding to save off a stale listing while its peer skips
+        would park it alone in the commit barrier."""
+        from tpudp.utils.checkpoint import (coordinated_any,
+                                            latest_step_dir,
+                                            save_checkpoint)
 
-        if skip_first or latest_step_dir(self.policy.checkpoint_dir):
+        if skip_first or coordinated_any(
+                latest_step_dir(self.policy.checkpoint_dir) is not None):
             return
         path = os.path.join(self.policy.checkpoint_dir,
                             f"step_{start_epoch}")
@@ -456,15 +677,37 @@ class Supervisor:
                         epoch_end(missed)
                     t._fit(train_loader, test_loader, epochs, cur_start,
                            epoch_end, cur_skip)
+                    if self._multihost:
+                        # Completion vote: a host that finished cleanly
+                        # parks here, so a peer faulting in the final
+                        # stretch finds a vote partner instead of a
+                        # departed process — and if the vote carries a
+                        # fault, this host joins the coordinated
+                        # recovery and replays alongside its peers.
+                        worst = self._vote(OUTCOME_OK)
+                        if worst != OUTCOME_OK:
+                            cur_start, cur_skip = \
+                                self._coordinated_recover(worst, None)
+                            continue
                     return
                 except ResilienceExhausted as e:
                     raise e.original from e
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except (FloatingPointError, LossSpikeError) as e:
-                    cur_start, cur_skip = self._rollback(e)
+                    if self._multihost:
+                        cur_start, cur_skip = self._coordinated_recover(
+                            self._vote(OUTCOME_DIVERGENCE), e)
+                    else:
+                        cur_start, cur_skip = self._rollback(e)
                 except Exception as e:
-                    cur_start, cur_skip = self._step_recover(e)
+                    if self._multihost:
+                        code = (OUTCOME_HANG if isinstance(e, StepHangError)
+                                else OUTCOME_STEP_FAULT)
+                        cur_start, cur_skip = self._coordinated_recover(
+                            self._vote(code), e)
+                    else:
+                        cur_start, cur_skip = self._step_recover(e)
         finally:
             t._resilience = None
             if t.watchdog is not None:

@@ -181,6 +181,14 @@ def corrupt_checkpoint(path: str | os.PathLike, mode: str = "flip") -> str:
       file (silent bit rot: orbax may restore cleanly, the manifest
       checksum catches it; or orbax's own framing fails — either way the
       verified-restore fallback must engage)
+    * ``"flip_shard"`` — same flip, but targeted at the largest file
+      under the checkpoint's ``d/`` subtree — the OCDBT payload domain
+      where a MULTI-HOST save's shard bytes live (the largest file
+      overall in that layout is often process metadata whose flip orbax
+      shrugs off).  This is "one host's shard rotted": the per-host
+      crc32 shard manifests must catch it, on the saved geometry and on
+      the reassembled view after an elastic restore.  Falls back to the
+      plain flip when no ``d/`` subtree exists (single-host layouts).
     * ``"truncate"`` — cuts the largest file in half (torn write)
     * ``"manifest"`` — tampers a checksum in the sidecar manifest (the
       paranoid case: manifest and data disagree)
@@ -204,15 +212,18 @@ def corrupt_checkpoint(path: str | os.PathLike, mode: str = "flip") -> str:
         with open(mpath, "w") as f:
             json.dump(manifest, f)
         return mpath
-    if mode not in ("flip", "truncate"):
+    if mode not in ("flip", "flip_shard", "truncate"):
         raise ValueError(f"unknown corruption mode {mode!r}")
+    walk_root = path
+    if mode == "flip_shard" and os.path.isdir(os.path.join(path, "d")):
+        walk_root = os.path.join(path, "d")
     files = []
-    for dirpath, _dirs, names in os.walk(path):
+    for dirpath, _dirs, names in os.walk(walk_root):
         for name in names:
             p = os.path.join(dirpath, name)
             files.append((os.path.getsize(p), p))
     if not files:
-        raise ValueError(f"no files under checkpoint dir {path}")
+        raise ValueError(f"no files under checkpoint dir {walk_root}")
     _, target = max(files)  # largest file = the biggest leaf's payload
     size = os.path.getsize(target)
     if mode == "truncate":

@@ -14,6 +14,22 @@ verify=True)`` recomputes the checksums on the restored arrays and raises
 :func:`restore_latest_verified` walks the ``step_N`` series newest→oldest
 so a torn or bit-flipped newest checkpoint falls back to the previous
 intact one instead of crash-looping every resume.
+
+Multi-host (``jax.process_count() > 1``) saves are TWO-PHASE: each host
+writes a per-host shard manifest (``<path>.manifest.host<K>.json`` —
+crc32 over its unique addressable shard bytes, keyed by the shard's
+global slice, closing the old "skipped: not fully addressable" hole and
+covering ZeRO-1's sharded optimizer state), then an allgather barrier
+confirms every host's manifest is durable before process 0 writes the
+``<path>.COMMITTED`` marker.  A torn multi-host save is therefore
+DETECTABLE: ``restore_latest_verified`` refuses any step dir without its
+marker, and the multi-host walk is COORDINATED — hosts vote (allgather)
+on the restore step so every replica restores the SAME checkpoint (min
+over hosts' newest verified; a dir any host rejects is quarantined for
+all).  Shard records verify elastically: a checkpoint saved at N hosts
+re-verifies at M hosts by checking every recorded global slice that is
+addressable on the current topology (the reassembled view covers all of
+them when the pod shrinks).
 """
 
 from __future__ import annotations
@@ -51,11 +67,120 @@ def manifest_path(path: str | os.PathLike) -> str:
     return os.path.abspath(os.fspath(path)) + ".manifest.json"
 
 
+def host_manifest_path(path: str | os.PathLike, host: int) -> str:
+    """The per-host shard manifest for multi-host saves (one writer per
+    file — host ``K`` checksums only the shard bytes it addressed)."""
+    return os.path.abspath(os.fspath(path)) + f".manifest.host{host}.json"
+
+
+def host_manifest_paths(path: str | os.PathLike) -> list[str]:
+    """Every per-host shard manifest present beside ``path`` (sorted by
+    host so verification order is deterministic)."""
+    import glob
+    import re
+
+    base = os.path.abspath(os.fspath(path))
+    found = glob.glob(base + ".manifest.host*.json")
+    pat = re.compile(re.escape(base) + r"\.manifest\.host(\d+)\.json$")
+    with_rank = [(int(m.group(1)), p) for p in found if (m := pat.match(p))]
+    return [p for _, p in sorted(with_rank)]
+
+
+def commit_marker_path(path: str | os.PathLike) -> str:
+    """The two-phase-commit marker for multi-host saves: written by
+    process 0 only after an allgather confirmed every host's shards and
+    manifest are durable, so a torn multi-host save (one host died
+    mid-write) is detectable by the marker's absence."""
+    return os.path.abspath(os.fspath(path)) + ".COMMITTED"
+
+
+def is_committed(path: str | os.PathLike) -> bool:
+    return os.path.exists(commit_marker_path(path))
+
+
+def all_hosts_ok(ok: bool, value: int = 0) -> bool:
+    """Cross-host unanimity vote on a local boolean: True only if EVERY
+    process passed ``ok=True`` AND every process passed the same
+    ``value`` (e.g. the step number of the dir being voted on, so hosts
+    whose directory listings diverged — one already sees a new save the
+    other does not — reject instead of restoring different states).
+    The primitive behind every replica-consistent restore decision — a
+    checkpoint one host rejects must be rejected by all, or replicas
+    resume from different states.  On a single process this is the
+    identity (no collective dispatched)."""
+    if jax.process_count() == 1:
+        return ok
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([1 if ok else 0, int(value)], jnp.int32)))
+    return bool(flags[:, 0].min() == 1
+                and (flags[:, 1] == flags[0, 1]).all())
+
+
+def gather_host_values(value: int) -> list[int]:
+    """Allgather one integer per host, in rank order (identity list on a
+    single process — no collective dispatched).  The alignment primitive
+    for decisions that need to SEE every host's value rather than just
+    unanimity — e.g. the verified walk aligning to the newest step every
+    host can see."""
+    if jax.process_count() == 1:
+        return [int(value)]
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([int(value)], jnp.int32)))
+    return [int(v) for v in flags[:, 0]]
+
+
+def coordinated_any(flag: bool) -> bool:
+    """True if ANY host passed True (identity on a single process — no
+    collective dispatched).  The entry-gate primitive: whether to enter
+    a collective restore/save protocol must itself be a collective
+    decision — a per-host filesystem probe (stale shared-FS listing)
+    deciding entry would leave one host inside an allgather its peer
+    never joins, or one host alone inside a collective save barrier."""
+    if jax.process_count() == 1:
+        return flag
+    return max(gather_host_values(1 if flag else 0)) == 1
+
+
+def invalidate_commit(path: str | os.PathLike) -> None:
+    """Remove a previous save's COMMITTED marker and per-host shard
+    manifests BEFORE a multi-host save rewrites ``path`` (``force=True``
+    overwrite, or a shrunken pod re-saving the same step name): a stale
+    marker would make the new, not-yet-barriered save look committed,
+    and a stale ``manifest.host<K>.json`` from a host that no longer
+    exists would fail verification against the new bytes forever.
+    Process 0 only; callers barrier after."""
+    if jax.process_index() != 0:
+        return
+    for p in [commit_marker_path(path)] + host_manifest_paths(path):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def _sidecar_paths(path: str | os.PathLike) -> list[str]:
+    """Every integrity sidecar beside the checkpoint dir at ``path``:
+    the plain manifest, all per-host shard manifests, and the commit
+    marker — the set that must travel with the dir on quarantine and die
+    with it on prune."""
+    return ([manifest_path(path), commit_marker_path(path)]
+            + host_manifest_paths(path))
+
+
 def leaf_checksums(state: Any) -> dict:
     """Per-leaf crc32/dtype/shape over the pytree, keyed by
     ``jax.tree_util.keystr`` path.  Leaves that are not fully addressable
     on this process (multi-host shards) are recorded as skipped — a
-    checksum over a partial host view would be topology-dependent."""
+    checksum over a partial host view would be topology-dependent; the
+    per-host shard manifests (:func:`leaf_shard_checksums`) cover them."""
     import zlib
 
     import numpy as np
@@ -72,17 +197,117 @@ def leaf_checksums(state: Any) -> dict:
     return out
 
 
+def _index_spans(index, gshape) -> list[list[int]]:
+    """A shard's global slice as ``[[start, stop], ...]`` (JSON-stable;
+    ``slice(None)`` normalized to the full dimension)."""
+    spans = []
+    for sl, dim in zip(index, gshape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        spans.append([start, stop])
+    return spans
+
+
+def _unique_addressable_shards(leaf):
+    """This host's addressable shards deduped by global index (replicas
+    of the same slice on several local devices checksum once)."""
+    seen = {}
+    for s in leaf.addressable_shards:
+        key = str(s.index)
+        if key not in seen:
+            seen[key] = s
+    return [seen[k] for k in sorted(seen)]
+
+
+def leaf_shard_checksums(state: Any) -> dict:
+    """Per-leaf records of THIS host's unique addressable shard bytes —
+    the multi-host manifest payload.  Each record carries the shard's
+    GLOBAL slice, so verification is topology-portable: any later
+    process that can address that slice (same geometry, or the
+    reassembled view after an elastic restore) can recompute the crc32.
+
+    Leaves whose full value is identical on every host (fully
+    addressable, or fully REPLICATED over the mesh) are recorded by
+    process 0 only: every host writing the same whole-array record would
+    make every later restore recompute the full model's checksums once
+    per host manifest.  Genuinely sharded leaves are recorded by every
+    host — each holds different slices."""
+    import zlib
+
+    import numpy as np
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = jax.tree_util.keystr(path)
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            if (getattr(leaf, "is_fully_replicated", False)
+                    and jax.process_index() != 0):
+                continue  # identical full-span record on every host
+            shards = []
+            for s in _unique_addressable_shards(leaf):
+                arr = np.asarray(s.data)
+                shards.append(
+                    {"index": _index_spans(s.index, leaf.shape),
+                     "crc32": zlib.crc32(arr.tobytes()) & 0xFFFFFFFF})
+            out[key] = {"dtype": str(leaf.dtype),
+                        "gshape": list(leaf.shape), "shards": shards}
+            continue
+        if jax.process_index() != 0:
+            continue  # fully addressable: same bytes on every host
+        arr = np.asarray(leaf)
+        out[key] = {"dtype": str(arr.dtype), "gshape": list(arr.shape),
+                    "shards": [{"index": [[0, d] for d in arr.shape],
+                                "crc32": zlib.crc32(arr.tobytes())
+                                & 0xFFFFFFFF}]}
+    return out
+
+
 def write_manifest(path: str | os.PathLike, state: Any) -> str:
-    """Write the per-leaf checksum manifest for the checkpoint at ``path``
-    (process 0 only on multi-host — one writer per file)."""
+    """Write the integrity manifest for the checkpoint at ``path``.
+
+    Single-host: the per-leaf whole-array manifest (``.manifest.json``),
+    unchanged semantics.  Multi-host: EVERY host writes its own shard
+    manifest (``.manifest.host<K>.json``, fsync'd — the commit barrier in
+    :func:`save_checkpoint` keys off its durability); no plain manifest
+    is written, the per-host set plus the COMMITTED marker replace it."""
     import json
 
+    if jax.process_count() > 1:
+        hpath = host_manifest_path(path, jax.process_index())
+        with open(hpath, "w") as f:
+            json.dump({"format": 2, "host": jax.process_index(),
+                       "nprocs": jax.process_count(),
+                       "leaves": leaf_shard_checksums(state)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        return hpath
     mpath = manifest_path(path)
-    if jax.process_index() != 0:
-        return mpath
     with open(mpath, "w") as f:
         json.dump({"format": 1, "leaves": leaf_checksums(state)}, f)
     return mpath
+
+
+def commit_after_all_hosts(path: str | os.PathLike) -> None:
+    """Phase 2 of the multi-host save: barrier until every host's save +
+    manifest write returned, then process 0 alone writes the COMMITTED
+    marker.  Until the marker exists the step dir is not part of the
+    verified series — a host dying mid-save leaves a detectably torn
+    checkpoint instead of a silently short one."""
+    import json
+    import time
+
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(
+        f"tpudp_ckpt_commit:{os.path.basename(os.fspath(path))}")
+    if jax.process_index() != 0:
+        return
+    with open(commit_marker_path(path), "w") as f:
+        json.dump({"nprocs": jax.process_count(),
+                   "committed_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime())}, f)
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def read_manifest(path: str | os.PathLike) -> dict | None:
@@ -97,25 +322,111 @@ def read_manifest(path: str | os.PathLike) -> dict | None:
         return None
 
 
+def _crc_of_slice(leaf, spans) -> int | None:
+    """crc32 of the global slice ``spans`` of restored leaf ``leaf`` if
+    that slice is addressable on this host, else None (another host's
+    shard under the current topology — someone else verifies it)."""
+    import zlib
+
+    import numpy as np
+
+    want = tuple(slice(s, e) for s, e in spans)
+    if not isinstance(leaf, jax.Array) or leaf.is_fully_addressable:
+        arr = np.asarray(leaf)
+        data = arr[want] if want else arr
+        return zlib.crc32(np.ascontiguousarray(data).tobytes()) & 0xFFFFFFFF
+    for s in leaf.addressable_shards:
+        have = _index_spans(s.index, leaf.shape)
+        if all(hs <= ws and we <= he
+               for (hs, he), (ws, we) in zip(have, spans)):
+            local = np.asarray(s.data)
+            rel = tuple(slice(ws - hs, we - hs)
+                        for (hs, _), (ws, we) in zip(have, spans))
+            data = local[rel] if rel else local
+            return (zlib.crc32(np.ascontiguousarray(data).tobytes())
+                    & 0xFFFFFFFF)
+    return None
+
+
+def verify_restored_coverage(path: str | os.PathLike,
+                             state: Any) -> tuple[bool, str, list[bool]]:
+    """Compare ``state`` (a freshly restored pytree) against the
+    manifest(s) written when ``path`` was saved.  Returns ``(ok, detail,
+    coverage)`` where ``coverage`` has one flag per shard record — in a
+    DETERMINISTIC order (payloads in read order, leaves in file order,
+    shards in record order), identical on every host because every host
+    reads the same manifest files — saying whether THIS host could
+    address and therefore check that record.  A checkpoint with no
+    manifest of any kind verifies vacuously (legacy checkpoints carry
+    none).
+
+    Verification is topology-portable: whole-array records (single-host
+    manifests) and per-shard records (multi-host host manifests) are both
+    checked for every global slice this host can address on the CURRENT
+    mesh — on an elastic restore at fewer hosts the reassembled view
+    covers every recorded shard, so a byte flipped in any save-time
+    host's shard is still caught.  On a GROWN or resharded topology a
+    record may be addressable on no single host; the coordinated walk
+    unions the per-host coverage and rejects a dir whose records nobody
+    checked (a silent 'verified' there would cover nothing)."""
+    import json
+
+    coverage: list[bool] = []
+    payloads = []
+    plain = read_manifest(path)
+    if plain is not None:
+        payloads.append(plain)
+    for hpath in host_manifest_paths(path):
+        try:
+            with open(hpath) as f:
+                payloads.append(json.load(f))
+        except (json.JSONDecodeError, OSError):
+            return (False,
+                    f"unreadable host manifest {os.path.basename(hpath)}",
+                    coverage)
+    if not payloads:
+        return True, "no manifest (unverified legacy checkpoint)", coverage
+
+    have = {jax.tree_util.keystr(p): leaf for p, leaf
+            in jax.tree_util.tree_flatten_with_path(state)[0]}
+    checked = 0
+    for payload in payloads:
+        host = payload.get("host")
+        for key, rec in payload.get("leaves", {}).items():
+            if key not in have:
+                return False, f"leaf {key} missing from restored tree", \
+                    coverage
+            leaf = have[key]
+            if "shards" in rec:
+                records = [(s["index"], s["crc32"]) for s in rec["shards"]]
+            elif "crc32" in rec:
+                # format-1 whole-array record
+                shape = rec.get("shape", [])
+                records = [([[0, d] for d in shape], rec["crc32"])]
+            else:
+                continue  # recorded as skipped by a pre-shard-manifest save
+            for spans, want_crc in records:
+                got = _crc_of_slice(leaf, spans)
+                if got is None:
+                    # not addressable here; a peer must cover it
+                    coverage.append(False)
+                    continue
+                coverage.append(True)
+                checked += 1
+                if got != want_crc:
+                    where = f" (host {host} shard)" if host is not None else ""
+                    return (False,
+                            f"leaf {key}{where} checksum mismatch "
+                            f"(saved {want_crc}, restored {got})", coverage)
+    return True, f"{checked} shard checksums verified", coverage
+
+
 def verify_restored(path: str | os.PathLike, state: Any) -> tuple[bool, str]:
-    """Compare ``state`` (a freshly restored pytree) against the manifest
-    written when ``path`` was saved.  Returns ``(ok, detail)``; a missing
-    manifest verifies vacuously (legacy checkpoints carry none)."""
-    manifest = read_manifest(path)
-    if manifest is None:
-        return True, "no manifest (unverified legacy checkpoint)"
-    want = manifest.get("leaves", {})
-    have = leaf_checksums(state)
-    for key, rec in want.items():
-        if "crc32" not in rec:
-            continue  # skipped at save time (non-addressable leaf)
-        got = have.get(key)
-        if got is None:
-            return False, f"leaf {key} missing from restored tree"
-        if got.get("crc32") != rec["crc32"]:
-            return False, (f"leaf {key} checksum mismatch "
-                           f"(saved {rec['crc32']}, restored {got.get('crc32')})")
-    return True, f"{len(want)} leaves verified"
+    """:func:`verify_restored_coverage` without the coverage vector —
+    the single-host verification entry point (one host's fully
+    addressable view covers every record, so coverage is vacuous)."""
+    ok, detail, _coverage = verify_restored_coverage(path, state)
+    return ok, detail
 
 
 def save_checkpoint(path: str | os.PathLike, state: Any, *,
@@ -125,13 +436,37 @@ def save_checkpoint(path: str | os.PathLike, state: Any, *,
     ``manifest=True`` (default) also writes the per-leaf checksum manifest
     beside the directory, making this checkpoint verifiable by
     ``restore_checkpoint(..., verify=True)`` and eligible as a fallback
-    target for :func:`restore_latest_verified`."""
+    target for :func:`restore_latest_verified`.
+
+    Multi-host: the save is collective (every process writes its
+    addressable shards) and TWO-PHASE — each host writes its shard
+    manifest, then :func:`commit_after_all_hosts` barriers and process 0
+    writes the COMMITTED marker.  A host dying anywhere before the
+    barrier leaves a marker-less (torn, detectable) dir."""
     if not HAVE_ORBAX:
         raise RuntimeError("orbax-checkpoint is not installed")
     path = os.path.abspath(os.fspath(path))
+    multihost = jax.process_count() > 1
+    if multihost:
+        # Stale sidecars from a previous save under this name must die
+        # BEFORE orbax starts writing (a leftover marker would make the
+        # new save look committed while hosts are still mid-write).
+        from jax.experimental import multihost_utils
+
+        invalidate_commit(path)
+        multihost_utils.sync_global_devices(
+            f"tpudp_ckpt_invalidate:{os.path.basename(path)}")
+    else:
+        # Single-host saves must ALSO clear stale multi-host sidecars
+        # under this name (a shrunken pod re-saving a step a larger pod
+        # once wrote): a leftover host manifest would be verified
+        # against the new bytes and reject the fresh save forever.
+        invalidate_commit(path)
     _checkpointer().save(path, state, force=force)
     if manifest:
         write_manifest(path, state)
+        if multihost:
+            commit_after_all_hosts(path)
     return path
 
 
@@ -195,25 +530,31 @@ def step_dirs_newest_first(root: str | os.PathLike) -> list[str]:
 
 
 def quarantine_step_dir(path: str) -> None:
-    """Move a rejected ``step_N`` dir (and its manifest) aside to
-    ``step_N.corrupt``, removing it from the step series: later walks must
-    not re-count the same corruption, ``latest_step_dir``/pruning must not
-    treat it as live state, and the bytes stay for forensics.  Rename
-    races (multi-host: every process walks the series) are tolerated —
-    whichever rename wins, the dir leaves the series."""
+    """Move a rejected ``step_N`` dir (and every sidecar: manifest,
+    per-host shard manifests, COMMITTED marker) aside to ``step_N.corrupt``,
+    removing it from the step series: later walks must not re-count the
+    same corruption, ``latest_step_dir``/pruning must not treat it as live
+    state, and the bytes stay for forensics.  The COMMITTED marker MUST
+    leave with the dir — a marker left behind would make a later save
+    under the same step name look committed before its barrier ran.
+    Rename races (multi-host: every process walks the series) are
+    tolerated — whichever rename wins, the dir leaves the series."""
     import shutil
 
-    target = path + ".corrupt"
+    base = os.path.abspath(os.fspath(path))
+    target = base + ".corrupt"
+    sidecars = _sidecar_paths(base)  # enumerate BEFORE the dir rename
     try:
         if os.path.isdir(target):
             shutil.rmtree(target)
-        os.rename(path, target)
+        os.rename(base, target)
     except OSError:
         return
-    try:
-        os.replace(manifest_path(path), manifest_path(target))
-    except OSError:
-        pass
+    for src in sidecars:
+        try:
+            os.replace(src, target + src[len(base):])
+        except OSError:
+            pass
 
 
 def restore_latest_verified(root: str | os.PathLike, target: Any, *,
@@ -230,28 +571,181 @@ def restore_latest_verified(root: str | os.PathLike, target: Any, *,
     where ``skipped`` lists ``(path, reason)`` for every rejected newer
     checkpoint.  Raises FileNotFoundError if no step dirs exist and
     RuntimeError if none of them is restorable."""
-    dirs = step_dirs_newest_first(root)
-    if not dirs:
+    multihost = jax.process_count() > 1
+    pending = step_dirs_newest_first(root)
+    if not pending and not multihost:
         raise FileNotFoundError(f"no step_N checkpoints under {os.fspath(root)!r}")
+
+    def _barrier(tag: str) -> None:
+        if multihost:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)
+
+    def _step_of(path: str) -> int:
+        return int(os.path.basename(path).rsplit("_", 1)[1])
+
     skipped: list[tuple[str, str]] = []
-    for path in dirs:
-        try:
-            state = restore_checkpoint(path, target, verify=True)
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except Exception as e:
-            reason = f"{type(e).__name__}: {e}"
-            skipped.append((path, reason))
-            log(f"[tpudp] WARNING: checkpoint {path} unrestorable "
-                f"({reason}); quarantining it and falling back to the "
-                "previous step dir")
+    first_round = True
+    while pending or multihost:
+        if multihost:
+            # Alignment round: every host proposes its newest remaining
+            # step (-1 when exhausted) and all align to the MIN — the
+            # newest step EVERY host can see.  Directory listings can
+            # diverge (shared-FS attribute-cache lag, a save landing
+            # between two hosts' scans); a dir a peer cannot see is
+            # skipped WITHOUT quarantine (it may be perfectly healthy —
+            # the peer's listing is stale, not the bytes), and because
+            # exhaustion is itself a proposal, one host running out
+            # aborts ALL hosts together instead of leaving peers parked
+            # in a collective nobody else will join.
+            while True:
+                head = _step_of(pending[0]) if pending else -1
+                proposals = gather_host_values(head)
+                aligned = min(proposals)
+                if aligned < 0:
+                    if first_round and max(proposals) < 0:
+                        raise FileNotFoundError(
+                            f"no step_N checkpoints under "
+                            f"{os.fspath(root)!r} (on any host)")
+                    raise RuntimeError(
+                        f"no step_N checkpoint under {os.fspath(root)!r} "
+                        f"is restorable on every host ({len(skipped)} "
+                        "tried/skipped locally; a peer exhausted its "
+                        "series); refusing to silently restart from "
+                        "scratch — remove the directory to train fresh")
+                if head == aligned and all(p == aligned for p in proposals):
+                    break
+                while pending and _step_of(pending[0]) > aligned:
+                    unseen = pending.pop(0)
+                    skipped.append((unseen, "not visible on every host "
+                                    "(divergent step listing); skipped "
+                                    "without quarantine"))
+                    log(f"[tpudp] WARNING: checkpoint {unseen} is not "
+                        "visible on every host (divergent step listing); "
+                        "skipping it WITHOUT quarantine and falling back "
+                        "to the newest step all hosts can see")
+            first_round = False
+        path = pending[0]
+        step_no = _step_of(path)
+        # Phase 1 — cheap symmetric pre-check, VOTED before any host
+        # enters the collective restore: a dir saved multi-host (it has
+        # per-host shard manifests) without its COMMITTED marker is a
+        # torn two-phase commit; alignment above pinned the step number,
+        # so no host ends up alone inside orbax's collective
+        # deserialization.
+        reason = None
+        if host_manifest_paths(path) and not is_committed(path):
+            reason = "uncommitted multi-host save (torn two-phase commit)"
+        if not all_hosts_ok(reason is None, step_no):
+            reason = reason or ("rejected by a peer host's vote "
+                                "(torn commit on another host)")
+        else:
+            # Phase 2 — collective restore + local shard verification,
+            # then a second vote: a byte flipped in ONE host's shard is
+            # seen by that host alone, and must reject the dir for all.
+            state, coverage = None, None
+            try:
+                if multihost:
+                    state = restore_checkpoint(path, target, verify=False)
+                    ok, detail, coverage = verify_restored_coverage(
+                        path, state)
+                    if not ok:
+                        raise CheckpointCorruptError(
+                            f"checkpoint {path} corrupt: {detail}")
+                else:
+                    state = restore_checkpoint(path, target, verify=True)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                reason = f"{type(e).__name__}: {e}"
+            if all_hosts_ok(reason is None, step_no):
+                uncovered = (_coverage_union_uncovered(coverage)
+                             if multihost and coverage else 0)
+                if not uncovered:
+                    return state, path, skipped
+                # Every host verified fine LOCALLY, but some records were
+                # addressable on no host — a grown/resharded topology
+                # cannot re-verify those bytes, and accepting them would
+                # report 'verified' while covering nothing.  The bytes
+                # are not (known) corrupt, so skip WITHOUT quarantine.
+                skipped.append((path, f"{uncovered} shard record(s) "
+                                "addressable on no host (grown/resharded "
+                                "topology cannot re-verify them); skipped "
+                                "without quarantine"))
+                log(f"[tpudp] WARNING: checkpoint {path} has {uncovered} "
+                    "shard record(s) this topology cannot re-verify "
+                    "(grown/resharded pod); refusing to restore it "
+                    "UNVERIFIED — skipping without quarantine.  Restore "
+                    "once at a geometry that covers the saved shards to "
+                    "re-checkpoint for this one.")
+                pending.pop(0)
+                continue
+            reason = reason or ("rejected by a peer host's vote "
+                                "(corrupt shard on another host)")
+        skipped.append((path, reason))
+        log(f"[tpudp] WARNING: checkpoint {path} unrestorable "
+            f"({reason}); quarantining it and falling back to the "
+            "previous step dir")
+        if not multihost or jax.process_index() == 0:
             quarantine_step_dir(path)
-            continue
-        return state, path, skipped
+        # No host may probe the next dir while the rename is in flight.
+        # The tag is keyed by the ALIGNED step number, identical on every
+        # host by construction.
+        _barrier(f"tpudp_ckpt_quarantine:step_{step_no}")
+        pending.pop(0)
     raise RuntimeError(
         f"every step_N checkpoint under {os.fspath(root)!r} is corrupt or "
         f"torn ({len(skipped)} tried); refusing to silently restart from "
         "scratch — remove the directory to train fresh")
+
+
+def _coverage_union_uncovered(coverage: list[bool]) -> int:
+    """COLLECTIVE: allgather the per-host record-coverage flags from
+    :func:`verify_restored_coverage` (same length on every host — all
+    read the same manifest files) and return how many records NO host
+    could address/check.  Zero means the union of host views re-verified
+    every saved shard byte."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = jnp.asarray([1 if c else 0 for c in coverage], jnp.int32)
+    allc = np.asarray(multihost_utils.process_allgather(local))
+    return int((allc.max(axis=0) == 0).sum())
+
+
+def restore_emergency_voted(root: str | os.PathLike, emerg: str,
+                            target: Any, *, log=print) -> Any | None:
+    """Restore + verify the emergency dump at ``emerg`` with the
+    accept/quarantine decision UNANIMOUS across hosts (``all_hosts_ok``):
+    a dump whose shard is corrupt on ONE host must be rejected by ALL
+    hosts, or replicas resume from different states.  Returns the
+    restored state, or None if the dump was rejected — in which case
+    process 0 has quarantined it (``.corrupt``) behind a barrier and the
+    caller falls back to the step_N series.  The one emergency-dump
+    accept protocol, shared by the CLI resume and the supervisor's
+    ``auto_resume``."""
+    state, err = None, None
+    try:
+        state = restore_checkpoint(emerg, target, verify=True)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        err = e
+    if all_hosts_ok(err is None):
+        return state
+    log(f"[tpudp] WARNING: emergency dump {emerg} failed "
+        f"restore/verification "
+        f"({err if err is not None else 'on a peer host'}); quarantining "
+        "it and falling back to the epoch checkpoint series")
+    if jax.process_index() == 0:
+        quarantine_emergency(root)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tpudp_emergency_quarantine")
+    return None
 
 
 class AsyncCheckpointWriter:
@@ -280,10 +774,44 @@ class AsyncCheckpointWriter:
         if not HAVE_ORBAX:
             raise RuntimeError("orbax-checkpoint is not installed")
         self._ckpt = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        # Multi-host two-phase commit, DEFERRED: the COMMITTED marker may
+        # only be written after every host's async write finalized, which
+        # an async save() cannot wait for — the pending path is committed
+        # (barrier + marker) by the next save()/wait()/close(), each of
+        # which first joins the in-flight write.  Until then the dir is
+        # detectably torn, exactly like a sync save killed mid-barrier.
+        self._pending_commit: str | None = None
+
+    def _commit_pending(self) -> None:
+        """Barrier + COMMITTED marker for the previous multi-host save.
+        Callers must have joined that write (``wait_until_finished``)
+        first — the marker asserts durability on EVERY host."""
+        if self._pending_commit is None:
+            return
+        path, self._pending_commit = self._pending_commit, None
+        commit_after_all_hosts(path)
 
     def save(self, path: str | os.PathLike, state: Any, *,
              force: bool = True, manifest: bool = True) -> str:
         path = os.path.abspath(os.fspath(path))
+        if self._pending_commit is not None:
+            self._ckpt.wait_until_finished()
+            self._commit_pending()
+        multihost = manifest and jax.process_count() > 1
+        if multihost:
+            # Same stale-sidecar invalidation as the sync saver: a
+            # leftover marker under this name would mark the new
+            # in-flight write committed before any byte landed.
+            from jax.experimental import multihost_utils
+
+            invalidate_commit(path)
+            multihost_utils.sync_global_devices(
+                f"tpudp_async_ckpt_invalidate:{os.path.basename(path)}")
+        elif manifest:
+            # Same stale-sidecar hazard as the sync saver: a shrunken
+            # pod's single-host re-save must not inherit a larger pod's
+            # host manifests under this name.
+            invalidate_commit(path)
         self._ckpt.save(path, state, force=force)
         if manifest:
             # Checksums must be computed NOW, before the caller's next
@@ -293,14 +821,20 @@ class AsyncCheckpointWriter:
             # crash mid-write then leaves a torn dir whose verification
             # fails, which is exactly the signal the fallback walk needs.
             write_manifest(path, state)
+            if multihost:
+                self._pending_commit = path
         return path
 
     def wait(self) -> None:
-        """Block until every started save has committed to disk."""
+        """Block until every started save has committed to disk (and, on
+        multi-host, carries its COMMITTED marker)."""
         self._ckpt.wait_until_finished()
+        self._commit_pending()
 
     def close(self) -> None:
         """Join outstanding writes and release the background threads."""
+        self._ckpt.wait_until_finished()
+        self._commit_pending()
         self._ckpt.close()
 
     def __enter__(self) -> "AsyncCheckpointWriter":
@@ -368,15 +902,25 @@ def consume_emergency(root: str | os.PathLike) -> str:
     ``emergency.restored`` (replacing any previous one) and clear the
     sentinel, so later resumes fall back to the ``step_N`` series.  The
     single implementation behind the CLI resume, ``auto_resume``, and the
-    supervisor's in-process step recovery."""
+    supervisor's in-process step recovery.  Multi-host integrity sidecars
+    (per-host shard manifests, COMMITTED marker) leave with the dir: a
+    stale host manifest left at the base name would be read against the
+    NEXT dump's bytes (e.g. a single-host dump after the pod shrank) and
+    reject every future dump at this root forever."""
     root = os.fspath(root)
     emerg = os.path.join(root, "emergency")
     consumed = emerg + ".restored"
+    sidecars = _sidecar_paths(emerg)  # enumerate BEFORE the rename
     if os.path.isdir(consumed):
         import shutil
 
         shutil.rmtree(consumed)
     os.rename(emerg, consumed)
+    for src in sidecars:
+        try:
+            os.replace(src, consumed + src[len(os.path.abspath(emerg)):])
+        except OSError:
+            pass
     clear_emergency_sentinel(root)
     return consumed
 
@@ -390,6 +934,7 @@ def quarantine_emergency(root: str | os.PathLike) -> str | None:
     root = os.fspath(root)
     emerg = os.path.join(root, "emergency")
     target = emerg + ".corrupt"
+    sidecars = _sidecar_paths(emerg)  # enumerate BEFORE the rename
     moved = None
     try:
         if os.path.isdir(target):
@@ -398,6 +943,14 @@ def quarantine_emergency(root: str | os.PathLike) -> str | None:
             shutil.rmtree(target)
         os.rename(emerg, target)
         moved = target
+        # Sidecars leave with the dir (see consume_emergency): a stale
+        # host manifest at the base name would reject every future dump.
+        for src in sidecars:
+            try:
+                os.replace(src,
+                           target + src[len(os.path.abspath(emerg)):])
+            except OSError:
+                pass
     except OSError:
         pass
     clear_emergency_sentinel(root)
@@ -459,47 +1012,64 @@ def prune_step_dirs(root: str | os.PathLike, keep: int) -> list[str]:
     even when it falls outside the keep window: if the newer retained dirs
     are all torn, that dir is the only restorable state left and pruning
     it would make the next resume impossible (docs/RESILIENCE.md).
-    A pruned dir's manifest file is deleted with it.  Residual window:
-    SILENT rot of a never-yet-restored newest dir keeps its manifest, so
-    the protection can still pick it while ``keep=1`` deletes the intact
-    older dir — restore-time rejection quarantines corrupt dirs out of
-    the series, but only once a restore has actually run; prefer
-    ``keep >= 2`` when the storage is suspect.  Multi-host callers
-    should invoke this on process 0 only, after the save for the newest
-    step has committed (the sync saver and AsyncCheckpointWriter's
-    serialized saves both guarantee the PREVIOUS step is durable by then,
-    so the retained set is always restorable)."""
+    A pruned dir's sidecars (manifest, per-host shard manifests, commit
+    marker) are deleted with it.  Residual window: SILENT rot of a
+    never-yet-restored newest dir keeps its manifest, so the protection
+    can still pick it while ``keep=1`` deletes the intact older dir —
+    restore-time rejection quarantines corrupt dirs out of the series,
+    but only once a restore has actually run; prefer ``keep >= 2`` when
+    the storage is suspect.
+
+    Multi-host: ONLY process 0 deletes (enforced here — on any other
+    process this is a no-op, so a caller that forgets the rank guard
+    cannot race N deleters against each other), and a dir carrying
+    per-host shard manifests but no COMMITTED marker is never deleted:
+    the marker is the two-phase-commit proof that every host finished
+    writing, so a marker-less dir may still be mid-write by a peer (the
+    cross-host prune race) — it is skipped and left for the verified
+    walk to quarantine as torn."""
     import shutil
 
     root = os.fspath(root)
     if keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
+    if jax.process_index() != 0:
+        return []
     newest_first = step_dirs_newest_first(root)  # the one scan the
     # restore-fallback walk uses too — prune and restore can't disagree
     # about what the series contains
     protected = next(
         (path for path in newest_first
          if os.path.exists(manifest_path(path))
+         or (host_manifest_paths(path) and is_committed(path))
          or os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))),
         None)
     deleted = []
     for path in list(reversed(newest_first))[:-keep]:
         if path == protected:
             continue
+        if host_manifest_paths(path) and not is_committed(path):
+            # Possibly still being written by another host (its manifest
+            # landed, the commit barrier has not): deleting under a
+            # writer tears the save AND the writer.  Leave it; the
+            # verified walk quarantines it if it really is torn.
+            continue
+        sidecars = _sidecar_paths(path)  # enumerate BEFORE the rmtree
         try:
             shutil.rmtree(path)
         except OSError as e:
             print(f"[tpudp] WARNING: could not prune checkpoint {path}: {e}")
             continue
-        try:
-            os.unlink(manifest_path(path))
-        except FileNotFoundError:
-            pass
-        except OSError as e:  # same tolerance as the rmtree above: a
-            # housekeeping failure must never kill (or, under the
-            # supervisor, fault-retry) the training run
-            print(f"[tpudp] WARNING: could not remove manifest of pruned "
-                  f"checkpoint {path}: {e}")
+        for sidecar in sidecars:
+            try:
+                os.unlink(sidecar)
+            except FileNotFoundError:
+                pass
+            except OSError as e:  # same tolerance as the rmtree above: a
+                # housekeeping failure must never kill (or, under the
+                # supervisor, fault-retry) the training run
+                print(f"[tpudp] WARNING: could not remove sidecar of "
+                      f"pruned checkpoint {path}: {e}")
         deleted.append(path)
     return deleted
 

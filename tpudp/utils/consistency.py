@@ -116,10 +116,33 @@ def fingerprint(tree) -> np.ndarray:
     """Cheap cross-process consistency probe: per-leaf (sum, sum of
     squares, size) reduced over leaves — processes can exchange/compare
     these few floats instead of weights.  Equal fingerprints don't prove
-    equality, but unequal ones prove divergence."""
+    equality, but unequal ones prove divergence.
+
+    Only leaves whose full logical value is visible on this host
+    contribute — fully addressable ones, and fully REPLICATED multi-host
+    ones (every host holds the whole value, so their sums must agree; on
+    a multi-process mesh these are NOT fully addressable, and skipping
+    them would fingerprint nothing at all exactly where the check
+    matters).  A genuinely SHARDED leaf (ZeRO-1 optimizer state, FSDP
+    params) holds a different slice on every host, so its per-host sums
+    differ by construction — including it would flag healthy runs; its
+    bytes are covered by the per-host checkpoint shard manifests
+    instead."""
     sums = sqs = n = 0.0
     for _, leaf in _leaf_paths(tree):
         if isinstance(leaf, jax.Array):
+            if not getattr(leaf, "is_fully_addressable", True):
+                if not getattr(leaf, "is_fully_replicated", False):
+                    continue
+                # Replicated across processes: any addressable shard IS
+                # the full value (np.asarray on the array itself is
+                # version-dependent for non-addressable arrays).
+                a = np.asarray(leaf.addressable_shards[0].data,
+                               dtype=np.float64)
+                sums += float(a.sum())
+                sqs += float((a * a).sum())
+                n += a.size
+                continue
             a = np.asarray(jax.device_get(leaf), dtype=np.float64)
             sums += float(a.sum())
             sqs += float((a * a).sum())
