@@ -137,6 +137,11 @@ def _worker() -> int:
         # 2-proc CPU smoke geometry, always before the first event).
         from jax.experimental import multihost_utils
 
+        # tpudp: lint-ok(divergent-collective): nproc comes from
+        # TRAIN_SOAK_NPROC, which _launch_pod sets IDENTICALLY for every
+        # worker it spawns — the condition is host-uniform by
+        # construction, and this barrier exists precisely to serialize
+        # the pod's first rendezvous.
         multihost_utils.sync_global_devices("tpudp_pod_startup")
     import flax.linen as nn
     import jax

@@ -25,6 +25,17 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
+def audit_capture():
+    """ONE trace-audit capture shared by every analysis test module —
+    capturing re-traces all registered step programs (~7s), so the
+    suite must not pay it per module."""
+    from tpudp.analysis import audit
+
+    audit.force_smoke_backend()
+    return audit.capture()
+
+
+@pytest.fixture(scope="session")
 def mesh8():
     from tpudp.mesh import make_mesh
 

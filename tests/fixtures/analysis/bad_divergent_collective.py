@@ -1,0 +1,32 @@
+"""Seeded violations for divergent-collective: rendezvous ops under
+per-host control flow."""
+
+import os
+
+import jax
+from jax import lax
+
+
+def rank0_reduce(x, axis):
+    if jax.process_index() == 0:        # finding: only rank 0 arrives
+        return lax.psum(x, axis)
+    return x
+
+
+def recover(x, axis, root):
+    head = os.path.exists(root)
+    if head:                            # finding: filesystem condition
+        x = lax.all_gather(x, axis)
+    try:
+        return lax.psum(x, axis)
+    except RuntimeError:
+        return lax.pmean(x, axis)       # finding: inside except handler
+
+
+def flag_gate(x, axis, root):
+    ready = False
+    if os.path.exists(root):
+        ready = True                    # control-dependent constant
+    if ready:                           # finding: the flag carries the
+        return lax.psum(x, axis)        # per-host divergence anyway
+    return x

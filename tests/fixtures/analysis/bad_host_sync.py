@@ -1,0 +1,28 @@
+"""Seeded violations for host-sync: device→host round trips in traced
+code and on a marked scheduler hot path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_traced(x):
+    return float(x.sum())           # finding: concretizes in trace
+
+
+class Scheduler:
+    def __init__(self, step):
+        self.step = step
+
+    # tpudp: hot-path
+    def drive(self, state, batch):
+        logits = jnp.matmul(state, batch)
+        score = float(logits.sum())          # finding: per-step fetch
+        # finding: the sync hides inside a host call AND untaints its
+        # own target — must still fire with the pre-assignment taint
+        score = max(float(logits.sum()), 0.0)
+        toks = np.asarray(logits)            # finding: per-step fetch
+        jax.device_get(logits)               # finding: explicit fetch
+        logits.block_until_ready()           # finding: explicit barrier
+        return score, toks

@@ -1,0 +1,23 @@
+"""Seeded violations for trace-nondeterminism: host clock/RNG values
+frozen into a traced program.  Lint fixture — parsed, never imported."""
+
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def noisy_step(x):
+    jitter = time.perf_counter()          # finding: wall clock in trace
+    noise = np.random.normal(size=3)      # finding: host RNG in trace
+    return x * jitter + noise
+
+
+def scan_body(carry, _):
+    return carry + random.random(), None  # finding: traced via lax.scan
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)

@@ -1,0 +1,30 @@
+"""Seeded violations for traced-branch: Python control flow on traced
+values inside jitted functions."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x, lo):
+    if x > lo:                      # finding: traced comparison
+        return x
+    return lo
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def normalize(buf, scale):
+    total = jnp.sum(buf) * scale
+    while total > 1.0:              # finding: traced while
+        total = total / 2.0
+    return buf * total
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def dispatch(x, mode):
+    y = x * 2
+    if y.sum() > 0:                 # finding: derived traced value
+        return y
+    return x

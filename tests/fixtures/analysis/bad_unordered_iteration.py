@@ -1,0 +1,24 @@
+# tpudp: collective-module
+"""Seeded violations for unordered-iteration: set iteration inside a
+trace, unsorted os.listdir in a coordination module."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+AXES = {"data", "model", "seq"}
+
+
+@jax.jit
+def reduce_axes(x):
+    total = x
+    for axis in {"a", "b"}:        # finding: set iteration in trace
+        total = total + jnp.sum(x)
+    parts = [jnp.sum(x) for a in frozenset(AXES)]  # finding: set iter
+    return total, parts
+
+
+def newest_checkpoint(root):
+    dirs = os.listdir(root)        # finding: unsorted listing feeds walk
+    return dirs[-1]

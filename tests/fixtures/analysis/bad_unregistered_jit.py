@@ -1,0 +1,28 @@
+# tpudp: compile-once-module
+"""Seeded violation for unregistered-jit: a jitted program in a
+compile-once module with no TRACE_COUNTS bump."""
+
+import collections
+import functools
+
+import jax
+
+TRACE_COUNTS = collections.Counter()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def silent_step(cache, tokens):     # finding: recompiles are invisible
+    return cache + tokens
+
+
+@jax.jit
+def counted_step(x):
+    TRACE_COUNTS["counted_step"] += 1
+    return x * 2
+
+
+def _silent_body(cache, tokens):
+    return cache * tokens
+
+
+fast_silent = jax.jit(_silent_body)  # finding: call-form, no counter

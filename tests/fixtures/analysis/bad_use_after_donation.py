@@ -1,0 +1,25 @@
+"""Seeded violations for use-after-donation: buffers read after being
+handed to a donating jitted program."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(buf, delta):
+    return buf + delta
+
+
+def read_after_donate(buf, delta):
+    new = update(buf, delta)
+    stale = buf.sum()               # finding: buf was donated above
+    return new, stale
+
+
+def donate_in_loop(buf, deltas):
+    outs = []
+    for d in deltas:
+        outs.append(update(buf, d))  # finding: never rebound in loop
+    return outs

@@ -1,0 +1,37 @@
+"""Corrected twin of bad_divergent_collective: every host reaches every
+collective; per-host facts travel THROUGH the collective instead of
+gating it."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def uniform_reduce(x, axis):
+    total = lax.psum(x, axis)           # every host, unconditionally
+    if jax.process_index() == 0:
+        print("sum ready")              # host-local side effect is fine
+    return total
+
+
+def recover(x, axis, root):
+    # the per-host fact becomes collective INPUT, not a gate
+    have = jnp.float32(1.0 if os.path.exists(root) else 0.0)
+    everyone_has = lax.pmin(have, axis)  # agreed value on every host
+    gathered = lax.all_gather(x, axis)   # unconditional rendezvous
+    return gathered, everyone_has
+
+
+def static_branch(x, axis, world):
+    if world > 1:                        # host-uniform config value
+        return lax.psum(x, axis)
+    return x
+
+
+def voted_gate(x, axis, root, step, all_hosts_ok):
+    have = os.path.exists(root)          # per-host fact...
+    if all_hosts_ok(have, step):         # ...voted: the RESULT is
+        return lax.all_gather(x, axis)   # host-uniform, branch is safe
+    return x

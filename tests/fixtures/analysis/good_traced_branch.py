@@ -1,0 +1,31 @@
+"""Corrected twin of bad_traced_branch: lax.cond/jnp.where for traced
+decisions, Python branches only on static facts."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def clamp(x, lo):
+    return jnp.where(x > lo, x, lo)         # traced select, no branch
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def normalize(buf, scale):
+    total = jnp.sum(buf) * scale
+    total = lax.while_loop(lambda t: t > 1.0, lambda t: t / 2.0, total)
+    return buf * total
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def dispatch(x, mode):
+    if mode == "double":                    # static argument: fine
+        return x * 2
+    if x.ndim == 2:                         # shape facts are static
+        return x.sum(axis=-1)
+    if x is None:                           # identity tests are static
+        return jnp.zeros(())
+    return x

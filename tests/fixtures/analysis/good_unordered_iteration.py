@@ -1,0 +1,30 @@
+# tpudp: collective-module
+"""Corrected twin of bad_unordered_iteration: sorted orders
+everywhere the interpreter's hash order could leak in."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+AXES = {"data", "model", "seq"}
+
+
+@jax.jit
+def reduce_axes(x):
+    total = x
+    for axis in sorted({"a", "b"}):       # deterministic order
+        total = total + jnp.sum(x)
+    parts = [jnp.sum(x) for a in sorted(AXES)]
+    return total, parts
+
+
+def newest_checkpoint(root):
+    dirs = sorted(os.listdir(root))       # every host walks one order
+    return dirs[-1]
+
+
+def newest_step(root):
+    # sorted() enclosing a comprehension also normalizes the order
+    steps = sorted(int(d.split("_")[1]) for d in os.listdir(root))
+    return steps[-1]

@@ -1,0 +1,28 @@
+# tpudp: compile-once-module
+"""Corrected twin of bad_unregistered_jit: every jit bumps its
+TRACE_COUNTS entry as the first traced side effect."""
+
+import collections
+import functools
+
+import jax
+
+TRACE_COUNTS = collections.Counter()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def loud_step(cache, tokens):
+    TRACE_COUNTS["loud_step"] += 1
+    return cache + tokens
+
+
+def plain_helper(x):                # not jitted: no counter required
+    return x * 2
+
+
+def _loud_body(cache, tokens):
+    TRACE_COUNTS["loud_body"] += 1
+    return cache * tokens
+
+
+fast_loud = jax.jit(_loud_body)     # call-form with its counter: fine

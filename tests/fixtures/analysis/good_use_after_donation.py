@@ -1,0 +1,23 @@
+"""Corrected twin of bad_use_after_donation: the donated buffer is
+rebound by the same statement, loops carry the fresh result."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(buf, delta):
+    return buf + delta
+
+
+def rebind_then_read(buf, delta):
+    buf = update(buf, delta)        # same-statement rebind: the idiom
+    return buf, buf.sum()           # reads the NEW buffer
+
+
+def donate_in_loop(buf, deltas):
+    for d in deltas:
+        buf = update(buf, d)        # refreshed every iteration
+    return buf

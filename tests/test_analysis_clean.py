@@ -1,0 +1,49 @@
+"""Tier-1 gates: the tree itself must satisfy its own static analysis.
+
+Two pins (ISSUE 8 acceptance bar):
+
+  * ``lint``: zero unsuppressed findings over tpudp/ — every sanctioned
+    exception is a visible ``# tpudp: lint-ok(rule)`` in the diff, and
+    a new hazard (host sync on a hot path, collective under divergent
+    control flow, unregistered jit, ...) fails here before it can
+    regress a pod run.
+  * ``audit``: the registered step programs' jaxprs match the committed
+    tools/trace_lock.json at the CPU smoke geometries — a recompile, a
+    new host transfer, or a changed collective sequence in a pinned hot
+    path is an explicit `audit --update` + lockfile diff, never a
+    silent serve_bench regression.  Source digests must be fresh too,
+    so the lock's provenance tracks every hot-path edit.
+"""
+
+import os
+
+from tpudp.analysis import lint_paths
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK = os.path.join(ROOT, "tools", "trace_lock.json")
+
+
+def test_lint_clean_over_tpudp():
+    findings, errors = lint_paths(["tpudp"], ROOT)
+    assert errors == [], errors
+    assert findings == [], "\n".join(f.render() for f in findings) + (
+        "\n\nfix the hazard, or justify it with an explicit "
+        "`# tpudp: lint-ok(rule): why` (docs/ANALYSIS.md)")
+
+
+def test_lint_clean_over_tools_and_benchmarks():
+    """The gate/bench layer must hold the same bar — it drives the same
+    donating programs and hot loops the package does."""
+    findings, errors = lint_paths(["tools", "benchmarks"], ROOT)
+    assert errors == [], errors
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_audit_matches_committed_lock(audit_capture):
+    from tpudp.analysis import audit
+
+    problems = audit.compare(audit.load_lock(LOCK), audit_capture)
+    assert problems == [], "\n".join(problems) + (
+        "\n\nif the trace change is intended: "
+        "`python -m tpudp.analysis audit --update` and commit the "
+        "tools/trace_lock.json diff (docs/ANALYSIS.md)")

@@ -284,3 +284,45 @@ def test_collective_gap_gate(tmp_path):
         {"strategy": "allreduce", "wall_time_s": 0.01, "devices": 8,
          "device_kind": "TPU v4"}])
     assert collective_missing(d)
+
+
+def test_analysis_gap_stage(tmp_path):
+    """The correctness-gate stage: a clean tree reports no gaps; a tree
+    with an unsuppressed finding owes `lint`, and a missing/stale
+    trace lock owes `audit` — all without importing jax (the poll-path
+    contract; tests/test_analysis.py proves the jax-free load)."""
+    from tools.bench_gaps import analysis_missing
+
+    # the real tree is the clean case — tier-1 pins it clean, so the
+    # stage must agree
+    assert analysis_missing() == []
+
+    # seeded tree: one traced-branch violation + no lockfile at all
+    pkg = tmp_path / "tpudp"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()       # configured lint paths must
+    (tmp_path / "benchmarks").mkdir()  # exist, or that alone is a gap
+    (pkg / "bad.py").write_text(
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n")
+    assert analysis_missing(str(tmp_path)) == ["lint", "audit"]
+
+    # fixing the violation (suppression counts: it is explicit in the
+    # diff) leaves only the missing lock owed
+    (pkg / "bad.py").write_text(
+        "import jax\n"
+        "from jax import lax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return jax.numpy.where(x > 0, x, -x)\n")
+    assert analysis_missing(str(tmp_path)) == ["audit"]
+
+    # a configured lint path vanishing must read as a lint gap, not as
+    # "clean" — the CLI exits 2 on the same condition and the two gates
+    # must agree
+    (tmp_path / "benchmarks").rmdir()
+    assert analysis_missing(str(tmp_path)) == ["lint", "audit"]

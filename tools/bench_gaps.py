@@ -30,16 +30,30 @@ the missing work as arguments the benches accept:
                                            pod-scale kill-one-host soak
                                            seeds (multi-host resilience
                                            rows missing)
+    python tools/bench_gaps.py analysis -> "lint" if tpudp.analysis has
+                                           unsuppressed findings and/or
+                                           "audit" if tools/
+                                           trace_lock.json is stale
+                                           against the pinned hot-path
+                                           sources (correctness gates,
+                                           not TPU measurements — they
+                                           key off the TREE, not
+                                           bench_results/)
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
 window is retried in the next.  Pure stdlib (no jax import) so the watcher
-can call it cheaply every poll.
+can call it cheaply every poll — the analysis stage keeps that true by
+loading tpudp/analysis by FILE PATH under a synthetic package name (its
+lint half is stdlib by design), never importing the jax-heavy `tpudp`
+parent package.
 """
 
 import argparse
+import importlib.util
 import json
 import os
+import sys
 
 MATRIX_CONFIGS = ("part1_single", "dp_psum", "dp_ring", "dp_coordinator",
                   "dp_gspmd", "resnet50", "gpt2_small", "gpt2_flash",
@@ -410,13 +424,61 @@ def collective_missing(d: str) -> bool:
     return not any(r.get("skipped") and r.get("devices") == 1 for r in rows)
 
 
+def _load_analysis():
+    """tpudp/analysis as a standalone package (no `tpudp` import, so no
+    jax): spec_from_file_location with submodule_search_locations makes
+    the package's own relative imports work."""
+    if "_tpudp_analysis" in sys.modules:
+        return sys.modules["_tpudp_analysis"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkgdir = os.path.join(root, "tpudp", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_tpudp_analysis", os.path.join(pkgdir, "__init__.py"),
+        submodule_search_locations=[pkgdir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_tpudp_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ANALYSIS_LINT_PATHS = ("tpudp", "tools", "benchmarks")
+
+
+def analysis_missing(root: str | None = None) -> list[str]:
+    """Correctness gates still owed on the current TREE: ``lint`` when
+    `python -m tpudp.analysis lint` would fail (unsuppressed findings),
+    ``audit`` when tools/trace_lock.json no longer matches the pinned
+    hot-path sources (an edit landed without `audit --update`; the full
+    jaxpr re-trace is the tier-1 test's job — this is the cheap stdlib
+    staleness proxy for the poll path)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mod = _load_analysis()
+    audit = importlib.import_module("_tpudp_analysis.audit")
+    gaps = []
+    # a configured path that vanished must NOT read as "clean" — the
+    # CLI exits 2 on exactly this ('no such path'), and the poll gate
+    # must agree with it
+    missing = [p for p in ANALYSIS_LINT_PATHS
+               if not os.path.exists(os.path.join(root, p))]
+    findings, errors = mod.lint_paths(
+        [p for p in ANALYSIS_LINT_PATHS if p not in missing], root)
+    if findings or errors or missing:
+        gaps.append("lint")
+    if audit.sources_stale(os.path.join(root, "tools", "trace_lock.json"),
+                           root):
+        gaps.append("audit")
+    return gaps
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_soak",
                                      "serve_prefix", "serve_tenancy",
-                                     "train_soak", "train_soak_multihost"])
+                                     "train_soak", "train_soak_multihost",
+                                     "analysis"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -445,6 +507,8 @@ def main() -> None:
               end="")
     elif args.stage == "serve_prefix":
         print(",".join(serve_prefix_missing(args.dir)), end="")
+    elif args.stage == "analysis":
+        print(",".join(analysis_missing()), end="")
     elif args.stage == "collective":
         print("collective" if collective_missing(args.dir) else "", end="")
     elif args.stage == "lever":

@@ -1,0 +1,30 @@
+"""tpudp.analysis — static enforcement of the repo's runtime invariants.
+
+Two surfaces (docs/ANALYSIS.md):
+
+  * ``python -m tpudp.analysis lint`` — an AST-based, repo-aware linter
+    for the failure classes this codebase has already paid for:
+    nondeterminism baked into traces, Python branches on traced values,
+    host syncs on scheduler hot paths, use-after-donation, collectives
+    under per-host-divergent control flow, and unobservable jit
+    programs.  Suppressions are explicit ``# tpudp: lint-ok(rule)``
+    comments, so every sanctioned exception is visible in a diff.
+  * ``python -m tpudp.analysis audit`` — traces the registered step
+    programs at pinned CPU smoke geometries, fingerprints their jaxprs
+    (plus a host-callback/transfer/collective census) and diffs against
+    the committed ``tools/trace_lock.json``: a PR that introduces a
+    recompile, a new host transfer, or a changed collective sequence in
+    a hot path fails tier-1 loudly instead of silently regressing the
+    benches.
+
+This ``__init__`` (and the lint half of the package) is import-light by
+design — stdlib only, jax loaded lazily inside the audit functions — so
+watcher tooling (tools/bench_gaps.py) can run the lint gate on its poll
+path without paying a jax import.
+"""
+
+# Relative imports throughout the package: tools/bench_gaps.py loads it
+# standalone (by file path, under a synthetic package name) to run the
+# lint gate without importing the jax-heavy `tpudp` parent package.
+from .core import Finding, Module, Rule, lint_paths  # noqa: F401
+from .rules import RULES, RULES_BY_NAME  # noqa: F401

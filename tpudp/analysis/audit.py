@@ -1,0 +1,254 @@
+"""Trace-stability auditor: jaxpr fingerprints + transfer census vs a
+committed lockfile.
+
+For every program in :mod:`tpudp.analysis.programs` this traces the
+function (``jax.make_jaxpr`` — trace only, nothing compiles) and
+records:
+
+  * ``fingerprint`` — sha256 of the canonicalized jaxpr text (memory
+    addresses scrubbed).  Any change to the traced computation —
+    including one that would force a recompile at fixed shapes —
+    changes it.
+  * ``collectives`` — the ordered sequence of collective primitives
+    (psum/ppermute/all_gather/...), recursively through scan/cond/pjit
+    sub-jaxprs.  This is the static twin of PR 7's runtime vote: two
+    hosts tracing different collective sequences deadlock a pod.
+  * ``callbacks`` / ``transfers`` — host-callback and device_put
+    primitive counts: a new host round trip inside a step program is a
+    latency regression serve_bench would only catch after the fact.
+  * ``eqns`` — total equation count (a coarse program-size canary).
+
+``compare`` diffs a capture against the lockfile and names the
+offending program and WHAT changed.  Source digests (sha256 of
+AUDIT_SOURCES) also ride in the lock so stdlib-only tooling
+(tools/bench_gaps.py) can flag a stale lock without importing jax; the
+tier-1 test keeps them fresh, so every hot-path edit forces an
+explicit ``audit --update`` + lockfile diff in review.
+
+Module import is jax-free; jax loads inside the functions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+
+LOCK_VERSION = 1
+
+#: Substrings identifying collective primitives (matched against
+#: primitive names so jax renames like psum→psum2 keep being counted
+#: — the recorded name is always the real one).
+COLLECTIVE_PRIM_PARTS = ("psum", "pmax", "pmin", "ppermute", "pbroadcast",
+                         "all_gather", "all_to_all", "reduce_scatter",
+                         "pgather")
+CALLBACK_PRIM_PARTS = ("callback",)
+TRANSFER_PRIM_NAMES = {"device_put", "copy"}
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# -- stdlib half (bench_gaps-safe) ------------------------------------
+
+def source_digests(root: str | None = None) -> dict[str, str]:
+    from .programs import AUDIT_SOURCES
+
+    root = root or repo_root()
+    out = {}
+    for rel in AUDIT_SOURCES:
+        path = os.path.join(root, rel)
+        h = hashlib.sha256()
+        try:
+            with open(path, "rb") as f:
+                h.update(f.read())
+            out[rel] = h.hexdigest()
+        except OSError:
+            out[rel] = "MISSING"
+    return out
+
+
+def load_lock(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_lock(path: str, capture_result: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(capture_result, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def sources_stale(lock_path: str, root: str | None = None) -> list[str]:
+    """Pinned source files whose digest no longer matches the lock —
+    pure stdlib, usable from the watcher poll path.  A missing/
+    unreadable lock returns every pinned source."""
+    try:
+        lock = load_lock(lock_path)
+    except (OSError, json.JSONDecodeError):
+        from .programs import AUDIT_SOURCES
+        return list(AUDIT_SOURCES)
+    recorded = lock.get("sources", {})
+    current = source_digests(root)
+    return sorted(set(
+        [rel for rel, digest in current.items()
+         if recorded.get(rel) != digest]
+        + [rel for rel in recorded if rel not in current]))
+
+
+# -- jax half ----------------------------------------------------------
+
+def force_smoke_backend():
+    """Pin the CPU backend with 8 virtual devices BEFORE first use, so
+    the audit geometry is identical on every host (laptop, CI, TPU VM).
+    Raises RuntimeError if another backend already initialized."""
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already up — verified below
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            "the trace audit must run on the CPU smoke backend, but "
+            f"backend {jax.default_backend()!r} is already initialized — "
+            "run `python -m tpudp.analysis audit` in a fresh process")
+    if jax.device_count() < 8:
+        raise RuntimeError(
+            "the trace audit needs >= 8 virtual CPU devices for the mesh "
+            "geometries; launch with XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 (a fresh "
+            "`python -m tpudp.analysis audit` sets this itself)")
+    return jax
+
+
+def _census(jaxpr, acc) -> None:
+    from jax.core import Jaxpr
+
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        name = eqn.primitive.name
+        acc["eqns"] += 1
+        if any(p in name for p in COLLECTIVE_PRIM_PARTS):
+            acc["collectives"].append(name)
+        if any(p in name for p in CALLBACK_PRIM_PARTS):
+            acc["callbacks"] += 1
+        if name in TRANSFER_PRIM_NAMES:
+            acc["transfers"] += 1
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for sub in vs:
+                if isinstance(sub, Jaxpr) or hasattr(sub, "jaxpr"):
+                    _census(sub, acc)
+
+
+def fingerprint(fn, args) -> dict:
+    """Trace ``fn(*args)`` and reduce the jaxpr to its lock record."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    text = _ADDR_RE.sub("0xX", str(closed))
+    acc = {"eqns": 0, "collectives": [], "callbacks": 0, "transfers": 0}
+    _census(closed, acc)
+    return {
+        "fingerprint": hashlib.sha256(text.encode()).hexdigest(),
+        "eqns": acc["eqns"],
+        "collectives": acc["collectives"],
+        "callbacks": acc["callbacks"],
+        "transfers": acc["transfers"],
+    }
+
+
+def capture(programs: dict | None = None) -> dict:
+    """Trace every registered program → a lockfile-shaped dict."""
+    import jax
+
+    if programs is None:
+        from .programs import build_programs
+        programs = build_programs()
+    return {
+        "version": LOCK_VERSION,
+        "jax": jax.__version__,
+        "programs": {name: fingerprint(fn, args)
+                     for name, (fn, args) in programs.items()},
+        "sources": source_digests(),
+    }
+
+
+def compare(lock: dict, current: dict) -> list[str]:
+    """Human-readable mismatches, each naming the offending program."""
+    problems: list[str] = []
+    if lock.get("version") != current["version"]:
+        problems.append(
+            f"lock version {lock.get('version')} != auditor version "
+            f"{current['version']} — regenerate with --update")
+        return problems
+    if lock.get("jax") != current["jax"]:
+        problems.append(
+            f"lock was generated under jax {lock.get('jax')}, this "
+            f"environment runs {current['jax']} — jaxpr text is only "
+            f"comparable within one jax version; regenerate with --update")
+        return problems
+    locked = lock.get("programs", {})
+    live = current["programs"]
+    for name in locked:
+        if name not in live:
+            problems.append(
+                f"{name}: in the lockfile but no longer registered — a "
+                f"pinned hot-path program disappeared (deliberate removal "
+                f"=> --update)")
+    for name, rec in live.items():
+        old = locked.get(name)
+        if old is None:
+            problems.append(
+                f"{name}: registered but not in the lockfile — run "
+                f"--update to pin the new program")
+            continue
+        if old == rec:
+            continue
+        deltas = []
+        if old.get("collectives") != rec["collectives"]:
+            deltas.append(
+                f"collective sequence changed: {old.get('collectives')} "
+                f"-> {rec['collectives']} (host-uniform ordering is the "
+                f"pod-deadlock invariant)")
+        if old.get("callbacks") != rec["callbacks"]:
+            deltas.append(
+                f"host callbacks {old.get('callbacks')} -> "
+                f"{rec['callbacks']} (a new host round trip inside the "
+                f"step program)")
+        if old.get("transfers") != rec["transfers"]:
+            deltas.append(f"device transfers {old.get('transfers')} -> "
+                          f"{rec['transfers']}")
+        if old.get("eqns") != rec["eqns"]:
+            deltas.append(f"eqn count {old.get('eqns')} -> {rec['eqns']}")
+        if not deltas:
+            deltas.append("jaxpr fingerprint changed at identical census "
+                          "— the traced math itself differs")
+        problems.append(f"{name}: trace changed — " + "; ".join(deltas))
+    cur_sources = current.get("sources", {})
+    lock_sources = lock.get("sources", {})
+    stale = sorted(
+        {rel for rel, digest in cur_sources.items()
+         if lock_sources.get(rel) != digest}
+        # symmetric: a file REMOVED from AUDIT_SOURCES (or renamed)
+        # without --update leaves a rotted lock entry — same staleness
+        | {rel for rel in lock_sources if rel not in cur_sources})
+    if stale:
+        problems.append(
+            "stale source digests (edit without --update): "
+            + ", ".join(stale)
+            + " — traces still match, but the lock's provenance is out "
+              "of date; rerun `python -m tpudp.analysis audit --update` "
+              "and commit the lockfile")
+    return problems

@@ -1,0 +1,127 @@
+"""``python -m tpudp.analysis`` — lint and audit entry points.
+
+Exit codes compose with ``set -o pipefail`` harnesses: 0 = clean,
+1 = findings / audit mismatch, 2 = usage or internal error.
+
+``lint`` is pure stdlib and runs anywhere; ``audit`` forces the CPU
+backend at the pinned smoke geometry (8 virtual devices) BEFORE jax
+initializes, so the committed lockfile is reproducible on any host —
+laptop, CI, or a TPU VM — and never depends on what accelerator
+happens to be attached.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .audit import repo_root
+
+DEFAULT_LOCK = os.path.join("tools", "trace_lock.json")
+
+
+def _cmd_lint(args) -> int:
+    from .core import lint_paths
+    from .rules import RULES
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.name}: {rule.summary}")
+        return 0
+    root = repo_root()
+    paths = args.paths or ["tpudp"]
+    missing = [p for p in paths if not os.path.exists(
+        p if os.path.isabs(p) else os.path.join(root, p))]
+    if missing:
+        # a typo'd path must not turn the gate green by linting nothing
+        print(f"error: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings, errors = lint_paths(paths, root)
+    for err in errors:
+        print(f"error: {err}", file=sys.stderr)
+    for f in findings:
+        print(f.render())
+    n = len(findings)
+    print(f"tpudp.analysis lint: {n} finding{'s' if n != 1 else ''} "
+          f"({len(errors)} parse error{'s' if len(errors) != 1 else ''})")
+    return 1 if findings or errors else 0
+
+
+def _cmd_audit(args) -> int:
+    from . import audit
+
+    root = repo_root()
+    lock_path = os.path.join(root, args.lock)
+    lock = None
+    if not args.update:
+        # fail fast BEFORE the (multi-second) trace capture
+        try:
+            lock = audit.load_lock(lock_path)
+        except FileNotFoundError:
+            print(f"error: no lockfile at {args.lock} — run "
+                  f"`python -m tpudp.analysis audit --update` and commit "
+                  f"it", file=sys.stderr)
+            return 1
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"error: unreadable lockfile {args.lock} "
+                  f"({type(exc).__name__}: {exc}) — fix it (merge "
+                  f"conflict?) or regenerate with --update",
+                  file=sys.stderr)
+            return 1
+    try:
+        audit.force_smoke_backend()
+    except RuntimeError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    current = audit.capture()
+    if args.update:
+        audit.write_lock(lock_path, current)
+        print(f"tpudp.analysis audit: lockfile updated "
+              f"({len(current['programs'])} programs) -> {args.lock}")
+        return 0
+    problems = audit.compare(lock, current)
+    for p in problems:
+        print(p)
+    n = len(current["programs"])
+    if problems:
+        print(f"tpudp.analysis audit: {len(problems)} mismatch"
+              f"{'es' if len(problems) != 1 else ''} against {args.lock} — "
+              f"if the trace change is intended, regenerate with --update "
+              f"and commit the diff")
+        return 1
+    print(f"tpudp.analysis audit: {n} step programs match {args.lock}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpudp.analysis",
+        description="JAX-hazard linter + trace-stability auditor for the "
+                    "tpudp invariants (docs/ANALYSIS.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    lint = sub.add_parser(
+        "lint", help="AST hazard rules over the given paths (default: "
+                     "tpudp/); nonzero on any unsuppressed finding")
+    lint.add_argument("paths", nargs="*",
+                      help="files/directories, relative to the repo root")
+    lint.add_argument("--list-rules", action="store_true",
+                      help="print the rule catalogue and exit")
+    lint.set_defaults(fn=_cmd_lint)
+
+    aud = sub.add_parser(
+        "audit", help="trace the registered step programs at the CPU "
+                      "smoke geometries and diff jaxpr fingerprints + "
+                      "host-transfer/collective census against "
+                      f"{DEFAULT_LOCK}")
+    aud.add_argument("--update", action="store_true",
+                     help="regenerate the lockfile from the current tree")
+    aud.add_argument("--lock", default=DEFAULT_LOCK,
+                     help="lockfile path relative to the repo root")
+    aud.set_defaults(fn=_cmd_audit)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)

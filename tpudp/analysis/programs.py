@@ -1,0 +1,192 @@
+"""The trace-audit program registry: which jitted step programs are
+pinned, and at which CPU smoke geometries.
+
+Every program the serve/train hot loops dispatch is registered here
+with a builder that reconstructs the EXACT argument shapes/dtypes the
+runtime passes, at a geometry small enough to trace in milliseconds on
+the CPU backend.  ``python -m tpudp.analysis audit`` traces each one
+with ``jax.make_jaxpr`` (trace only — nothing compiles or runs),
+fingerprints the jaxpr, and diffs against ``tools/trace_lock.json``.
+
+If the runtime changes a program's argument shapes or its body, the
+audit fails and names the program — that is the point: a trace change
+in a pinned hot path must be an explicit, reviewed event
+(``audit --update`` + a committed lockfile diff), never a silent
+recompile/new-transfer regression discovered on the pod.
+
+Geometries are deliberately tiny and FIXED (they are part of the lock
+identity); they only need to exercise the same code paths the smoke
+tests pin, not realistic sizes.
+
+Heavy imports (jax, the models) happen inside the builders so the lint
+half of the package stays stdlib-importable.
+"""
+
+from __future__ import annotations
+
+#: Files whose edits can change a registered trace.  Their sha256
+#: digests ride in the lockfile: tools/bench_gaps.py compares them on
+#: the watcher poll path (stdlib-only) to report a stale lock without
+#: paying a jax import, and the tier-1 audit test requires them fresh
+#: so `audit --update` provenance can't rot.
+AUDIT_SOURCES = (
+    "tpudp/serve/engine.py",
+    "tpudp/serve/prefix_cache.py",
+    "tpudp/serve/speculate.py",
+    "tpudp/models/generate.py",
+    "tpudp/models/gpt2.py",
+    "tpudp/models/llama.py",
+    "tpudp/ops/sampling.py",
+    "tpudp/ops/attention.py",
+    "tpudp/ops/losses.py",
+    "tpudp/train.py",
+    "tpudp/parallel/sync.py",
+    "tpudp/parallel/ring.py",
+    "tpudp/analysis/programs.py",
+)
+
+#: Which registered program covers each TRACE_COUNTS key the serve
+#: layer can bump.  tests/test_analysis.py derives the key set from the
+#: actual ``TRACE_COUNTS[...] += 1`` sites by AST, so a new jit that
+#: satisfies the unregistered-jit rule (it bumps a counter) but skips
+#: this registry fails the suite instead of dodging the trace lock.
+TRACE_COUNTER_PROGRAMS = {
+    "decode_step": "serve.decode_step",
+    "verify_step": "serve.verify_step",
+    "prefill_chunk": "serve.prefill_chunk",
+    "sample_row": "serve.sample_row",
+    "prefix_block_in": "prefix.copy_block_in",
+    "prefix_block_out": "prefix.copy_block_out",
+    "draft_model": "serve.draft_model",
+}
+
+# Serve smoke geometry: 2 slots x 32 arena positions, chunk 8, k=3 —
+# the same scale tests/test_serve.py exercises.
+SERVE = dict(vocab=64, seq=64, layers=2, heads=2, d_model=32,
+             slots=2, max_len=32, chunk=8, k=3, blocks=4)
+# Train smoke geometry: a tiny conv-free net over 8x8x3 inputs on the
+# 8-virtual-device CPU mesh the tier-1 suite runs on.
+TRAIN = dict(input=(8, 8, 3), classes=4, batch=8, devices=8)
+
+
+def _tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from tpudp.models.gpt2 import GPT2, GPT2Config
+
+    cfg = GPT2Config(vocab_size=SERVE["vocab"], max_seq_len=SERVE["seq"],
+                     num_layers=SERVE["layers"], num_heads=SERVE["heads"],
+                     d_model=SERVE["d_model"])
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+    return cfg, params
+
+
+def _serve_args():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudp.models.generate import KVCache
+
+    s, m, k = SERVE["slots"], SERVE["max_len"], SERVE["k"]
+    cfg, params = _tiny_lm()
+    cache = KVCache.zeros(cfg, s, m)
+    host = dict(
+        last=np.zeros(s, np.int32), lens=np.zeros(s, np.int32),
+        active=np.zeros(s, bool), temps=np.zeros(s, np.float32),
+        topk=np.zeros(s, np.int32), topp=np.ones(s, np.float32),
+        keys=jnp.zeros((s, 2), jnp.uint32),
+        window=np.zeros((s, k + 1), np.int32),
+        ndraft=np.zeros(s, np.int32),
+        chunk=np.zeros((1, SERVE["chunk"]), np.int32),
+    )
+    return cfg, params, cache, host
+
+
+def build_programs() -> dict:
+    """name → (fn, args): every pinned program, ready for
+    ``jax.make_jaxpr(fn)(*args)``.  Insertion order is the lockfile
+    order."""
+    import numpy as np
+
+    from tpudp.models.generate import KVCache
+
+    programs: dict[str, tuple] = {}
+
+    # -- serve step programs (frozen-weight jits, engine.py) -----------
+    from tpudp.serve import engine as _engine
+
+    cfg, params, cache, h = _serve_args()
+    decode, verify, prefill = _engine._build_steps(cfg, params)
+    geo = f"s{SERVE['slots']}m{SERVE['max_len']}"
+    programs[f"serve.decode_step@{geo}"] = (
+        decode, (cache, h["last"], h["lens"], h["active"], h["temps"],
+                 h["topk"], h["topp"], h["keys"]))
+    programs[f"serve.verify_step@{geo}k{SERVE['k']}"] = (
+        verify, (cache, h["window"], h["lens"], h["active"], h["ndraft"],
+                 h["temps"], h["topk"], h["topp"], h["keys"]))
+    programs[f"serve.prefill_chunk@{geo}c{SERVE['chunk']}"] = (
+        prefill, (cache, np.int32(0), h["chunk"], np.int32(0),
+                  np.int32(SERVE["chunk"] - 1)))
+    programs["serve.sample_row@v%d" % SERVE["vocab"]] = (
+        _engine._sample_row,
+        (np.zeros((1, SERVE["vocab"]), np.float32), np.float32(0.0),
+         np.int32(0), np.float32(1.0), h["keys"][0]))
+
+    # -- prefix-cache block copies (prefix_cache.py) -------------------
+    from tpudp.serve import prefix_cache as _prefix
+
+    pool = KVCache.zeros(cfg, SERVE["blocks"], SERVE["chunk"])
+    pgeo = f"{geo}b{SERVE['blocks']}"
+    programs[f"prefix.copy_block_in@{pgeo}"] = (
+        _prefix.copy_block_in,
+        (cache, pool, np.int32(0), np.int32(0), np.int32(0)))
+    programs[f"prefix.copy_block_out@{pgeo}"] = (
+        _prefix.copy_block_out,
+        (cache, pool, np.int32(0), np.int32(0), np.int32(0)))
+
+    # -- speculative drafter program (speculate.py) --------------------
+    from tpudp.serve.speculate import _draft_greedy
+
+    ctx = 16
+    programs[f"serve.draft_model@ctx{ctx}k{SERVE['k']}"] = (
+        lambda p, t, n: _draft_greedy(cfg, p, t, n, SERVE["k"]),
+        (params, np.zeros((1, ctx), np.int32), np.int32(8)))
+
+    # -- train/eval step programs (train.py) ---------------------------
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from tpudp.mesh import make_mesh
+    from tpudp.train import (init_state, make_eval_step, make_optimizer,
+                             make_train_step)
+
+    class _TinyNet(nn.Module):
+        """Minimal image classifier — enough structure for the fused
+        fwd+loss+bwd+sync+update step to have its real shape."""
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16, name="fc1")(x))
+            return nn.Dense(TRAIN["classes"], name="fc2")(x)
+
+    model = _TinyNet()
+    tx = make_optimizer()
+    state = init_state(model, tx, input_shape=(1, *TRAIN["input"]))
+    b = TRAIN["batch"]
+    images = jnp.zeros((b, *TRAIN["input"]), jnp.float32)
+    labels = jnp.zeros((b,), jnp.int32)
+    weights = jnp.ones((b,), jnp.float32)
+
+    programs["train.step_single@tiny"] = (
+        make_train_step(model, tx, None), (state, images, labels))
+    mesh = make_mesh(TRAIN["devices"])
+    for sync in ("allreduce", "ring"):
+        programs[f"train.step_dp_{sync}@mesh{TRAIN['devices']}"] = (
+            make_train_step(model, tx, mesh, sync), (state, images, labels))
+    programs[f"train.eval_step@mesh{TRAIN['devices']}"] = (
+        make_eval_step(model, mesh), (state, images, labels, weights))
+    return programs

@@ -400,6 +400,10 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                 # the directory.
                 from jax.experimental import multihost_utils
 
+                # tpudp: lint-ok(divergent-collective): the branch
+                # condition is the OUTCOME of restore_emergency_voted —
+                # a collectively-agreed value, identical on every host
+                # by protocol, so all hosts take the same arm.
                 multihost_utils.sync_global_devices("tpudp_emergency_restore")
             if not args.eval_only and jax.process_index() == 0:
                 from tpudp.utils.checkpoint import consume_emergency

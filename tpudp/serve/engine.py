@@ -159,6 +159,7 @@ from jax import lax
 from tpudp.models.generate import (KVCache, _forward_cached,
                                    validate_decode_config)
 from tpudp.ops.sampling import sample_tokens, split_keys, verify_tokens
+from tpudp.utils.compile_cache import ProgramCache
 
 # Trace-time side-effect counters: each jitted step body bumps its entry
 # when (and only when) XLA traces it, so tests can assert the decode step
@@ -315,25 +316,14 @@ def _build_steps(cfg, params):
 # LRU of built step programs keyed by (cfg, id(params)): engines over
 # the same weights (the test/bench pattern — and any multi-engine
 # deployment of one model) share one set of compiled programs instead of
-# re-freezing the weights per Engine.  Entries hold a strong params ref,
-# which both bounds memory (LRU evicts) and makes the id() key safe (an
-# id can only be reused after the object it named was collected, and
-# ours can't be while the entry holds it; the `is` check then confirms).
-_STEP_CACHE: "collections.OrderedDict" = collections.OrderedDict()
-_STEP_CACHE_MAX = 8
+# re-freezing the weights per Engine.  The cache itself lives in
+# tpudp.utils.compile_cache (ProgramCache documents the id()-key safety
+# argument); the trace-stability audit pins its reuse semantics.
+_STEP_CACHE = ProgramCache(_build_steps, max_entries=8)
 
 
 def _engine_steps(cfg, params):
-    key = (cfg, id(params))
-    hit = _STEP_CACHE.get(key)
-    if hit is not None and hit[0] is params:
-        _STEP_CACHE.move_to_end(key)
-        return hit[1]
-    steps = _build_steps(cfg, params)
-    _STEP_CACHE[key] = (params, steps)
-    while len(_STEP_CACHE) > _STEP_CACHE_MAX:
-        _STEP_CACHE.popitem(last=False)
-    return steps
+    return _STEP_CACHE.get(cfg, params)
 
 
 class _ModelState:
@@ -366,6 +356,7 @@ def _sample_row(logits, temp, top_k, top_p, key):
     """First-token sample after a finished prefill: one row through the
     same masked-sampling op the decode step uses, advancing the slot's
     key chain exactly once."""
+    TRACE_COUNTS["sample_row"] += 1
     carry, sub = split_keys(key[None])
     tok = sample_tokens(logits, temp[None], top_k[None], top_p[None], sub)
     return tok[0], carry[0]
@@ -1280,6 +1271,9 @@ class Engine:
                 "sample", _sample_row, last_logits, self._temps[s],
                 self._topk[s], self._topp[s], self._keys[s])
             self._keys = self._keys.at[s].set(carry)
+            # tpudp: lint-ok(host-sync): the first-token commit IS a
+            # per-token round trip — the on-device decode loop rung
+            # (ROADMAP) exists to delete it.
             self._commit(s, int(tok), emitted)
 
     def _run_decode(self, ms: _ModelState, active, emitted) -> None:
@@ -1287,6 +1281,9 @@ class Engine:
             "decode", ms.decode_step,
             ms.cache, self._last, self._len, active, self._temps,
             self._topk, self._topp, self._keys)
+        # tpudp: lint-ok(host-sync): THE per-token host round trip — one
+        # fetch per batched decode step; the on-device decode loop rung
+        # (ROADMAP) replaces it with a fused lax.while_loop.
         toks = np.asarray(toks)
         self.stats["decode_steps"] += 1
         self.stats["active_slot_steps"] += int(active.sum())
@@ -1406,8 +1403,11 @@ class Engine:
             "verify", ms.verify_step,
             ms.cache, tokens, self._len, active, n_draft, self._temps,
             self._topk, self._topp, self._keys)
+        # tpudp: lint-ok(host-sync): the per-window verify fetch (one
+        # round trip per k+1-token window, amortized over accepts) —
+        # fusing the drafter into the device program removes it.
         out = np.asarray(out)
-        n_emit = np.asarray(n_emit)
+        n_emit = np.asarray(n_emit)  # tpudp: lint-ok(host-sync): same fetch
         self.stats["verify_steps"] += 1
         self.stats["active_slot_steps"] += int(active.sum())
         self.stats["draft_tokens"] += int(n_draft.sum())

@@ -1,4 +1,21 @@
-"""Persistent XLA compilation cache for the relay-gated TPU.
+"""Compiled-program caching: the in-process step-program LRU and the
+persistent XLA executable cache.
+
+Two layers, one module, because both answer the same question — "have
+we already paid for this compile?":
+
+  * :class:`ProgramCache` — in-process LRU of BUILT jitted step
+    programs keyed by ``(cfg, id(params))``.  The serve engine freezes
+    weights into its step programs as compile-time constants (PR 2);
+    without this cache every Engine over the same weight tree would
+    re-freeze (and re-compile) its own copies.  The trace-stability
+    audit (tpudp/analysis) leans on these semantics: programs are
+    reused per (config, params identity), so admission/retirement churn
+    and co-resident engines can never mint new traces.
+  * :func:`enable_persistent_cache` — JAX's on-disk executable cache
+    for the relay-gated TPU (below).
+
+Persistent XLA compilation cache for the relay-gated TPU.
 
 The axon relay gives short, unpredictable windows of TPU health
 (BASELINE.md "relay outage" note); the dominant cost inside a window is
@@ -24,7 +41,55 @@ compile with a warning.  The reference has no analogue (eager torch
 compiles nothing); this is TPU-runtime machinery.
 """
 
+import collections
 import os
+
+
+class ProgramCache:
+    """LRU of built (jitted) programs keyed by ``(cfg, id(params))``.
+
+    ``build(cfg, params)`` runs on a miss; its result is cached and
+    returned as-is on later hits.  Entries hold a STRONG reference to
+    ``params``, which both bounds memory (the LRU evicts whole entries,
+    weights included) and makes the ``id()`` key safe: an id can only
+    be reused after the object it named was collected, and ours can't
+    be collected while the entry holds it — the ``is`` check then
+    confirms the identity on every hit.
+
+    ``cfg`` must be hashable (the model configs are frozen dataclasses).
+    Eviction is LRU over GETS, not builds: the hottest (cfg, params)
+    pairs survive a parade of one-shot engines.
+    """
+
+    def __init__(self, build, max_entries: int = 8):
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self._build = build
+        self.max_entries = max_entries
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self.builds = 0
+        self.hits = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, cfg, params):
+        key = (cfg, id(params))
+        hit = self._entries.get(key)
+        if hit is not None and hit[0] is params:
+            self.hits += 1
+            self._entries.move_to_end(key)
+            return hit[1]
+        programs = self._build(cfg, params)
+        self.builds += 1
+        self._entries[key] = (params, programs)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+        return programs
+
+    def clear(self) -> None:
+        self._entries.clear()
+
 
 # Inside the repo (the environment forbids writes elsewhere) and inside
 # bench_results/ (gitignored by the `bench_results/*` rule).
