@@ -38,6 +38,21 @@ sequential baseline produces for the same request (pinned by
 tests/test_serve.py and tests/test_speculate.py) — all columns measure
 the SAME work.
 
+With ``--decode-fuse N1,N2`` (or SERVE_DECODE_FUSE) the bench instead
+emits one ``serve_fused`` row per window size N: the SAME greedy
+pure-decode workload through an ``Engine(decode_fuse=N)`` — whose
+scheduler dispatches ONE ``lax.while_loop`` program running up to N
+decode steps on device per host round trip — and through the
+single-step engine.  Each row reports host-dispatches-per-decoded-token
+for both (the fused engine's must land within ``1/N x (1 + eps)`` —
+``dispatch_ok``, the gate the resume machinery keys on), tokens/sec
+for both with the headline ``value`` = fused tokens/sec, and the
+in-bench ``parity_ok`` (fused outputs bit-identical to single-step).
+``N=1`` is the single-step control row.  The workload defaults to one
+in-flight request (SERVE_FUSED_CONCURRENCY) — dispatch overhead per
+token is largest at the smallest batch, the regime the fused loop
+exists for (ROADMAP "On-device decode loop").
+
 With ``--queue-limit N`` (or SERVE_QUEUE_LIMIT) the sweep also exercises
 the robustness layer's bounded admission: submits past the limit are
 shed with a typed ``QueueFull`` (counted per row in ``shed``) instead of
@@ -93,6 +108,8 @@ smoke mode (tier-1 runs it at a trimmed geometry).  Knobs: SERVE_CONCURRENCY
 (comma-separated subset of the registered levels — the watcher's
 gap-resume path), SERVE_SPECULATE_K (same, for the spec rows),
 SERVE_SOAK (same, for the soak rows),
+SERVE_DECODE_FUSE (same, for the fused-decode rows),
+SERVE_FUSED_CONCURRENCY,
 SERVE_PREFIX (same, for the prefix rows), SERVE_SPEC_CONCURRENCY,
 SERVE_REQUESTS, SERVE_PROMPT_LEN, SERVE_MAX_NEW, SERVE_LAYERS,
 SERVE_DMODEL, SERVE_VOCAB, SERVE_CHUNK, SERVE_LOAD, SERVE_SEED,
@@ -114,14 +131,21 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
-                              SERVE_PREFIX_WORKLOADS, SERVE_SOAK_SEEDS,
-                              SERVE_SPEC_KS, SERVE_TENANCY_SEEDS)
+                              SERVE_FUSED_NS, SERVE_PREFIX_WORKLOADS,
+                              SERVE_SOAK_SEEDS, SERVE_SPEC_KS,
+                              SERVE_TENANCY_SEEDS)
 
 METRIC = "serve_tokens_per_sec"
 SPEC_METRIC = "serve_spec_tokens_per_sec"
 SOAK_METRIC = "serve_soak"
 PREFIX_METRIC = "serve_prefix"
 TENANCY_METRIC = "serve_tenancy"
+FUSED_METRIC = "serve_fused"
+
+#: Slack on the fused dispatch gate: staggered prefill completions pay
+#: a few single-step decodes before the first window, so the measured
+#: host-dispatches-per-decoded-token sits slightly above the ideal 1/N.
+FUSED_DISPATCH_EPS = 0.25
 
 
 def _percentile(xs, q):
@@ -142,6 +166,12 @@ def main() -> None:
                     help="comma-separated speculation depths; emits "
                          "speculative-vs-baseline rows instead of the "
                          "concurrency sweep (env: SERVE_SPECULATE_K)")
+    ap.add_argument("--decode-fuse", default=None,
+                    help="comma-separated fused decode window sizes; "
+                         "emits host-dispatches-per-token + tokens/sec "
+                         "rows for the on-device lax.while_loop decode "
+                         "program vs the single-step engine "
+                         "(env: SERVE_DECODE_FUSE)")
     ap.add_argument("--soak", default=None,
                     help="comma-separated soak seeds; runs the "
                          "fault-injection soak harness instead of the "
@@ -182,6 +212,8 @@ def main() -> None:
 
     spec_env = args.speculate_k or os.environ.get("SERVE_SPECULATE_K")
     spec_ks = _parse_levels(spec_env) if spec_env else []
+    fused_env = args.decode_fuse or os.environ.get("SERVE_DECODE_FUSE")
+    fused_ns = _parse_levels(fused_env) if fused_env else []
     soak_env = args.soak or os.environ.get("SERVE_SOAK")
     soak_seeds = _parse_levels(soak_env) if soak_env else []
     tenancy_env = args.tenants or os.environ.get("SERVE_TENANCY")
@@ -202,13 +234,17 @@ def main() -> None:
     if os.environ.get("SERVE_STRICT_LEVELS") == "1":
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
         if (not spec_ks and not soak_seeds and not prefix_workloads
-                and not tenancy_seeds and bad):
+                and not tenancy_seeds and not fused_ns and bad):
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
         bad_k = [k for k in spec_ks if k not in SERVE_SPEC_KS]
         if bad_k:
             raise SystemExit(f"error: unregistered speculate_k values "
                              f"{bad_k} (registry: {list(SERVE_SPEC_KS)})")
+        bad_n = [n for n in fused_ns if n not in SERVE_FUSED_NS]
+        if bad_n:
+            raise SystemExit(f"error: unregistered decode_fuse sizes "
+                             f"{bad_n} (registry: {list(SERVE_FUSED_NS)})")
         bad_s = [s for s in soak_seeds if s not in SERVE_SOAK_SEEDS]
         if bad_s:
             raise SystemExit(f"error: unregistered soak seeds {bad_s} "
@@ -231,6 +267,11 @@ def main() -> None:
     # untrained greedy LM collapses into dominates the run.
     spec_conc = int(os.environ.get("SERVE_SPEC_CONCURRENCY", 1))
     spec_max_new = int(os.environ.get("SERVE_SPEC_MAX_NEW", 64))
+    # The fused decode loop's home regime is the same LOW-occupancy one:
+    # dispatch overhead per token is largest when the batch is smallest
+    # (ROADMAP "On-device decode loop"), so the fused rows default to
+    # one in-flight request and measure dispatch mechanics.
+    fused_conc = int(os.environ.get("SERVE_FUSED_CONCURRENCY", 1))
     # Robustness axes for the concurrency sweep: a bounded queue (sheds
     # counted per row) and optional per-request deadline budgets.
     ql_env = args.queue_limit or os.environ.get("SERVE_QUEUE_LIMIT")
@@ -360,7 +401,7 @@ def main() -> None:
     seq_tps = per_req_s = None
     seq_latencies = []
     if (not spec_ks and not soak_seeds and not prefix_workloads
-            and not tenancy_seeds):
+            and not tenancy_seeds and not fused_ns):
         np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
                             max_new))
         t0 = time.perf_counter()
@@ -498,6 +539,108 @@ def main() -> None:
             "requests": n_requests,
             "prompt_len": prompt_len,
             "max_new_tokens": spec_max_new,
+            "prefill_chunk": chunk,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        })
+
+    # The fused sweep's single-step baseline, measured lazily once and
+    # shared by every run_fused row (see its docstring).
+    fused_shared: dict = {}
+
+    def run_fused(n: int) -> None:
+        """Fused-decode-window row: the IDENTICAL greedy pure-decode
+        workload through a ``decode_fuse=n`` engine and a single-step
+        engine (bit-identical outputs — ``parity_ok`` is the row's own
+        check), reporting host-dispatches-per-decoded-token and
+        tokens/sec for both.  Requests run ``fused_conc`` at a time
+        with the queue kept empty, so once prefill drains every
+        scheduler iteration is a pure-decode step — the regime where
+        the single-step engine pays one host round trip per token and
+        the fused engine pays one per up-to-n-token window.  The
+        ``dispatch_ok`` gate (<= 1/n x (1 + eps)) is what the resume
+        machinery keys on: a fused run that still dispatched per token
+        proved the loop never engaged.  ``n=1`` is the single-step
+        control row (the fused program is never built).
+
+        The single-step baseline is measured ONCE per sweep and shared
+        across rows (the workload is a pure function of the seed, so
+        every row compares against the identical run) — re-measuring
+        the same engine per N would only burn the relay window, the
+        same sharing rationale as run_spec's shared zero tree."""
+        frng = np.random.default_rng(seed + 4)
+        f_prompts = [frng.integers(0, cfg.vocab_size, size=prompt_len)
+                     .astype(np.int32) for _ in range(n_requests)]
+
+        def run(engine):
+            # Warmup compiles prefill/sample/decode — and, for n > 1,
+            # the fused window program — off the clock.
+            engine.generate_many([f_prompts[0]], 2)
+            base_stats = dict(engine.stats)
+            outputs = []
+            t0 = time.perf_counter()
+            for i in range(0, n_requests, fused_conc):
+                batch = f_prompts[i:i + fused_conc]
+                handles = [engine.submit(p, max_new, seed=seed + i + j)
+                           for j, p in enumerate(batch)]
+                engine.run_until_complete()
+                outputs.extend(h.tokens for h in handles)
+            elapsed = time.perf_counter() - t0
+            st = engine.stats
+            decoded = (st["tokens"] - base_stats.get("tokens", 0)
+                       - n_requests)  # first tokens ride the prefill sample
+            dispatches = (st["decode_steps"]
+                          - base_stats.get("decode_steps", 0)
+                          + st["fused_windows"]
+                          - base_stats.get("fused_windows", 0))
+            tokens = st["tokens"] - base_stats.get("tokens", 0)
+            return dict(
+                elapsed=elapsed, outputs=outputs, tokens=tokens,
+                decoded=decoded, dispatches=dispatches,
+                fused_windows=(st["fused_windows"]
+                               - base_stats.get("fused_windows", 0)),
+                fused_steps=(st["fused_steps"]
+                             - base_stats.get("fused_steps", 0)))
+
+        if "base" not in fused_shared:
+            fused_shared["base"] = run(
+                Engine(model, params, num_slots=fused_conc,
+                       max_len=cfg.max_seq_len, prefill_chunk=chunk))
+        base = fused_shared["base"]
+        fused = run(Engine(model, params, num_slots=fused_conc,
+                           max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                           decode_fuse=n))
+        dpt = (fused["dispatches"] / fused["decoded"]
+               if fused["decoded"] else None)
+        bound = (1.0 / n) * (1.0 + FUSED_DISPATCH_EPS)
+        tps = (fused["tokens"] / fused["elapsed"]
+               if fused["elapsed"] > 0 else 0.0)
+        base_tps = (base["tokens"] / base["elapsed"]
+                    if base["elapsed"] > 0 else 0.0)
+        emit({
+            "metric": FUSED_METRIC,
+            "decode_fuse": n,
+            "concurrency": fused_conc,
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            "host_dispatches_per_token": (round(dpt, 4)
+                                          if dpt is not None else None),
+            "dispatch_bound": round(bound, 4),
+            "dispatch_ok": dpt is not None and dpt <= bound,
+            "fused_windows": fused["fused_windows"],
+            "fused_steps": fused["fused_steps"],
+            "single_step_tokens_per_sec": round(base_tps, 1),
+            "single_step_dispatches_per_token": (
+                round(base["dispatches"] / base["decoded"], 4)
+                if base["decoded"] else None),
+            "speedup_vs_single_step": (round(tps / base_tps, 3)
+                                       if base_tps else None),
+            "parity_ok": fused["outputs"] == base["outputs"],
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new,
             "prefill_chunk": chunk,
             "num_layers": cfg.num_layers,
             "d_model": cfg.d_model,
@@ -973,6 +1116,15 @@ def main() -> None:
                 emit({"metric": PREFIX_METRIC, "workload": w,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
         print(json.dumps({"serve_prefix": results}))
+        return
+    if fused_ns:
+        for n in fused_ns:
+            try:
+                run_fused(n)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": FUSED_METRIC, "decode_fuse": n,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        print(json.dumps({"serve_fused": results}))
         return
     if spec_ks:
         # One zero tree for the whole sweep: a fresh tree per k would

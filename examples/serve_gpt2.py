@@ -25,6 +25,11 @@ this demo is about throughput and interleaving, not different text.
   # resumes and finishes bit-identically (first listed = highest tier):
   python examples/serve_gpt2.py --tenants high:2,low:6 --platform cpu
 
+  # Fused on-device decode loop: pure-decode steps run up to N decode
+  # iterations in ONE lax.while_loop program — one host round trip per
+  # window instead of per token (outputs bit-identical either way):
+  python examples/serve_gpt2.py --decode-fuse 8 --platform cpu
+
   # Restore a train_gpt2.py checkpoint (params-only, like generate_gpt2):
   python examples/serve_gpt2.py --checkpoint-dir ckpt --layers 4 ...
 
@@ -81,6 +86,12 @@ def main() -> None:
                         "high tier preempts low in-flight slots and "
                         "every preempted request resumes bit-identically "
                         "(overrides --requests)")
+    p.add_argument("--decode-fuse", type=int, default=1,
+                   help="fused on-device decode loop: run up to N decode "
+                        "steps per host dispatch through one "
+                        "lax.while_loop program on pure-decode scheduler "
+                        "iterations (1 = off; output is identical either "
+                        "way)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", type=str, default=None)
     args = p.parse_args()
@@ -112,6 +123,9 @@ def main() -> None:
     if args.prefix_cache_blocks < 0:
         raise SystemExit(f"error: --prefix-cache-blocks must be >= 0 "
                          f"(got {args.prefix_cache_blocks})")
+    if args.decode_fuse < 1:
+        raise SystemExit(f"error: --decode-fuse must be >= 1 "
+                         f"(got {args.decode_fuse})")
 
     if args.platform:
         import jax
@@ -171,6 +185,7 @@ def main() -> None:
                                            args.seq_len),
                     speculate_k=args.speculate_k,
                     prefix_cache_blocks=args.prefix_cache_blocks,
+                    decode_fuse=args.decode_fuse,
                     tenants=tenants)
 
     # Mixed-length prompts from the training examples' deterministic
@@ -215,8 +230,12 @@ def main() -> None:
             print(f"[serve] tenant {name}: submitted={st['submitted']} "
                   f"preempted={st['preempted']} tokens={st['tokens']}")
     total = sum(len(h.tokens) for h in handles)
+    # Every fused loop iteration is one batched decode over the arena
+    # (fused_steps counts them; 0 with --decode-fuse 1), so occupancy
+    # stays meaningful when fusing replaces single decode steps.
     batched_steps = (engine.stats["decode_steps"]
-                     + engine.stats["verify_steps"])
+                     + engine.stats["verify_steps"]
+                     + engine.stats["fused_steps"])
     occ = (engine.stats["active_slot_steps"]
            / max(batched_steps * args.num_slots, 1))
     spec = ""
@@ -230,6 +249,10 @@ def main() -> None:
                  f"{engine.stats['prefix_hit_tokens']} "
                  f"(pool {engine.prefix_cache.used_blocks}"
                  f"/{args.prefix_cache_blocks} blocks)")
+    if args.decode_fuse > 1:
+        spec += (f" | fused windows={engine.stats['fused_windows']} "
+                 f"({engine.stats['fused_steps']} on-device decode "
+                 f"steps — one host dispatch per window)")
     print(f"[serve] {len(handles)} requests, {total} tokens in {dt:.3f}s "
           f"({total / dt:.1f} tokens/sec incl. compile) | "
           f"decode steps={engine.stats['decode_steps']} "

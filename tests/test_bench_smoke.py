@@ -260,6 +260,76 @@ def test_serve_prefix_gap_gate(tmp_path):
     assert serve_prefix_missing(d) == []  # banked history row counts
 
 
+def test_serve_fused_bench_rows_parse():
+    """The serve_fused stage's CPU smoke (tier-1's guard on the
+    fused-decode bench the TPU watcher resumes): every registered
+    window size emits a parseable row with bit-exact parity against
+    the single-step engine and the host dispatch count actually
+    amortized (dispatch_ok — per-token for N=1, <= 1/N x 1.25 for the
+    fused rows, with real fused windows recorded)."""
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu",
+        "SERVE_DECODE_FUSE": "1,4,8",
+        "SERVE_LAYERS": "1", "SERVE_DMODEL": "64", "SERVE_VOCAB": "128",
+        "SERVE_REQUESTS": "3", "SERVE_MAX_NEW": "17", "SERVE_CHUNK": "8",
+        "SERVE_PROMPT_LEN": "8",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byn = {r["decode_fuse"]: r for r in rows
+           if r.get("metric") == "serve_fused" and "decode_fuse" in r}
+    assert set(byn) == {1, 4, 8}, proc.stderr[-800:]
+    for n, r in byn.items():
+        assert "error" not in r, r
+        assert r["value"] > 0
+        assert r["parity_ok"] is True   # bit-exact vs the single-step run
+        assert r["dispatch_ok"] is True
+        assert r["host_dispatches_per_token"] <= (1 / n) * 1.25
+    assert byn[1]["fused_windows"] == 0   # N=1 never builds the program
+    for n in (4, 8):
+        assert byn[n]["fused_windows"] > 0   # the loop actually engaged
+        assert byn[n]["fused_steps"] >= byn[n]["fused_windows"]
+    # unregistered window sizes fail fast, like the spec-k registry
+    bad = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu", "SERVE_DECODE_FUSE": "7",
+        "SERVE_STRICT_LEVELS": "1"}, timeout=300)
+    assert bad.returncode != 0
+    assert "decode_fuse" in (bad.stderr + bad.stdout)
+
+
+def test_serve_fused_gap_gate(tmp_path):
+    """tools/bench_gaps serve_fused stage: CPU smoke rows, error rows,
+    parity-broken rows, and dispatch-bound-blown rows never close a
+    window size; banked TPU rows that passed both gates do (the
+    watcher's window-accumulation contract, same rules as the
+    serve_spec stage)."""
+    from tools.bench_gaps import SERVE_FUSED_NS, serve_fused_missing
+
+    d = str(tmp_path)
+    assert serve_fused_missing(d) == list(SERVE_FUSED_NS)
+    ok = {"metric": "serve_fused", "value": 9000.0, "parity_ok": True,
+          "dispatch_ok": True}
+    rows = [
+        {**ok, "decode_fuse": 1, "device_kind": "cpu"},   # smoke: no
+        {"metric": "serve_fused", "decode_fuse": 4,
+         "error": "relay wedged"},                        # error: no
+        {**ok, "decode_fuse": 4, "parity_ok": False,
+         "device_kind": "TPU v5 lite"},                   # parity: no
+        {**ok, "decode_fuse": 8, "dispatch_ok": False,
+         "device_kind": "TPU v5 lite"},                   # dispatch: no
+        {**ok, "decode_fuse": 1, "device_kind": "TPU v5 lite"},  # yes
+    ]
+    with open(os.path.join(d, "serve_fused.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_fused_missing(d) == [4, 8]
+    with open(os.path.join(d, "serve_fused.history.jsonl"), "w") as f:
+        f.write(json.dumps(
+            {**ok, "decode_fuse": 8,
+             "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_fused_missing(d) == [4]  # banked history row counts
+
+
 def test_serve_tenancy_bench_row_parses():
     """The serve_tenancy stage's CPU smoke (tier-1's guard on the
     multi-tenant bench the TPU watcher resumes): at a trimmed geometry

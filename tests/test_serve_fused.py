@@ -1,0 +1,486 @@
+"""The fused on-device decode loop (``Engine(decode_fuse=N)``) and its
+fall-back seam.
+
+The contract under test: a fused ``lax.while_loop`` window is
+bit-identical to running its iterations as single decode steps — for
+greedy, sampled, prefix-cached, and multi-tenant/preempted traffic —
+and every host intervention (admission, retirement, deadline expiry,
+preemption, step failure, cancellation) lands at a window edge with
+committed tokens, per-slot PRNG chains, and arena positions carried
+over exactly.  ``decode_fuse=1`` (the default) is byte-for-byte the
+single-step engine, stats schema and trace counts included.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudp.models.generate import generate
+from tpudp.models.gpt2 import gpt2_small
+from tpudp.serve import Engine, FinishReason, TenantClass, TRACE_COUNTS
+from tpudp.serve.faults import FaultySteps
+from tpudp.train import init_state, make_optimizer
+
+TINY = dict(vocab_size=61, max_seq_len=64, num_layers=2, num_heads=2,
+            d_model=32)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = gpt2_small(**TINY)
+    state = init_state(model, make_optimizer(), input_shape=(1, 8))
+    return model, state.params
+
+
+def _reference(model, params, prompt, n):
+    return np.asarray(generate(model, params, jnp.asarray(prompt[None]), n))
+
+
+def test_greedy_parity_fused_vs_generate(model_and_params):
+    """Staggered admissions through a fused engine: queued work forces
+    single-step fall-backs, an emptied queue lets windows engage, and
+    every output must still equal standalone generate()."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 61, size=n).astype(np.int32)
+               for n in (5, 19, 3, 9, 24)]
+    max_new = [16, 4, 8, 5, 7]
+
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+                 decode_fuse=4)
+    handles = [eng.submit(prompts[0], max_new[0])]
+    eng.step()
+    eng.step()
+    handles.append(eng.submit(prompts[1], max_new[1]))
+    handles.append(eng.submit(prompts[2], max_new[2]))
+    eng.step()
+    handles.append(eng.submit(prompts[3], max_new[3]))
+    handles.append(eng.submit(prompts[4], max_new[4]))
+    eng.run_until_complete()
+
+    for p, n, h in zip(prompts, max_new, handles):
+        ref = _reference(model, params, p, n)
+        got = np.concatenate([p, np.asarray(h.tokens, np.int32)])
+        np.testing.assert_array_equal(ref[0], got)
+    assert eng.stats["completed"] == 5
+    assert eng.stats["fused_windows"] > 0     # the loop actually engaged
+    assert eng.stats["decode_steps"] > 0      # and fell back when it had to
+
+
+def test_sampled_parity_fused_vs_single_step(model_and_params):
+    """Sampled requests (temperature/top-k/top-p, per-request seeds)
+    through decode_fuse=4 emit token-for-token what decode_fuse=1 emits:
+    the loop advances each slot's PRNG chain exactly once per own
+    committed token, same as the single-step path."""
+    model, params = model_and_params
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 61, size=n).astype(np.int32)
+               for n in (5, 12, 7)]
+
+    def run(fuse):
+        eng = Engine(model, params, num_slots=2, max_len=48,
+                     prefill_chunk=8, decode_fuse=fuse)
+        handles = [eng.submit(p, 9, temperature=0.9, top_k=12, top_p=0.9,
+                              seed=7 + i) for i, p in enumerate(prompts)]
+        eng.run_until_complete()
+        return [h.tokens for h in handles]
+
+    assert run(4) == run(1)
+
+
+def test_eos_early_exit_mid_window(model_and_params):
+    """A slot sampling its eos_id mid-window stops committing there (the
+    loop predicate exits once every running slot is done) and the
+    request retires with EOS exactly as the single-step engine would."""
+    model, params = model_and_params
+    # An eos value whose FIRST occurrence lands strictly inside the
+    # decode window (not the prefill-sampled first token, not the
+    # window's last iteration) — scan prompts until one qualifies
+    # (greedy sequences from random weights can collapse to loops).
+    for seed in range(4, 30):
+        rng = np.random.default_rng(seed)
+        p = rng.integers(0, 61, size=5).astype(np.int32)
+        ref = _reference(model, params, p, 16)[0, 5:]
+        firsts: dict[int, int] = {}
+        for i, t in enumerate(ref):
+            firsts.setdefault(int(t), i)
+        cands = sorted((i, t) for t, i in firsts.items() if 2 <= i <= 10)
+        if cands:
+            first, eos = cands[0]
+            break
+    else:
+        pytest.fail("no prompt produced a mid-window eos candidate")
+
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 decode_fuse=16)
+    h = eng.submit(p, 16, eos_id=eos)
+    eng.run_until_complete()
+    assert h.finish_reason is FinishReason.EOS
+    assert h.tokens == ref[:first + 1].tolist()
+    # Early exit: the window never ran its full 16 iterations.
+    assert 0 < eng.stats["fused_steps"] < 16
+
+
+def test_budget_at_window_edges(model_and_params):
+    """max_new_tokens landing exactly on and just past a window edge
+    both retire COMPLETE with exactly the budgeted tokens."""
+    model, params = model_and_params
+    rng = np.random.default_rng(5)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+    for max_new in (9, 10):  # 1 prefill-sample + 8 / 9 decode tokens, N=4
+        eng = Engine(model, params, num_slots=1, max_len=48,
+                     prefill_chunk=8, decode_fuse=4)
+        h = eng.submit(p, max_new)
+        eng.run_until_complete()
+        assert h.finish_reason is FinishReason.COMPLETE
+        assert h.tokens == _reference(model, params, p,
+                                      max_new)[0, 5:].tolist()
+
+
+def test_deadline_detected_at_window_edge(model_and_params):
+    """A deadline passing DURING a fused window is detected at the next
+    host touch: the request retires DEADLINE with its committed tokens
+    on the handle and the overshoot bounded by one window."""
+    model, params = model_and_params
+    rng = np.random.default_rng(6)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+    eng = Engine(model, params, num_slots=1, max_len=64, prefill_chunk=8,
+                 decode_fuse=4)
+    h = eng.submit(p, 40)
+    while not h.tokens:
+        eng.step()
+    # Arm a deadline that expires essentially now: the next step's
+    # window may still run (expiry lands mid-window), but the step
+    # after must retire the request.
+    h.deadline_s = (time.perf_counter() - h.submit_time) + 1e-4
+    emitted_at_arm = len(h.tokens)
+    eng.step()
+    after_one = len(h.tokens)
+    eng.step()
+    assert h.done and h.finish_reason is FinishReason.DEADLINE
+    # Overshoot past the armed deadline is at most ONE fused window.
+    assert after_one - emitted_at_arm <= 4
+    assert len(h.tokens) == after_one  # nothing committed after expiry
+    assert eng.stats["deadline_expired"] == 1
+    # The tokens that did land are still bit-exact generate() prefixes.
+    ref = _reference(model, params, p, 40)[0, 5:]
+    assert h.tokens == ref[:len(h.tokens)].tolist()
+
+
+def test_admission_falls_back_and_resumes_bit_exactly(model_and_params):
+    """A submit landing between fused windows forces the single-step
+    path (admission + prefill); the interrupted request's remaining
+    tokens continue bit-identically — the window's carry IS the
+    single-step state."""
+    model, params = model_and_params
+    rng = np.random.default_rng(7)
+    p0 = rng.integers(0, 61, size=5).astype(np.int32)
+    p1 = rng.integers(0, 61, size=9).astype(np.int32)
+
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+                 decode_fuse=4)
+    h0 = eng.submit(p0, 14, temperature=1.1, top_k=9, seed=3)
+    eng.step()
+    eng.step()  # h0 runs fused windows alone
+    assert eng.stats["fused_windows"] > 0
+    h1 = eng.submit(p1, 6)
+    eng.run_until_complete()
+    # h0's sampled stream depends only on its own seed/chain: identical
+    # to an uninterrupted decode_fuse=1 run.
+    solo = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8)
+    ref0 = solo.submit(p0, 14, temperature=1.1, top_k=9, seed=3)
+    solo.run_until_complete()
+    assert h0.tokens == ref0.tokens
+    np.testing.assert_array_equal(
+        _reference(model, params, p1, 6)[0, 9:], np.asarray(h1.tokens))
+
+
+def test_preemption_takes_effect_at_next_host_touch(model_and_params):
+    """Tenancy + fused windows: a high-priority submit between windows
+    preempts the fused low-tier slot at the next host touch; the
+    preempted request resumes with tokens + PRNG chain carried over and
+    finishes bit-identically (greedy AND sampled)."""
+    model, params = model_and_params
+    rng = np.random.default_rng(8)
+    p_low = rng.integers(0, 61, size=5).astype(np.int32)
+    p_hi = rng.integers(0, 61, size=7).astype(np.int32)
+
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 decode_fuse=4,
+                 tenants={"low": TenantClass(priority=0),
+                          "high": TenantClass(priority=1)})
+    h_low = eng.submit(p_low, 12, temperature=0.8, top_p=0.95, seed=11,
+                       tenant="low")
+    eng.step()
+    eng.step()  # low runs fused alone
+    assert eng.stats["fused_windows"] > 0
+    h_hi = eng.submit(p_hi, 4, tenant="high")
+    eng.run_until_complete()
+    assert eng.stats["preempted"] == 1 and h_low.preemptions == 1
+    assert h_low.finish_reason is FinishReason.COMPLETE
+    np.testing.assert_array_equal(
+        _reference(model, params, p_hi, 4)[0, 7:], np.asarray(h_hi.tokens))
+    solo = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8)
+    ref = solo.submit(p_low, 12, temperature=0.8, top_p=0.95, seed=11)
+    solo.run_until_complete()
+    assert h_low.tokens == ref.tokens
+
+
+def test_step_failure_during_fused_window_contained(model_and_params):
+    """An exception escaping the fused device call is contained exactly
+    like a single-step failure: arena rebuilt, the in-flight request
+    requeued once with tokens + PRNG carried over, and the retry
+    continues bit-identically."""
+    model, params = model_and_params
+    rng = np.random.default_rng(9)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+
+    class FailNthFused:
+        def __init__(self, nth):
+            self.nth = nth
+            self.seen = 0
+
+        def __call__(self, kind, idx):
+            if kind == "fused_decode":
+                self.seen += 1
+                if self.seen == self.nth:
+                    raise RuntimeError("injected fused fault")
+
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 decode_fuse=4, step_fault_hook=FailNthFused(2))
+    h = eng.submit(p, 12, temperature=0.7, seed=5)
+    eng.run_until_complete()
+    assert eng.stats["step_failures"] == 1 and eng.stats["requeued"] == 1
+    assert h.finish_reason is FinishReason.COMPLETE
+    solo = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8)
+    ref = solo.submit(p, 12, temperature=0.7, seed=5)
+    solo.run_until_complete()
+    assert h.tokens == ref.tokens
+
+
+def test_containment_mid_replay_keeps_prng_consistent(model_and_params):
+    """A failure raised DURING the window's host replay — a pending
+    watchdog hang surfacing in a mid-replay retirement's prefix publish
+    — must requeue every slot with its PRNG chain matching its
+    COMMITTED tokens: a slot whose replay had not run yet resumes from
+    its pre-window chain with zero window tokens, never from the
+    window-final carry (which would skip it ahead of its stream)."""
+    from tpudp.utils.watchdog import StepHangError
+
+    model, params = model_and_params
+    rng = np.random.default_rng(17)
+    p0 = rng.integers(0, 61, size=8).astype(np.int32)   # one full chunk
+    p1 = rng.integers(0, 61, size=8).astype(np.int32)
+
+    class HangAtPublish:
+        def __init__(self):
+            self.fired = False
+
+        def __call__(self, kind, idx):
+            if kind == "prefix_out" and not self.fired:
+                self.fired = True
+                raise StepHangError("injected hang at publish")
+
+    hook = HangAtPublish()
+    eng = Engine(model, params, num_slots=2, max_len=64, prefill_chunk=8,
+                 decode_fuse=4, prefix_cache_blocks=8,
+                 step_fault_hook=hook)
+    # Slot 0 finishes inside a fused window (retire -> publish raises,
+    # containment interrupts the replay BEFORE slot 1's commits); slot 1
+    # is sampled, so a key chain ahead of its committed tokens would
+    # visibly diverge its stream on resume.
+    h0 = eng.submit(p0, 3)
+    h1 = eng.submit(p1, 12, temperature=0.9, top_k=12, seed=21)
+    eng.run_until_complete()
+    assert hook.fired and eng.stats["step_failures"] == 1
+    assert h0.finish_reason is FinishReason.COMPLETE
+    assert h1.finish_reason is FinishReason.COMPLETE
+    np.testing.assert_array_equal(
+        _reference(model, params, p0, 3)[0, 8:], np.asarray(h0.tokens))
+    solo = Engine(model, params, num_slots=2, max_len=64, prefill_chunk=8)
+    ref1 = solo.submit(p1, 12, temperature=0.9, top_k=12, seed=21)
+    solo.run_until_complete()
+    assert h1.tokens == ref1.tokens
+
+
+def test_cancel_between_windows_frees_slot(model_and_params):
+    model, params = model_and_params
+    rng = np.random.default_rng(10)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 decode_fuse=4)
+    h = eng.submit(p, 30)
+    eng.step()
+    eng.step()
+    assert not h.done and eng.stats["fused_windows"] > 0
+    assert h.cancel()
+    assert h.finish_reason is FinishReason.CANCELLED
+    q = eng.submit(rng.integers(0, 61, size=4).astype(np.int32), 3)
+    eng.run_until_complete()
+    assert q.done and len(q.tokens) == 3
+
+
+def test_prefix_cached_traffic_parity(model_and_params):
+    """Prefix-cache hits + fused windows: the cached engine's outputs
+    stay bit-identical to generate(), publishes still fire at
+    retirement (a host-touch event), and windows actually ran."""
+    model, params = model_and_params
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, 61, size=16).astype(np.int32)
+    tails = [rng.integers(0, 61, size=4).astype(np.int32)
+             for _ in range(3)]
+    eng = Engine(model, params, num_slots=1, max_len=64, prefill_chunk=8,
+                 decode_fuse=4, prefix_cache_blocks=8)
+    for t in tails:
+        p = np.concatenate([shared, t])
+        h = eng.submit(p, 8)
+        eng.run_until_complete()
+        np.testing.assert_array_equal(
+            _reference(model, params, p, 8)[0, p.size:],
+            np.asarray(h.tokens))
+    assert eng.stats["prefix_hit_tokens"] > 0
+    assert eng.stats["fused_windows"] > 0
+
+
+def test_speculative_engine_never_fuses(model_and_params):
+    """speculate_k > 0 with a live drafter keeps the verify path —
+    fused windows engage only after a quarantine turns the engine into
+    a pure-decode machine; outputs stay bit-exact throughout."""
+    from tpudp.serve import NgramDrafter
+    from tpudp.serve.faults import FailingDrafter
+
+    model, params = model_and_params
+    rng = np.random.default_rng(12)
+    rep = np.tile(rng.integers(0, 61, size=3), 4)[:9].astype(np.int32)
+
+    live = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                  speculate_k=2, drafter=NgramDrafter(max_ngram=3,
+                                                      min_ngram=2),
+                  decode_fuse=4)
+    out = live.generate_many([rep], 8)
+    assert live.stats["fused_windows"] == 0  # verify path owned the run
+    np.testing.assert_array_equal(_reference(model, params, rep, 8)[0],
+                                  out[0])
+
+    dying = Engine(model, params, num_slots=1, max_len=48,
+                   prefill_chunk=8, speculate_k=2,
+                   drafter=FailingDrafter(inner=NgramDrafter(),
+                                          ok_proposals=1),
+                   decode_fuse=4)
+    out = dying.generate_many([rep], 12)
+    assert dying.drafter_quarantined
+    assert dying.stats["fused_windows"] > 0  # quarantine unlocked fusing
+    np.testing.assert_array_equal(_reference(model, params, rep, 12)[0],
+                                  out[0])
+
+
+def test_fuse_stream_ring_taps_commits(model_and_params):
+    """fuse_stream=True: the io_callback tap records every in-window
+    commit as (slot, token) in order; the canonical tokens are
+    unchanged (the ring is observability, not the commit path)."""
+    model, params = model_and_params
+    rng = np.random.default_rng(13)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 decode_fuse=8, fuse_stream=True)
+    h = eng.submit(p, 9)
+    eng.run_until_complete()
+    ref = _reference(model, params, p, 9)[0, 5:]
+    assert h.tokens == ref.tolist()
+    # Every token after the prefill-sampled first one rode a window.
+    assert [t for _s, t in eng.fused_stream] == h.tokens[1:]
+    assert all(s == 0 for s, _t in eng.fused_stream)
+
+
+def test_decode_fuse_off_is_byte_identical(model_and_params):
+    """decode_fuse=1 (the default) never builds or dispatches the fused
+    program: stats keys, trace counts, and outputs are exactly the
+    single-step engine's."""
+    model, params = model_and_params
+    rng = np.random.default_rng(14)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+    base_traces = TRACE_COUNTS["fused_decode"]
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8)
+    eng.generate_many([p, p[:3]], 6)
+    assert "fused_windows" not in eng.stats
+    assert "fused_steps" not in eng.stats
+    assert eng.fused_stream is None
+    assert TRACE_COUNTS["fused_decode"] == base_traces
+
+
+def test_fused_compiles_once_across_churn(model_and_params):
+    """The static-shape invariant extends to the fused program: one
+    trace per (geometry, N) no matter how many requests churn through,
+    and a different N is a different program."""
+    model, params = model_and_params
+    rng = np.random.default_rng(15)
+    # A geometry no other test uses, so the jit cache cannot have
+    # compiled it already.
+    eng = Engine(model, params, num_slots=3, max_len=40, prefill_chunk=8,
+                 decode_fuse=5)
+    h = eng.submit(rng.integers(0, 61, size=4).astype(np.int32), 6)
+    eng.run_until_complete()
+    assert h.done
+    base = TRACE_COUNTS["fused_decode"]
+    for i in range(5):
+        eng.submit(rng.integers(0, 61, size=3 + 2 * (i % 3))
+                   .astype(np.int32), 4 + i,
+                   temperature=0.5 * (i % 2), top_k=4 if i % 2 else None,
+                   seed=i)
+        eng.run_until_complete()
+    assert TRACE_COUNTS["fused_decode"] == base
+    assert eng.stats["fused_windows"] > 0
+
+
+def test_fused_watchdog_budget_scales_with_window(model_and_params):
+    """The fused call's scoped watchdog deadline is step_timeout_s x N
+    (the window legitimately runs up to N decode steps in one call) —
+    a budget tuned for single-step decode must not misdiagnose a
+    healthy window as a wedge.  Every other device call keeps the flat
+    per-call budget."""
+    import contextlib
+
+    model, params = model_and_params
+    rng = np.random.default_rng(18)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 decode_fuse=4, step_timeout_s=5.0)
+    seen = []
+
+    def record_guard(timeout_s):
+        seen.append(timeout_s)
+        return contextlib.nullcontext()
+
+    eng._guard = record_guard
+    eng.generate_many([p], 9)
+    assert 20.0 in seen            # the fused windows (5.0 x 4)
+    assert 5.0 in seen             # prefill/sample keep the flat budget
+
+
+def test_validation(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="decode_fuse"):
+        Engine(model, params, num_slots=1, decode_fuse=0)
+    with pytest.raises(ValueError, match="fuse_stream"):
+        Engine(model, params, num_slots=1, fuse_stream=True)
+
+
+def test_fused_stats_and_hook_kind(model_and_params):
+    """The fused dispatch rides the same _device seam as every other
+    step program: the fault hook sees kind='fused_decode', and
+    fused_steps counts loop iterations (= the longest slot's commits),
+    so dispatch amortization is measurable from stats alone."""
+    model, params = model_and_params
+    rng = np.random.default_rng(16)
+    p = rng.integers(0, 61, size=5).astype(np.int32)
+    kinds = []
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 decode_fuse=4,
+                 step_fault_hook=lambda kind, idx: kinds.append(kind))
+    eng.generate_many([p], 9)
+    assert "fused_decode" in kinds
+    # 8 decode tokens in windows of 4 -> 2 windows, 8 iterations.
+    assert eng.stats["fused_windows"] == 2
+    assert eng.stats["fused_steps"] == 8

@@ -17,6 +17,9 @@ the missing work as arguments the benches accept:
     python tools/bench_gaps.py serve_spec -> comma-separated speculate_k
                                            values (speculative-serving
                                            rows missing)
+    python tools/bench_gaps.py serve_fused -> comma-separated fused
+                                           decode window sizes (on-device
+                                           decode-loop rows missing)
     python tools/bench_gaps.py serve_prefix -> comma-separated prefix-
                                            caching workloads (TTFT
                                            cache-on/off rows missing)
@@ -75,6 +78,17 @@ SERVE_SPEC_KS = (2, 4, 8)
 # (prefix_hit_tokens > 0) and bit-exact parity between the cached and
 # uncached engines.
 SERVE_PREFIX_WORKLOADS = ("shared_prefix", "multiturn")
+# Fused decode window sizes (serve_bench.py --decode-fuse: one
+# lax.while_loop program runs up to N decode steps on device per host
+# dispatch — the on-device decode loop, ROADMAP "kill the per-token
+# host round-trip") that must be measured on the TPU; same registry
+# contract.  A row closes its N only when it measured something
+# (tokens/sec > 0), the fused engine's outputs were bit-identical to
+# the single-step engine's (parity_ok), and the measured
+# host-dispatches-per-decoded-token landed within the fused bound
+# (dispatch_ok: <= 1/N x 1.25) — a fused run that dispatched per token
+# proved the loop never engaged.  N=1 is the single-step control row.
+SERVE_FUSED_NS = (1, 4, 8)
 # Fault-injection soak seeds (serve_bench.py --soak: random cancels,
 # deadline mix, injected drafter/step faults — and, since the tenancy
 # PR, a deterministic preemption storm — against the serve engine's
@@ -241,6 +255,27 @@ def serve_prefix_missing(d: str) -> list[str]:
                 and "TPU" in str(r.get("device_kind", ""))):
             done.add(r["workload"])
     return [w for w in SERVE_PREFIX_WORKLOADS if w not in done]
+
+
+def serve_fused_missing(d: str) -> list[int]:
+    """Fused-decode window sizes still lacking a real TPU measurement.
+    A row closes its N only when it measured something (tokens/sec >
+    0), kept bit-exact parity with the single-step engine
+    (``parity_ok``), and actually amortized the host dispatch
+    (``dispatch_ok`` — host-dispatches-per-decoded-token <= 1/N x
+    1.25).  CPU smoke and error rows never close an N (same rules as
+    serve_missing).  Comma-ready for SERVE_DECODE_FUSE so a window
+    resumes the sweep mid-way."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_fused.jsonl")):
+        if (r.get("metric") == "serve_fused"
+                and r.get("decode_fuse") in SERVE_FUSED_NS
+                and measured(r)
+                and r.get("parity_ok") is True
+                and r.get("dispatch_ok") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["decode_fuse"])
+    return [n for n in SERVE_FUSED_NS if n not in done]
 
 
 def serve_soak_missing(d: str) -> list[int]:
@@ -475,10 +510,10 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
                                      "collective", "lever", "serve",
-                                     "serve_spec", "serve_soak",
-                                     "serve_prefix", "serve_tenancy",
-                                     "train_soak", "train_soak_multihost",
-                                     "analysis"])
+                                     "serve_spec", "serve_fused",
+                                     "serve_soak", "serve_prefix",
+                                     "serve_tenancy", "train_soak",
+                                     "train_soak_multihost", "analysis"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -491,6 +526,9 @@ def main() -> None:
         print(",".join(str(c) for c in serve_missing(args.dir)), end="")
     elif args.stage == "serve_spec":
         print(",".join(str(k) for k in serve_spec_missing(args.dir)),
+              end="")
+    elif args.stage == "serve_fused":
+        print(",".join(str(n) for n in serve_fused_missing(args.dir)),
               end="")
     elif args.stage == "serve_soak":
         print(",".join(str(s) for s in serve_soak_missing(args.dir)),

@@ -208,6 +208,32 @@ def main() -> None:
                   f"p50 {r.get('ttft_p50_ms')} ms) | "
                   f"`serve_bench.py --speculate-k` | |")
 
+    # Fused-decode rows render pass/fail on the fused gates: bit-exact
+    # parity with the single-step engine and host-dispatches-per-token
+    # within the 1/N bound — the same criteria as
+    # bench_gaps.serve_fused_missing, so recorder and gate can't
+    # disagree.
+    fused = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_fused.jsonl"))
+         if "decode_fuse" in r and "serve_fused" not in r), "decode_fuse")
+    for r in sorted(fused.values(), key=lambda r: r.get("decode_fuse", 0)):
+        if (not measured(r) or r.get("parity_ok") is not True
+                or r.get("dispatch_ok") is not True):
+            why = r.get("error") or (
+                "parity broken" if r.get("parity_ok") is False
+                else "dispatch bound blown" if r.get("dispatch_ok") is False
+                else "no real measurement")
+            print(f"| serve_fused N={r.get('decode_fuse')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --decode-fuse` | |")
+        else:
+            print(f"| fused decode window N={r['decode_fuse']} "
+                  f"(c={r.get('concurrency')}) | "
+                  f"**{r['value']:,} tokens/sec** "
+                  f"({r.get('speedup_vs_single_step')}x single-step, "
+                  f"{r.get('host_dispatches_per_token')} host dispatches "
+                  f"per token vs 1.0, parity intact) | "
+                  f"`serve_bench.py --decode-fuse` | |")
+
     # Prefix-caching rows: TTFT with the block-pool cache on vs off on
     # the shared-prefix / multi-turn workloads, plus the hit accounting
     # that proves the cache actually served blocks (the gate's

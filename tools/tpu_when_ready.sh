@@ -91,6 +91,10 @@ serve_spec_ok() {
   local out; out=$(python tools/bench_gaps.py serve_spec) || return 1
   [ -z "$out" ]
 }
+serve_fused_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_fused) || return 1
+  [ -z "$out" ]
+}
 serve_soak_ok() {
   local out; out=$(python tools/bench_gaps.py serve_soak) || return 1
   [ -z "$out" ]
@@ -358,6 +362,21 @@ while true; do
         timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
         > bench_results/serve_spec.jsonl 2> bench_results/serve_spec.err
       log "serve_spec_bench rc=$? -> bench_results/serve_spec.jsonl"
+    fi
+    if serve_fused_ok; then
+      log "serve_fused.jsonl already good; skipping fused-decode bench"
+    else
+      # On-device fused decode loop (one lax.while_loop program per up
+      # to N decode steps, tpudp.serve Engine(decode_fuse=N)): host
+      # dispatches per token + tokens/sec vs the single-step engine —
+      # resumes at window-size granularity via bench_gaps, like the
+      # serve_spec stage.
+      bank bench_results/serve_fused.jsonl
+      ensure_window
+      SERVE_DECODE_FUSE="$(python tools/bench_gaps.py serve_fused)" \
+        timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
+        > bench_results/serve_fused.jsonl 2> bench_results/serve_fused.err
+      log "serve_fused_bench rc=$? -> bench_results/serve_fused.jsonl"
     fi
     if serve_prefix_ok; then
       log "serve_prefix.jsonl already good; skipping prefix-cache bench"

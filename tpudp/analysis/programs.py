@@ -55,15 +55,16 @@ TRACE_COUNTER_PROGRAMS = {
     "verify_step": "serve.verify_step",
     "prefill_chunk": "serve.prefill_chunk",
     "sample_row": "serve.sample_row",
+    "fused_decode": "serve.fused_decode",
     "prefix_block_in": "prefix.copy_block_in",
     "prefix_block_out": "prefix.copy_block_out",
     "draft_model": "serve.draft_model",
 }
 
-# Serve smoke geometry: 2 slots x 32 arena positions, chunk 8, k=3 —
-# the same scale tests/test_serve.py exercises.
+# Serve smoke geometry: 2 slots x 32 arena positions, chunk 8, k=3,
+# fused window 4 — the same scale tests/test_serve.py exercises.
 SERVE = dict(vocab=64, seq=64, layers=2, heads=2, d_model=32,
-             slots=2, max_len=32, chunk=8, k=3, blocks=4)
+             slots=2, max_len=32, chunk=8, k=3, blocks=4, fuse=4)
 # Train smoke geometry: a tiny conv-free net over 8x8x3 inputs on the
 # 8-virtual-device CPU mesh the tier-1 suite runs on.
 TRAIN = dict(input=(8, 8, 3), classes=4, batch=8, devices=8)
@@ -101,6 +102,8 @@ def _serve_args():
         window=np.zeros((s, k + 1), np.int32),
         ndraft=np.zeros(s, np.int32),
         chunk=np.zeros((1, SERVE["chunk"]), np.int32),
+        budgets=np.zeros(s, np.int32),
+        eos=np.full(s, -1, np.int32),
     )
     return cfg, params, cache, host
 
@@ -119,7 +122,7 @@ def build_programs() -> dict:
     from tpudp.serve import engine as _engine
 
     cfg, params, cache, h = _serve_args()
-    decode, verify, prefill = _engine._build_steps(cfg, params)
+    decode, verify, prefill, fused = _engine._build_steps(cfg, params)
     geo = f"s{SERVE['slots']}m{SERVE['max_len']}"
     programs[f"serve.decode_step@{geo}"] = (
         decode, (cache, h["last"], h["lens"], h["active"], h["temps"],
@@ -130,6 +133,22 @@ def build_programs() -> dict:
     programs[f"serve.prefill_chunk@{geo}c{SERVE['chunk']}"] = (
         prefill, (cache, np.int32(0), h["chunk"], np.int32(0),
                   np.int32(SERVE["chunk"] - 1)))
+    # Fused decode window, both variants: the stream twin pins the
+    # ordered io_callback in its host-callback census, so ANY change to
+    # the callback count inside the loop (a new host round trip — the
+    # exact regression this program exists to prevent) fails the audit
+    # naming the program.
+    fused_args = (cache, h["last"], h["lens"], h["active"], h["temps"],
+                  h["topk"], h["topp"], h["keys"], h["budgets"], h["eos"],
+                  np.int32(-1))
+    import functools
+
+    programs[f"serve.fused_decode@{geo}n{SERVE['fuse']}"] = (
+        functools.partial(fused, n_steps=SERVE["fuse"], stream=False),
+        fused_args)
+    programs[f"serve.fused_decode_stream@{geo}n{SERVE['fuse']}"] = (
+        functools.partial(fused, n_steps=SERVE["fuse"], stream=True),
+        fused_args)
     programs["serve.sample_row@v%d" % SERVE["vocab"]] = (
         _engine._sample_row,
         (np.zeros((1, SERVE["vocab"]), np.float32), np.float32(0.0),

@@ -28,7 +28,8 @@ from .core import Module, Rule, mentions, ordered_walk
 HOT_PATHS = {
     "tpudp/serve/engine.py": {
         "Engine.step", "Engine._run_prefill_chunk", "Engine._run_decode",
-        "Engine._run_verify", "Engine._gather_drafts", "Engine._commit",
+        "Engine._run_decode_fused", "Engine._run_verify",
+        "Engine._gather_drafts", "Engine._commit",
     },
     "tpudp/train.py": {
         "Trainer.train_epoch", "Trainer.evaluate",
@@ -47,8 +48,8 @@ DEVICE_ROOTS = {
 #: fault-seam wrapper ``self._device(kind, fn, *args)``.
 DEVICE_CALL_ATTRS = {
     "_device", "train_step", "eval_step", "fwd_step", "decode_step",
-    "verify_step", "prefill_step", "copy_block_in", "copy_block_out",
-    "_sample_row",
+    "verify_step", "prefill_step", "fused_step", "copy_block_in",
+    "copy_block_out", "_sample_row",
 }
 
 #: Known donating callables (attribute or bare name) → donated
@@ -57,7 +58,8 @@ DEVICE_CALL_ATTRS = {
 #: from their own decorators.
 DONATING = {
     "decode_step": (0,), "verify_step": (0,), "prefill_step": (0,),
-    "train_step": (0,), "copy_block_in": (0,), "copy_block_out": (1,),
+    "fused_step": (0,), "train_step": (0,), "copy_block_in": (0,),
+    "copy_block_out": (1,),
 }
 
 #: Pass-through wrappers: ``self._device("kind", fn, *args)`` runs

@@ -76,6 +76,29 @@ Design (static shapes everywhere — the TPU rule that shapes are compile
     batches never need a second program, and the verify step compiles
     once per (config, num_slots, max_len, k).
 
+  * **Fused decode windows** (``decode_fuse > 1``) — on "pure decode"
+    iterations (no queued work, nothing prefilling, no speculation this
+    step) the scheduler dispatches ONE jitted ``lax.while_loop`` program
+    that runs up to ``decode_fuse`` decode iterations entirely on
+    device: per-slot attention/KV append via the same vector-position
+    forward, per-slot traced sampling with the PRNG chains advanced
+    INSIDE the loop, and a loop predicate that exits early once every
+    running slot has hit EOS or its token budget.  The per-token host
+    round trip — scheduler iteration → one jitted step → host sync,
+    the decode ceiling at small batch on a real TPU, where dispatch
+    overhead beats FLOPs (arXiv:2204.06514) — becomes ONE fetch per
+    up-to-N-token window.  Committed tokens, per-slot PRNG state, and
+    arena positions come back as loop carry, so falling back to the
+    single-step path (admission, retirement, speculation, preemption,
+    deadlines — any step where the host must intervene) resumes
+    bit-identically; deadlines are detected at window edges (overshoot
+    bounded by the window).  ``decode_fuse=1`` — the default — is
+    byte-for-byte the single-step engine, stats keys and trace counts
+    included.  ``fuse_stream=True`` adds an ordered ``io_callback``
+    inside the loop that taps each iteration's committed tokens into a
+    host ring buffer (:attr:`Engine.fused_stream`) — observability
+    only, never the commit path.
+
 Host-side scheduling (admission, retirement, chunk bookkeeping, draft
 proposal, cancellation) is plain Python between device steps — the same
 split as the training stack (host data pipeline around a jitted step).
@@ -149,7 +172,9 @@ import collections
 import contextlib
 import enum
 import functools
+import itertools
 import time
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -230,6 +255,35 @@ class RequestFailed(RuntimeError):
             f"tokens{detail}")
 
 
+class _Ring(collections.deque):
+    """Bounded ``(slot, token)`` ring for ``fuse_stream`` — a deque
+    subclass so the type names its contract; deques are already
+    weak-referenceable, which the module registry below relies on to
+    never keep a dead engine's ring alive."""
+
+
+#: ring_id -> ring for the fused loop's io_callback tap.  Weak values:
+#: the engine holds the only strong reference, so a collected engine's
+#: ring drops out of the registry on its own.
+_STREAM_RINGS: "weakref.WeakValueDictionary[int, _Ring]" = (
+    weakref.WeakValueDictionary())
+_RING_IDS = itertools.count()
+
+
+def _stream_tap(ring_id, toks, running) -> None:
+    """Host side of the fused loop's ordered ``io_callback``: append
+    ``(slot, token)`` for every row that committed this iteration into
+    the engine's ring buffer.  Observability only — the canonical commit
+    path is the window's returned carry, so a full (bounded) ring drops
+    oldest entries rather than stalling the device."""
+    ring = _STREAM_RINGS.get(int(ring_id))
+    if ring is None:
+        return
+    toks = np.asarray(toks)
+    for s in np.nonzero(np.asarray(running))[0]:
+        ring.append((int(s), int(toks[s])))
+
+
 def _build_steps(cfg, params):
     """Jitted step programs with the WEIGHTS CLOSED OVER as compile-time
     constants rather than traced arguments.
@@ -290,6 +344,70 @@ def _build_steps(cfg, params):
         new_keys = jnp.where(active[:, None], carry, keys)
         return new_cache, out, n_emit, new_keys
 
+    @functools.partial(jax.jit, donate_argnums=(0,),
+                       static_argnames=("n_steps", "stream"))
+    def fused_decode_step(cache, last_tokens, lengths, active, temps,
+                          top_k, top_p, keys, budgets, eos_ids, ring_id,
+                          *, n_steps, stream=False):
+        """Up to ``n_steps`` decode iterations in ONE device program: a
+        ``lax.while_loop`` whose body is exactly the decode step's math
+        (same vector-position forward, same per-row masked sampling, the
+        per-slot PRNG chains advanced inside the loop once per OWN
+        committed token), with a predicate that exits early once every
+        running slot has sampled its ``eos_ids`` entry (-1 = none) or
+        exhausted its ``budgets`` entry (remaining ``max_new_tokens``).
+        Each iteration commits one token per still-running row into the
+        ``(num_slots, n_steps)`` output buffer; rows that stop keep
+        their key chain and length frozen, so the returned carry is
+        bit-identical to having run ``n_emit[s]`` single decode steps
+        for every slot — the fall-back seam the scheduler relies on.
+        ``n_steps`` is static (it shapes the output buffer), so the
+        program compiles once per (num_slots, max_len, n_steps);
+        ``budgets``/``eos_ids``/``ring_id`` are traced values.  With
+        ``stream`` (static) an ordered ``io_callback`` taps each
+        iteration's committed tokens into the host ring buffer named by
+        ``ring_id`` — an observability side channel, never the commit
+        path.  Returns ``(cache, out, n_emit, keys, iters)``; the ONE
+        host fetch per window replaces the per-token fetch."""
+        TRACE_COUNTS["fused_decode"] += 1
+        n_slots = last_tokens.shape[0]
+        out0 = jnp.zeros((n_slots, n_steps), jnp.int32)
+        n_emit0 = jnp.zeros((n_slots,), jnp.int32)
+
+        def cond(carry):
+            i, _cache, _last, _lens, running, _keys, _out, _n_emit = carry
+            return (i < n_steps) & jnp.any(running)
+
+        def body(carry):
+            i, cache, last, lens, running, keys, out, n_emit = carry
+            logits, cache = _forward_cached(cfg, params, last[:, None],
+                                            cache, lens)
+            carry_keys, sub = split_keys(keys)
+            toks = sample_tokens(logits[:, 0], temps, top_k, top_p, sub)
+            # Only rows still running advance their key chain / commit —
+            # a retired row's chain must read exactly as of its last
+            # committed token (the bit-exact resume contract shared with
+            # requeue/preemption carry-over).
+            keys = jnp.where(running[:, None], carry_keys, keys)
+            toks = jnp.where(running, toks, last)
+            if stream:
+                from jax.experimental import io_callback
+
+                io_callback(_stream_tap, None, ring_id, toks, running,
+                            ordered=True)
+            lens = jnp.where(running, lens + 1, lens)
+            col = jnp.arange(n_steps)[None, :] == n_emit[:, None]
+            out = jnp.where(col & running[:, None], toks[:, None], out)
+            n_emit = jnp.where(running, n_emit + 1, n_emit)
+            running = running & (toks != eos_ids) & (n_emit < budgets)
+            return (i + 1, cache, toks, lens, running, keys, out, n_emit)
+
+        iters, cache, _last, _lens, _running, keys, out, n_emit = (
+            lax.while_loop(cond, body,
+                           (jnp.int32(0), cache, last_tokens, lengths,
+                            active, keys, out0, n_emit0)))
+        return cache, out, n_emit, keys, iters
+
     @functools.partial(jax.jit, donate_argnums=(0,))
     def prefill_step(cache, slot, tokens, pos, last):
         """One fixed-size prompt chunk for one slot: slice the slot's
@@ -310,7 +428,7 @@ def _build_steps(cfg, params):
             lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1),
             lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1))
 
-    return decode_step, verify_step, prefill_step
+    return decode_step, verify_step, prefill_step, fused_decode_step
 
 
 # LRU of built step programs keyed by (cfg, id(params)): engines over
@@ -339,14 +457,16 @@ class _ModelState:
     inactive slot's row."""
 
     __slots__ = ("name", "model", "config", "params", "decode_step",
-                 "verify_step", "prefill_step", "cache", "prefix_cache")
+                 "verify_step", "prefill_step", "fused_step", "cache",
+                 "prefix_cache")
 
     def __init__(self, name, model, params, steps):
         self.name = name
         self.model = model
         self.config = model.config
         self.params = params
-        self.decode_step, self.verify_step, self.prefill_step = steps
+        (self.decode_step, self.verify_step, self.prefill_step,
+         self.fused_step) = steps
         self.cache = None
         self.prefix_cache = None
 
@@ -495,6 +615,20 @@ class Engine:
     the subsystem byte-for-byte, stats keys included).  The public
     handle is :attr:`prefix_cache` (``None`` when off).
 
+    ``decode_fuse > 1`` turns on fused decode windows: on pure-decode
+    iterations (no queued work, nothing prefilling, no speculation this
+    step) the scheduler runs ONE ``lax.while_loop`` program for up to
+    ``decode_fuse`` decode steps on device, early-exiting when every
+    running slot hits EOS or its budget — one host round trip per
+    window instead of per token, outputs bit-identical either way.
+    Any step where the host must intervene falls back to the
+    single-step path and resumes bit-identically (the window's carry IS
+    the single-step state).  ``fuse_stream=True`` additionally taps
+    each in-window commit into :attr:`fused_stream` (a bounded
+    ``(slot, token)`` ring) via an ordered ``io_callback``.
+    ``decode_fuse=1`` — the default — is byte-for-byte the single-step
+    engine, stats keys and trace counts included.
+
     Robustness knobs (see the module docstring): ``queue_limit`` bounds
     the submit queue (:class:`QueueFull` sheds overload);
     ``drafter_timeout_s`` is the per-propose budget past which the
@@ -522,6 +656,7 @@ class Engine:
                  max_len: int | None = None, prefill_chunk: int = 16,
                  speculate_k: int = 0, drafter=None,
                  prefix_cache_blocks: int = 0,
+                 decode_fuse: int = 1, fuse_stream: bool = False,
                  queue_limit: int | None = None,
                  drafter_timeout_s: float | None = None,
                  watchdog=None, step_timeout_s: float | None = None,
@@ -537,6 +672,14 @@ class Engine:
         if speculate_k < 0:
             raise ValueError(
                 f"speculate_k must be >= 0, got {speculate_k}")
+        if decode_fuse < 1:
+            raise ValueError(
+                f"decode_fuse must be >= 1 (1 disables the fused decode "
+                f"loop), got {decode_fuse}")
+        if fuse_stream and decode_fuse <= 1:
+            raise ValueError(
+                "fuse_stream requires decode_fuse >= 2 — the stream tap "
+                "rides the fused lax.while_loop program")
         if prefix_cache_blocks < 0:
             raise ValueError(
                 f"prefix_cache_blocks must be >= 0 (0 disables prefix "
@@ -592,6 +735,21 @@ class Engine:
         self.speculate_k = speculate_k
         self.drafter = drafter
         self._prefix_cache_blocks = prefix_cache_blocks
+        # Fused decode windows (module docstring "Fused decode windows"):
+        # decode_fuse=1 — the default — never touches the fused program
+        # and is byte-for-byte the single-step engine.
+        self.decode_fuse = decode_fuse
+        self._fuse_stream = bool(fuse_stream)
+        self.fused_stream: _Ring | None = None
+        self._ring_id = -1
+        if self._fuse_stream:
+            self._ring_id = next(_RING_IDS)
+            # Bound = a few windows' worth of tokens: the ring is an
+            # observability tap (the window's returned carry is the
+            # commit path), so overflow drops oldest instead of growing.
+            self.fused_stream = _Ring(
+                maxlen=max(4 * num_slots * decode_fuse, 64))
+            _STREAM_RINGS[self._ring_id] = self.fused_stream
         # Per-model serving state (arena + frozen-weight programs +
         # optional prefix cache), default model under key None.
         # Co-resident models (key = registered name) each add their own
@@ -899,6 +1057,14 @@ class Engine:
             slot = self._next_prefill_slot()
             if slot is not None:
                 self._run_prefill_chunk(slot, emitted)
+            # Fuse only on PURE-DECODE iterations: nothing queued (so
+            # admission/preemption cannot be waiting on a slot a
+            # mid-window retirement would free) and nothing prefilling
+            # (a prompt's next chunk must not stall behind a window).
+            # Deadlines do NOT gate fusing — expiry is detected at the
+            # window edge, overshoot bounded by decode_fuse tokens.
+            fuse = (self.decode_fuse > 1 and self.queue_depth == 0
+                    and self._next_prefill_slot() is None)
             # One batched decode (or draft+verify) per model with
             # decoding slots — with no co-resident models registered
             # this is exactly the old single decode step.
@@ -910,6 +1076,8 @@ class Engine:
                     continue
                 if self.speculate_k and not self._drafter_quarantined:
                     self._run_verify(ms, active, emitted)
+                elif fuse:
+                    self._run_decode_fused(ms, active, emitted)
                 else:
                     self._run_decode(ms, active, emitted)
         except Exception as exc:  # noqa: BLE001 — containment by design
@@ -1171,20 +1339,28 @@ class Engine:
             return contextlib.nullcontext()
         return self._watchdog.step(timeout_s)
 
-    def _device(self, kind: str, fn, *args):
+    def _device(self, kind: str, fn, *args, guard_timeout_s=None,
+                **kwargs):
         """Run one jitted step program behind the robustness seams: the
         fault-injection hook (``step_fault_hook(kind, index)``, raising
         to simulate a step failure) and the optional scoped watchdog
         deadline, so a wedged device call is detected from OUTSIDE the
         blocked call (``kill=True`` exits for the scheduler to restart;
         ``kill=False`` raises at the next call and is contained like any
-        other step failure)."""
+        other step failure).  ``guard_timeout_s`` overrides the engine's
+        flat per-call ``step_timeout_s`` for calls whose healthy
+        duration is a known multiple of a single step (the fused window
+        runs up to ``decode_fuse`` decode steps in one call — judging it
+        by one step's budget would misdiagnose a healthy window as a
+        wedge).  Remaining ``kwargs`` pass through to ``fn`` (the fused
+        decode step's static ``n_steps``/``stream``)."""
         idx = self._device_calls
         self._device_calls += 1
-        with self._guard(self._step_timeout_s):
+        with self._guard(guard_timeout_s if guard_timeout_s is not None
+                         else self._step_timeout_s):
             if self.step_fault_hook is not None:
                 self.step_fault_hook(kind, idx)
-            return fn(*args)
+            return fn(*args, **kwargs)
 
     def _contain_step_failure(self, exc: BaseException) -> None:
         """An exception escaped a device step: rebuild the arena (the
@@ -1262,6 +1438,21 @@ class Engine:
         self._len[s] = end
         self.stats["prefill_chunks"] += 1
         if end == fill.size:
+            # A requeued/preempted request can have been vacated AFTER
+            # its final commit — a hang surfacing in its retirement
+            # publish interrupts _retire between the commit and _finish
+            # — so its terminal condition already holds.  Retire it now
+            # instead of sampling a token past its budget (or past its
+            # committed eos): the resume must reproduce the retirement
+            # the interrupted step was performing, not extend the
+            # stream.
+            if r.eos_id is not None and r.tokens \
+                    and r.tokens[-1] == r.eos_id:
+                self._retire(s, FinishReason.EOS)
+                return
+            if len(r.tokens) >= r.max_new_tokens:
+                self._retire(s, FinishReason.COMPLETE)
+                return
             # Fill fully cached: the chunk's last-token logits are the
             # request's next sampling event (for a fresh request, the
             # FIRST — exactly generate()'s prefill-then-sample order;
@@ -1271,9 +1462,10 @@ class Engine:
                 "sample", _sample_row, last_logits, self._temps[s],
                 self._topk[s], self._topp[s], self._keys[s])
             self._keys = self._keys.at[s].set(carry)
-            # tpudp: lint-ok(host-sync): the first-token commit IS a
-            # per-token round trip — the on-device decode loop rung
-            # (ROADMAP) exists to delete it.
+            # tpudp: lint-ok(host-sync): the FIRST-token commit — one
+            # fetch per completed prefill, not per decoded token; the
+            # decoded tokens ride decode_fuse windows
+            # (_run_decode_fused) when fusing is on.
             self._commit(s, int(tok), emitted)
 
     def _run_decode(self, ms: _ModelState, active, emitted) -> None:
@@ -1281,15 +1473,81 @@ class Engine:
             "decode", ms.decode_step,
             ms.cache, self._last, self._len, active, self._temps,
             self._topk, self._topp, self._keys)
-        # tpudp: lint-ok(host-sync): THE per-token host round trip — one
-        # fetch per batched decode step; the on-device decode loop rung
-        # (ROADMAP) replaces it with a fused lax.while_loop.
+        # tpudp: lint-ok(host-sync): the single-step path's per-token
+        # fetch — Engine(decode_fuse=N) amortizes it to one fetch per
+        # fused lax.while_loop window (_run_decode_fused); this path
+        # remains for the host-intervention steps (admission, prefill,
+        # speculation, preemption) the fused window falls back to.
         toks = np.asarray(toks)
         self.stats["decode_steps"] += 1
         self.stats["active_slot_steps"] += int(active.sum())
         for s in np.nonzero(active)[0]:
             self._len[s] += 1  # the fed token's KV landed this step
             self._commit(int(s), int(toks[s]), emitted)
+
+    def _run_decode_fused(self, ms: _ModelState, active, emitted) -> None:
+        """One fused window: up to ``decode_fuse`` decode iterations in
+        a single device program (``fused_decode_step``), then ONE fetch
+        and a host-side replay of the window's commits through the same
+        ``_commit`` path the single-step engine uses — EOS/budget
+        retirement reasons, per-token timestamps, prefix-cache
+        publishes, and stats all flow through unchanged.  The device
+        already stopped each row at its EOS/budget, so the replay's own
+        retirement checks agree with the loop predicate by
+        construction; ``self._len``/``self._last`` advance per commit
+        (mirroring ``_run_verify``) and ``self._keys`` takes the loop's
+        carry, leaving the host state bit-identical to having run
+        ``n_emit[s]`` single steps — which is why any later fall-back
+        to the single-step path resumes exactly."""
+        budgets = np.zeros(self.num_slots, np.int32)
+        eos = np.full(self.num_slots, -1, np.int32)
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            budgets[s] = r.max_new_tokens - len(r.tokens)
+            if r.eos_id is not None:
+                eos[s] = r.eos_id
+        # The window legitimately runs up to decode_fuse decode steps in
+        # one device call, so its watchdog budget scales with the
+        # window — a step_timeout_s tuned for single-step decode must
+        # not misdiagnose a healthy window as a wedged call.
+        budget_s = (self._step_timeout_s * self.decode_fuse
+                    if self._step_timeout_s is not None else None)
+        ms.cache, out, n_emit, keys, iters = self._device(
+            "fused_decode", ms.fused_step,
+            ms.cache, self._last, self._len, active, self._temps,
+            self._topk, self._topp, self._keys, budgets, eos,
+            np.int32(self._ring_id), guard_timeout_s=budget_s,
+            n_steps=self.decode_fuse, stream=self._fuse_stream)
+        # tpudp: lint-ok(host-sync): the per-WINDOW fetch — one round
+        # trip per up-to-decode_fuse-token window, the amortized
+        # replacement for the single-step path's per-token fetch.
+        out = np.asarray(out)
+        n_emit = np.asarray(n_emit)  # tpudp: lint-ok(host-sync): same fetch
+        self.stats["fused_windows"] += 1
+        self.stats["fused_steps"] += int(iters)  # tpudp: lint-ok(host-sync): same fetch
+        # Each loop iteration is one batched decode over the arena, and
+        # a row commits exactly once per iteration it was running — so
+        # n_emit.sum() IS the window's active-slot-step count and
+        # occupancy consumers keep working with fusing on
+        # (active / (decode_steps + fused_steps) x num_slots).
+        self.stats["active_slot_steps"] += int(n_emit.sum())
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            # Take the window-final key carry PER SLOT, just before that
+            # slot's replay: the replay can raise only at a slot's OWN
+            # retirement publish (after its last commit), so if
+            # containment interrupts mid-replay every vacated slot's
+            # chain still matches its committed tokens — already-replayed
+            # slots carry the window chain, not-yet-replayed slots keep
+            # their pre-window chain with zero window tokens.  A single
+            # up-front `self._keys = keys` would skip an interrupted
+            # later slot's chain ahead of its stream.
+            self._keys = self._keys.at[s].set(keys[s])
+            for j in range(int(n_emit[s])):
+                if self._slots[s] is not r:
+                    break  # retired (EOS / budget / cancel) mid-replay
+                self._len[s] += 1
+                self._commit(int(s), int(out[s, j]), emitted)
 
     def _quarantine_drafter(self, reason: str, r: Request | None = None,
                             proposed: int = 0) -> None:

@@ -11,7 +11,14 @@ we already paid for this compile?":
     re-freeze (and re-compile) its own copies.  The trace-stability
     audit (tpudp/analysis) leans on these semantics: programs are
     reused per (config, params identity), so admission/retirement churn
-    and co-resident engines can never mint new traces.
+    and co-resident engines can never mint new traces.  Programs with a
+    per-engine static axis compose with it through jit statics rather
+    than extra cache keys: the fused decode window
+    (``engine.fused_decode_step``) is built once per ``(cfg, params)``
+    here and jitted with ``static_argnames=("n_steps", "stream")``, so
+    jax's own trace cache keys the compilations per ``(cfg, params,
+    N[, stream])`` — engines sharing weights but differing in
+    ``decode_fuse`` share one build and compile once per window size.
   * :func:`enable_persistent_cache` — JAX's on-disk executable cache
     for the relay-gated TPU (below).
 
