@@ -299,6 +299,12 @@ def _worker() -> int:
 def _launch(outdir: str, faults: dict[str, str]) -> subprocess.Popen:
     env = dict(os.environ)
     env["TRAIN_SOAK_OUT"] = outdir
+    # Flight recorder (tpudp.obs): every worker banks its span/event
+    # ring into flightrec-*.json on rollbacks/hangs/vote timeouts, so a
+    # soak kill always leaves a readable black box next to the event
+    # log.  Same dir for every relaunch of one soak — the dumps narrate
+    # the whole chaos schedule.
+    env.setdefault("TPUDP_FLIGHT_DIR", os.path.join(outdir, "flightrec"))
     for k in ("TRAIN_SOAK_NAN_AT", "TRAIN_SOAK_SPIKE_AT",
               "TRAIN_SOAK_RAISE_AT", "TRAIN_SOAK_STALL_AT",
               "TRAIN_SOAK_LOADER_AT"):
@@ -402,6 +408,12 @@ def _launch_pod(outdir: str, faults: dict[str, str], nproc: int,
     the rendezvous but keeps the mesh'd geometry-invariant config."""
     env = dict(os.environ)
     env["TRAIN_SOAK_OUT"] = outdir
+    # Per-host flight-recorder dumps (tpudp.obs): the killed-host story
+    # — a SIGKILLed worker cannot dump, but its SURVIVORS do (vote
+    # timeout / coordinated recovery), and rank 0 merges after each
+    # coordinated recovery, so every kill in the schedule leaves a
+    # timeline naming the failing region.
+    env.setdefault("TPUDP_FLIGHT_DIR", os.path.join(outdir, "flightrec"))
     for k in ("TRAIN_SOAK_NAN_AT", "TRAIN_SOAK_SPIKE_AT",
               "TRAIN_SOAK_RAISE_AT", "TRAIN_SOAK_STALL_AT",
               "TRAIN_SOAK_LOADER_AT"):

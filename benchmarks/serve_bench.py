@@ -191,6 +191,12 @@ def main() -> None:
                     help="bound the engine queue in the concurrency "
                          "sweep; overload sheds with QueueFull and rows "
                          "record the shed count (env: SERVE_QUEUE_LIMIT)")
+    ap.add_argument("--obs-check", action="store_true",
+                    help="measure the tpudp.obs overhead: the identical "
+                         "greedy workload through spans+counters-enabled "
+                         "vs disabled engines, one serve_obs_overhead "
+                         "row (the acceptance bar is within 3%% on the "
+                         "CPU smoke host; env: SERVE_OBS_CHECK=1)")
     args = ap.parse_args()
 
     import jax
@@ -391,6 +397,83 @@ def main() -> None:
         results.append(row)
         print(json.dumps(row), flush=True)
 
+    # Per-stage metric sidecar (tpudp.obs exposition): every stage banks
+    # the Engine.metrics() snapshots of the engines it measured —
+    # device counters, span rollups, stats — into ONE JSON file next to
+    # the row stream, so a bench row always ships with the structured
+    # telemetry that explains it (tools/bench_gaps.py's `obs` stage
+    # asserts the sidecar landed).
+    sidecar: dict = {"kind": "serve_bench_metrics", "stages": {}}
+
+    def bank_metrics(stage: str, key, metrics: dict) -> None:
+        sidecar["stages"].setdefault(stage, {})[str(key)] = metrics
+
+    def write_sidecar() -> None:
+        path = os.environ.get("SERVE_METRICS_SIDECAR") or os.path.join(
+            "bench_results", "serve_bench_metrics.json")
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            sidecar["device_kind"] = kind
+            with open(path, "w") as f:
+                json.dump(sidecar, f, indent=1, sort_keys=True,
+                          default=str)
+                f.write("\n")
+            print(f"[serve_bench] metrics sidecar -> {path}",
+                  file=sys.stderr)
+        except OSError as exc:
+            print(f"[serve_bench] metrics sidecar write failed: {exc}",
+                  file=sys.stderr)
+
+    obs_check = bool(args.obs_check
+                     or os.environ.get("SERVE_OBS_CHECK") == "1")
+    if obs_check:
+        # Spans+counters on vs off, identical greedy workload — the
+        # telemetry acceptance bar: enabled within 3% of disabled on
+        # the CPU smoke host.  Best-of-N both sides (the smoke host has
+        # documented double-digit variance; a single pair would gate on
+        # scheduler luck).  Parity is also asserted: obs must never
+        # perturb outputs.
+        oc_conc = int(os.environ.get("SERVE_OBS_CONCURRENCY", 4))
+        oc_tries = int(os.environ.get("SERVE_OBS_TRIES", 3))
+        offsets = np.zeros(n_requests)
+
+        def measure(obs_on):
+            eng = Engine(model, params, num_slots=oc_conc,
+                         max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                         obs=obs_on)
+            eng.generate_many(prompts[:2], 2)  # compile off the clock
+            best, outs = 0.0, None
+            for _ in range(oc_tries):
+                elapsed, _lat, _ttft, handles, _shed = drive(
+                    eng, offsets, prompts, max_new)
+                toks = sum(len(h.tokens) for h in handles)
+                tps = toks / elapsed if elapsed > 0 else 0.0
+                if tps >= best:
+                    best, outs = tps, [h.tokens for h in handles]
+            return best, outs, eng
+
+        on_tps, on_out, on_eng = measure(True)
+        off_tps, off_out, _off_eng = measure(False)
+        ratio = on_tps / off_tps if off_tps else None
+        emit({
+            "metric": "serve_obs_overhead",
+            "value": round(ratio, 4) if ratio is not None else None,
+            "unit": "enabled/disabled tokens/sec ratio",
+            "tokens_per_sec_obs_on": round(on_tps, 1),
+            "tokens_per_sec_obs_off": round(off_tps, 1),
+            "within_3pct": ratio is not None and ratio >= 0.97,
+            "parity_ok": on_out == off_out,
+            "concurrency": oc_conc,
+            "tries": oc_tries,
+            "requests": n_requests,
+            "max_new_tokens": max_new,
+            "device_kind": kind,
+        })
+        bank_metrics("obs_check", "on", on_eng.metrics())
+        write_sidecar()
+        print(json.dumps({"serve_obs": results}))
+        return
+
     # ---- sequential generate() baseline (one request at a time) --------
     # Warmup compiles the prefill+decode program; every request shares the
     # (prompt_len, max_new) geometry, so the timed loop never recompiles.
@@ -470,6 +553,7 @@ def main() -> None:
             "vocab_size": cfg.vocab_size,
             "device_kind": kind,
         })
+        bank_metrics("serve", c, engine.metrics())
 
     def run_spec(k: int) -> None:
         """Speculative vs plain engine, identical repetitive greedy
@@ -545,6 +629,7 @@ def main() -> None:
             "vocab_size": cfg.vocab_size,
             "device_kind": kind,
         })
+        bank_metrics("serve_spec", k, engine.metrics())
 
     # The fused sweep's single-step baseline, measured lazily once and
     # shared by every run_fused row (see its docstring).
@@ -602,7 +687,8 @@ def main() -> None:
                 fused_windows=(st["fused_windows"]
                                - base_stats.get("fused_windows", 0)),
                 fused_steps=(st["fused_steps"]
-                             - base_stats.get("fused_steps", 0)))
+                             - base_stats.get("fused_steps", 0)),
+                metrics=engine.metrics())
 
         if "base" not in fused_shared:
             fused_shared["base"] = run(
@@ -647,6 +733,7 @@ def main() -> None:
             "vocab_size": cfg.vocab_size,
             "device_kind": kind,
         })
+        bank_metrics("serve_fused", n, fused["metrics"])
 
     def run_soak(soak_seed: int) -> None:
         """Fault-injection soak against the robustness layer, fully
@@ -1097,6 +1184,7 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 emit({"metric": TENANCY_METRIC, "seed": s,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
         print(json.dumps({"serve_tenancy": results}))
         return
     if soak_seeds:
@@ -1106,6 +1194,7 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 emit({"metric": SOAK_METRIC, "seed": s,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
         print(json.dumps({"serve_soak": results}))
         return
     if prefix_workloads:
@@ -1115,6 +1204,7 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 emit({"metric": PREFIX_METRIC, "workload": w,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
         print(json.dumps({"serve_prefix": results}))
         return
     if fused_ns:
@@ -1124,6 +1214,7 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 emit({"metric": FUSED_METRIC, "decode_fuse": n,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
         print(json.dumps({"serve_fused": results}))
         return
     if spec_ks:
@@ -1137,6 +1228,7 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 emit({"metric": SPEC_METRIC, "speculate_k": k,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
         print(json.dumps({"serve_spec": results}))
         return
     for c in levels:
@@ -1145,6 +1237,7 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             emit({"metric": METRIC, "concurrency": c,
                   "error": f"{type(exc).__name__}: {exc}"[:500]})
+    write_sidecar()
     print(json.dumps({"serve": results}))
 
 

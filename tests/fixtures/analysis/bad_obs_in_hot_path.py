@@ -1,0 +1,16 @@
+"""Seeded violations for the obs-in-hot-path rule: allocating recorder
+calls (.span()/.event()) inside functions designated as scheduler hot
+paths.  Exactly 2 findings expected."""
+
+
+class Scheduler:
+    def __init__(self, obs):
+        self.obs = obs
+
+    # tpudp: hot-path
+    def step(self, batch):
+        with self.obs.span("step", batch=len(batch)):  # BAD: allocates
+            out = [t + 1 for t in batch]
+        for tok in out:
+            self.obs.event("commit", token=tok)  # BAD: dict per token
+        return out

@@ -1,0 +1,19 @@
+"""Corrected twin of bad_obs_in_hot_path.py: the hot path records
+through the allocation-free begin()/end()/count() API, and the
+allocating event moved off the hot path (retirement)."""
+
+
+class Scheduler:
+    def __init__(self, obs):
+        self.obs = obs
+
+    # tpudp: hot-path
+    def step(self, batch):
+        tok = self.obs.begin("step")  # OK: preallocated ring write
+        out = [t + 1 for t in batch]
+        self.obs.count("tokens", len(out))  # OK: counter bump
+        self.obs.end(tok)
+        return out
+
+    def retire(self, request):  # not a hot path: allocating API is fine
+        self.obs.event("finish", rid=request)

@@ -297,6 +297,38 @@ def test_serve_fused_bench_rows_parse():
     assert "decode_fuse" in (bad.stderr + bad.stdout)
 
 
+def test_serve_bench_obs_check_row_and_sidecar(tmp_path):
+    """The tpudp.obs exposition contract on the bench: --obs-check
+    emits the spans+counters-on vs off A/B row (the acceptance bar is
+    'within 3% on the CPU smoke host' — the row records the measured
+    ratio and the within_3pct verdict; the smoke test pins the
+    CONTRACT: parity intact, a real ratio measured, and the per-stage
+    metrics sidecar written with live device counters)."""
+    sidecar = tmp_path / "serve_bench_metrics.json"
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu", "SERVE_OBS_CHECK": "1",
+        "SERVE_LAYERS": "1", "SERVE_DMODEL": "64", "SERVE_VOCAB": "128",
+        "SERVE_REQUESTS": "6", "SERVE_MAX_NEW": "8", "SERVE_CHUNK": "8",
+        "SERVE_PROMPT_LEN": "8", "SERVE_OBS_TRIES": "2",
+        "SERVE_METRICS_SIDECAR": str(sidecar),
+    }, timeout=600)
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    row = next((r for r in rows
+                if r.get("metric") == "serve_obs_overhead"), None)
+    assert row is not None, proc.stderr[-800:]
+    assert row["parity_ok"] is True  # obs never perturbs outputs
+    assert row["value"] is not None and row["value"] > 0
+    assert row["tokens_per_sec_obs_on"] > 0
+    assert row["tokens_per_sec_obs_off"] > 0
+    assert isinstance(row["within_3pct"], bool)
+    doc = json.loads(sidecar.read_text())
+    assert doc["kind"] == "serve_bench_metrics"
+    on = doc["stages"]["obs_check"]["on"]
+    assert on["device_counters"]["tokens"] > 0
+    assert on["spans"]  # span rollup rode along
+
+
 def test_serve_fused_gap_gate(tmp_path):
     """tools/bench_gaps serve_fused stage: CPU smoke rows, error rows,
     parity-broken rows, and dispatch-bound-blown rows never close a

@@ -185,12 +185,14 @@ def test_vote_timeout_routes_to_hard_exit(tmp_path):
     outdir = str(tmp_path)
     bench = os.path.join(REPO, "benchmarks", "resilience_bench.py")
     port = _free_port()
+    flight = os.path.join(outdir, "flightrec")
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env.update(_pod_env({"TRAIN_SOAK_VOTE_TIMEOUT": "6",
                          "TRAIN_SOAK_OUT": outdir,
                          "TRAIN_SOAK_NPROC": "2",
                          "TRAIN_SOAK_DEVICES": "2",
-                         "TRAIN_SOAK_PORT": str(port)}))
+                         "TRAIN_SOAK_PORT": str(port),
+                         "TPUDP_FLIGHT_DIR": flight}))
     procs = []
     for rank in range(2):
         renv = dict(env)
@@ -209,6 +211,22 @@ def test_vote_timeout_routes_to_hard_exit(tmp_path):
     assert rc0 == VOTE_TIMEOUT_EXIT, rc0
     ev = _events(outdir)
     assert any(e["kind"] == "vote_timeout" for e in ev), ev
+    # The dying host banked its black box BEFORE exit 43 (tpudp.obs
+    # flight recorder): a strictly-LOCAL dump — the dead/wedged peer is
+    # never a dependency of its own post-mortem — whose timeline names
+    # the failing region (the unanswered vote + step fault that led
+    # there).
+    import glob as _glob
+    import json as _json
+
+    dumps = _glob.glob(os.path.join(flight, "flightrec-*vote_timeout*"))
+    assert dumps, sorted(os.listdir(flight)) if os.path.isdir(flight) \
+        else "no flight dir"
+    doc = _json.load(open(dumps[0]))
+    assert doc["reason"] == "vote_timeout"
+    names = [s["name"] for s in doc["spans"]]
+    assert "resilience.vote_timeout" in names
+    assert any(n.startswith("train.") for n in names)
 
 
 @pytest.mark.slow

@@ -449,7 +449,7 @@ def test_fused_watchdog_budget_scales_with_window(model_and_params):
                  decode_fuse=4, step_timeout_s=5.0)
     seen = []
 
-    def record_guard(timeout_s):
+    def record_guard(timeout_s, name="step"):
         seen.append(timeout_s)
         return contextlib.nullcontext()
 

@@ -42,6 +42,11 @@ the missing work as arguments the benches accept:
                                            not TPU measurements — they
                                            key off the TREE, not
                                            bench_results/)
+    python tools/bench_gaps.py obs      -> "sidecar" if serve-bench rows
+                                           were measured without the
+                                           tpudp.obs metrics sidecar
+                                           (serve_bench_metrics.json)
+                                           landing next to them
 
 Empty output means the stage is complete — the watcher's ok-gates key off
 that.  Error rows do not count as measured: a config that crashed in one
@@ -478,6 +483,31 @@ def _load_analysis():
 
 ANALYSIS_LINT_PATHS = ("tpudp", "tools", "benchmarks")
 
+#: Serve-bench result files whose rows must ship with the tpudp.obs
+#: metrics sidecar (serve_bench_metrics.json — per-stage
+#: Engine.metrics() snapshots: device counters, span rollups, stats).
+OBS_SIDECAR_STAGES = ("serve.jsonl", "serve_spec.jsonl",
+                      "serve_fused.jsonl", "serve_prefix.jsonl")
+OBS_SIDECAR_NAME = "serve_bench_metrics.json"
+
+
+def obs_missing(d: str) -> list[str]:
+    """Is the serve bench's metrics sidecar still owed?  Owed exactly
+    when some serve stage has banked MEASURED rows (telemetry must ship
+    with the numbers it explains) but no ``serve_bench_metrics.json``
+    exists in the results dir — a bench run that emitted rows without
+    the sidecar regressed the obs exposition contract.  Nothing
+    measured yet = nothing owed (the sidecar is written by the same
+    process that writes the rows)."""
+    has_rows = any(
+        measured(r)
+        for f in OBS_SIDECAR_STAGES
+        for r in rows_with_history(os.path.join(d, f)))
+    if not has_rows:
+        return []
+    return [] if os.path.exists(os.path.join(d, OBS_SIDECAR_NAME)) \
+        else ["sidecar"]
+
 
 def analysis_missing(root: str | None = None) -> list[str]:
     """Correctness gates still owed on the current TREE: ``lint`` when
@@ -513,7 +543,8 @@ def main() -> None:
                                      "serve_spec", "serve_fused",
                                      "serve_soak", "serve_prefix",
                                      "serve_tenancy", "train_soak",
-                                     "train_soak_multihost", "analysis"])
+                                     "train_soak_multihost", "analysis",
+                                     "obs"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -547,6 +578,8 @@ def main() -> None:
         print(",".join(serve_prefix_missing(args.dir)), end="")
     elif args.stage == "analysis":
         print(",".join(analysis_missing()), end="")
+    elif args.stage == "obs":
+        print(",".join(obs_missing(args.dir)), end="")
     elif args.stage == "collective":
         print("collective" if collective_missing(args.dir) else "", end="")
     elif args.stage == "lever":

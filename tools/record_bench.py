@@ -399,6 +399,56 @@ def main() -> None:
                   f"(**{r.get('ratio_dense_over_flash')}x**, kernel MFU "
                   f"{r.get('flash_mfu')}) | `flash_attention_bench.py` | |")
 
+    write_stage_sidecar(args.dir)
+
+
+#: Result file per stage — the recorder's per-stage metric sidecar
+#: summarizes exactly the files the resume gates read.
+STAGE_FILES = {
+    "bench": "bench.json", "epoch": "epoch.json",
+    "matrix": "matrix.jsonl", "mfu": "mfu.jsonl",
+    "flash": "flash.jsonl", "collective": "collective.jsonl",
+    "serve": "serve.jsonl", "serve_spec": "serve_spec.jsonl",
+    "serve_fused": "serve_fused.jsonl",
+    "serve_prefix": "serve_prefix.jsonl",
+    "serve_soak": "serve_soak.jsonl",
+    "serve_tenancy": "serve_tenancy.jsonl",
+    "train_soak": "train_soak.jsonl",
+    "train_soak_multihost": "train_soak_multihost.jsonl",
+}
+
+
+def write_stage_sidecar(d: str) -> None:
+    """Per-stage metric sidecar (tpudp.obs exposition): one JSON file
+    summarizing, for every stage the recorder renders, how many rows
+    exist, how many are real measurements, and how many came from a
+    real TPU — machine-readable progress the same way the markdown
+    table is human-readable.  Best-effort: a sidecar write failure must
+    never break the table output."""
+    import json
+
+    stages = {}
+    for stage, fname in STAGE_FILES.items():
+        rows = _rows(os.path.join(d, fname))
+        if not rows:
+            continue
+        stages[stage] = {
+            "rows": len(rows),
+            "measured": sum(1 for r in rows if measured(r)),
+            "tpu_measured": sum(
+                1 for r in rows
+                if measured(r) and "TPU" in str(r.get("device_kind", ""))),
+            "errors": sum(1 for r in rows if "error" in r),
+        }
+    try:
+        path = os.path.join(d, "record_bench_metrics.json")
+        with open(path, "w") as f:
+            json.dump({"kind": "record_bench_metrics", "stages": stages},
+                      f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
 
 if __name__ == "__main__":
     main()

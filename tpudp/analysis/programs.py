@@ -104,6 +104,10 @@ def _serve_args():
         chunk=np.zeros((1, SERVE["chunk"]), np.int32),
         budgets=np.zeros(s, np.int32),
         eos=np.full(s, -1, np.int32),
+        # OBS_DEVICE_COUNTERS accumulator (tpudp.obs zero-sync device
+        # counters) — the shape the engine passes every decode/verify/
+        # fused call.
+        counts=jnp.zeros((5,), jnp.float32),
     )
     return cfg, params, cache, host
 
@@ -126,10 +130,11 @@ def build_programs() -> dict:
     geo = f"s{SERVE['slots']}m{SERVE['max_len']}"
     programs[f"serve.decode_step@{geo}"] = (
         decode, (cache, h["last"], h["lens"], h["active"], h["temps"],
-                 h["topk"], h["topp"], h["keys"]))
+                 h["topk"], h["topp"], h["keys"], h["counts"]))
     programs[f"serve.verify_step@{geo}k{SERVE['k']}"] = (
         verify, (cache, h["window"], h["lens"], h["active"], h["ndraft"],
-                 h["temps"], h["topk"], h["topp"], h["keys"]))
+                 h["temps"], h["topk"], h["topp"], h["keys"],
+                 h["counts"]))
     programs[f"serve.prefill_chunk@{geo}c{SERVE['chunk']}"] = (
         prefill, (cache, np.int32(0), h["chunk"], np.int32(0),
                   np.int32(SERVE["chunk"] - 1)))
@@ -140,7 +145,7 @@ def build_programs() -> dict:
     # naming the program.
     fused_args = (cache, h["last"], h["lens"], h["active"], h["temps"],
                   h["topk"], h["topp"], h["keys"], h["budgets"], h["eos"],
-                  np.int32(-1))
+                  np.int32(-1), h["counts"])
     import functools
 
     programs[f"serve.fused_decode@{geo}n{SERVE['fuse']}"] = (

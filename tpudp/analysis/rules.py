@@ -55,10 +55,13 @@ DEVICE_CALL_ATTRS = {
 #: Known donating callables (attribute or bare name) → donated
 #: positional indices.  Mirrors the ``donate_argnums`` at their build
 #: sites; locally-defined jit functions are additionally discovered
-#: from their own decorators.
+#: from their own decorators.  The second index on the serve step
+#: programs is the OBS_DEVICE_COUNTERS accumulator (tpudp.obs) — tiny,
+#: but donated like the arena, so a read of the stale counters buffer
+#: after a step is the same class of bug as a stale-cache read.
 DONATING = {
-    "decode_step": (0,), "verify_step": (0,), "prefill_step": (0,),
-    "fused_step": (0,), "train_step": (0,), "copy_block_in": (0,),
+    "decode_step": (0, 8), "verify_step": (0, 9), "prefill_step": (0,),
+    "fused_step": (0, 11), "train_step": (0,), "copy_block_in": (0,),
     "copy_block_out": (1,),
 }
 
@@ -115,6 +118,12 @@ DIVERGENT_BUILTINS = {"open", "input"}
 SYNC_FUNCS = {"float", "int", "bool", "complex"}
 SYNC_DOTTED = {"numpy.asarray", "numpy.array", "jax.device_get"}
 SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+#: tpudp.obs recorder API split (the obs-in-hot-path rule): the
+#: ALLOCATION-FREE calls sanctioned on designated hot paths vs the
+#: convenient allocating forms that belong off them.
+OBS_FAST_METHODS = {"begin", "end", "count"}
+OBS_ALLOC_METHODS = {"span", "event"}
 
 
 def _hot_functions(mod: Module):
@@ -766,6 +775,41 @@ class UnregisteredJit(Rule):
                         f"body and register it for the trace audit")
 
 
+class ObsInHotPath(Rule):
+    """Allocating telemetry calls on designated scheduler hot paths.
+
+    Instrumentation must pass the same bar as the code it observes:
+    ``tpudp.obs``'s ``span(...)``/``event(...)`` build dicts and context
+    managers per call — fine at request admission or a recovery
+    decision, a per-token allocation regression inside
+    ``Engine.step``/``_run_decode``/``Trainer.train_epoch``.  The
+    recorder's allocation-free ``begin``/``end``/``count`` API exists
+    precisely for those paths (tpudp/obs/record.py documents the
+    contract), so on a hot path ONLY that API is allowed — the same
+    "every exception is visible in the diff" discipline as the
+    host-sync rule's suppressions.
+    """
+
+    name = "obs-in-hot-path"
+    summary = ("allocating obs recorder call (.span()/.event()) on a "
+               "designated hot path — use the allocation-free "
+               "begin()/end()/count() API")
+
+    def check(self, mod: Module):
+        for fn in _hot_functions(mod):
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in OBS_ALLOC_METHODS):
+                    continue
+                yield self.finding(
+                    mod, node,
+                    f".{node.func.attr}() allocates per call on the "
+                    f"`{fn.name}` hot path — record through the "
+                    f"allocation-free begin()/end()/count() API (or move "
+                    f"the event off the hot path)")
+
+
 RULES = [
     TraceNondeterminism(),
     UnorderedIteration(),
@@ -774,6 +818,7 @@ RULES = [
     UseAfterDonation(),
     DivergentCollective(),
     UnregisteredJit(),
+    ObsInHotPath(),
 ]
 
 RULES_BY_NAME = {r.name: r for r in RULES}

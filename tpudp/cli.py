@@ -150,6 +150,19 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "trailing-median window loss (--resilience only; "
                         "default: spike detection off, NaN windows still "
                         "roll back)")
+    p.add_argument("--flight-dir", type=str, default=None, metavar="DIR",
+                   help="observability (tpudp.obs): dump the flight "
+                        "recorder — the last N train/eval spans and "
+                        "recovery events — into per-host "
+                        "flightrec-*.json under DIR on watchdog "
+                        "timeouts, rollbacks, and vote timeouts "
+                        "(default: the TPUDP_FLIGHT_DIR env var; unset "
+                        "= dumps disabled)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="N",
+                   help="observability (tpudp.obs): serve a Prometheus-"
+                        "style text endpoint with the live Trainer."
+                        "metrics() snapshot on localhost:N/metrics "
+                        "(process 0 only; 0 picks a free port)")
     return p
 
 
@@ -299,7 +312,15 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                       spmd_mode=spmd_mode, timing_mode=args.timing_mode,
                       watchdog=watchdog, grad_accum=args.grad_accum,
                       remat=args.remat, metrics_jsonl=args.metrics_jsonl,
-                      verify_replicas=args.verify_replicas)
+                      verify_replicas=args.verify_replicas,
+                      flight_dir=args.flight_dir)
+    metrics_server = None
+    if args.metrics_port is not None and jax.process_index() == 0:
+        from tpudp.obs import MetricsServer
+
+        metrics_server = MetricsServer(args.metrics_port, trainer.metrics)
+        print(f"[tpudp] metrics endpoint: "
+              f"http://127.0.0.1:{metrics_server.port}/metrics")
     print(f"[tpudp] model={args.model} sync={sync} devices={world} "
           f"hosts={num_hosts} "
           f"global_batch={args.batch_size} dtype={args.dtype} "
@@ -539,6 +560,8 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                 watchdog.stop()
         if args.profile_dir:
             print(f"[tpudp] profiler trace written to {args.profile_dir}")
+        if metrics_server is not None:
+            metrics_server.close()
         return trainer
 
     from tpudp.utils.profiler import trace
@@ -575,6 +598,8 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
               f"{s.get('loader_restarts', 0)} loader restarts")
     if watchdog is not None:
         watchdog.stop()
+    if metrics_server is not None:
+        metrics_server.close()
     if args.profile_dir:
         print(f"[tpudp] profiler trace written to {args.profile_dir}")
     return trainer

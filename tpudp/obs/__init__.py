@@ -1,0 +1,51 @@
+"""``tpudp.obs`` — structured telemetry for both runtimes.
+
+One subsystem, four layers (docs/OBSERVABILITY.md):
+
+  * **Spans & events** (:mod:`tpudp.obs.record`): a preallocated
+    monotonic-clock ring per engine/trainer.  ``begin``/``end`` is the
+    allocation-free hot-path API (the only one the ``obs-in-hot-path``
+    lint rule allows on the designated scheduler/step hot paths);
+    ``span``/``event`` are the convenient off-hot-path forms.
+  * **Zero-sync device counters**: per-step scalars accumulated INSIDE
+    the existing step programs (``tpudp/serve/engine.py``
+    ``OBS_DEVICE_COUNTERS``) and carried in the arrays the engine
+    already shuttles — fetched only by ``Engine.metrics()`` snapshots,
+    never on a hot path, so ``tpudp.analysis lint`` stays at zero
+    host-sync findings.
+  * **Flight recorder** (:mod:`tpudp.obs.flight`): the ring persists as
+    a per-host ``flightrec-*.json`` on watchdog timeouts, step-failure
+    containment, and resilience rollbacks — enable by directory
+    (``TPUDP_FLIGHT_DIR`` or the ``flight_dir`` knobs).
+  * **Exposition** (:mod:`tpudp.obs.export` / :mod:`tpudp.obs.metrics`):
+    Chrome/Perfetto ``trace_event`` JSON, plain JSON snapshots, and a
+    Prometheus-style text endpoint (``tpudp.cli --metrics-port``).
+
+This package also absorbed the repo's older one-off timing APIs so
+there is ONE timing surface: :class:`StepTimer` (ex
+``tpudp/utils/timing.py``), the XLA :func:`trace` capture wrapper (ex
+``tpudp.utils.profiler.trace``), and the reference-parity window-line
+formatter (:func:`reference_window_lines`) the Trainer prints through.
+The old import paths re-export from here.  Importing ``tpudp.obs``
+never imports jax.
+"""
+
+from tpudp.obs.export import (counters_from_chrome_trace, snapshot_json,
+                              spans_from_chrome_trace, to_chrome_trace)
+from tpudp.obs.flight import (FLIGHT_DIR_ENV, FlightRecorder,
+                              coordinated_merge, list_dumps, merge_dumps,
+                              resolve_flight_dir)
+from tpudp.obs.format import reference_window_lines
+from tpudp.obs.metrics import MetricsServer, prometheus_text
+from tpudp.obs.record import NO_SPAN, Recorder
+from tpudp.obs.timing import StepTimer
+from tpudp.obs.tracing import step_annotation, trace
+
+__all__ = [
+    "FLIGHT_DIR_ENV", "FlightRecorder", "MetricsServer", "NO_SPAN",
+    "Recorder", "StepTimer", "coordinated_merge",
+    "counters_from_chrome_trace", "list_dumps", "merge_dumps",
+    "prometheus_text", "reference_window_lines", "resolve_flight_dir",
+    "snapshot_json", "spans_from_chrome_trace", "step_annotation",
+    "to_chrome_trace", "trace",
+]

@@ -1,0 +1,38 @@
+"""Span-backed reference-format log lines.
+
+The Trainer's window prints are PARITY OUTPUT — they reproduce the
+reference's exact strings (``src/Part 2a/main.py:100-112``) and tests/
+humans diff them against reference runs — so folding the print path
+into ``tpudp.obs`` must be a refactor, not a reformat.  This module is
+the single formatter both the Trainer and any span consumer use: given
+a completed train window's numbers (exactly what the window span
+carries), it returns the reference's lines byte-for-byte.
+"""
+
+from __future__ import annotations
+
+
+def reference_window_lines(it: int, loss: float, window_time: float,
+                           log_every: int, *, fwd_t: float | None = None,
+                           bwd_t: float | None = None,
+                           first_window: bool = False) -> list[str]:
+    """The reference's per-window lines for one completed log window.
+
+    ``first_window`` reproduces the reference's warmup exclusion (the
+    compile-bearing first window prints loss only);
+    ``fwd_t``/``bwd_t`` add the split-timing lines when the driver
+    measured them (``timing_mode='split'``).  Strings are pinned
+    byte-for-byte by tests/test_obs.py."""
+    lines = [
+        "Training loss after {} iterations is {}".format(it, loss),
+    ]
+    if not first_window:
+        if fwd_t is not None:
+            lines.append("Forward Pass time in iter {} is {}".format(
+                it, fwd_t / log_every))
+        if bwd_t is not None:
+            lines.append("Backward Pass time in iter {} is {}".format(
+                it, bwd_t / log_every))
+        lines.append("Average Pass time in iter {} is {}".format(
+            it, window_time / log_every))
+    return lines

@@ -1,0 +1,36 @@
+"""XLA profiler capture — the ``tpudp.obs`` home of the old
+``tpudp.utils.profiler.trace`` wrapper (that module re-exports from
+here, so existing imports keep working).
+
+The host-side recorder (``tpudp/obs/record.py``) answers "what was the
+scheduler doing"; THIS layer answers "what was the chip doing": a real
+XLA/TPU profile (TensorBoard trace-viewer format) around any region,
+with per-step boundaries marked so the viewer groups work by training
+step.  jax is imported lazily so ``tpudp.obs`` stays importable from
+stdlib-only tooling (the same discipline as ``tpudp.analysis``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None) -> Iterator[None]:
+    """XLA profiler capture into ``log_dir`` (no-op when None).  View
+    with TensorBoard's profile plugin or xprof."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def step_annotation(step: int):
+    """Mark a training step in an active trace."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)

@@ -286,6 +286,10 @@ class Supervisor:
     def record(self, kind: str, **fields) -> None:
         event = {"kind": kind, **fields}
         self.trainer.stats["events"].append(event)
+        # Mirror every typed recovery event into the obs ring (vote
+        # rounds included), so a flight-record dump carries the
+        # recovery timeline next to the train-window spans.
+        self.trainer.obs.event("resilience." + kind, **fields)
         if self.policy.on_event is not None:
             self.policy.on_event(event)
 
@@ -380,6 +384,10 @@ class Supervisor:
         return path
 
     def _rollback(self, e: BaseException) -> tuple[int, int]:
+        # Black box FIRST: the ring's tail is the window timeline that
+        # led to the divergence — dump before the restore overwrites
+        # any live context (no-op without a flight dir).
+        self.trainer.flight.dump("rollback", extra={"error": repr(e)[:500]})
         stats = self.trainer.stats
         if stats["rollbacks"] >= self.policy.max_rollbacks:
             self.record("rollback_escalation", error=repr(e),
@@ -406,6 +414,9 @@ class Supervisor:
         from tpudp.utils.checkpoint import restore_checkpoint
 
         t, stats = self.trainer, self.trainer.stats
+        t.flight.dump("step_fault"
+                      if not isinstance(e, StepHangError) else "hang",
+                      extra={"error": repr(e)[:500]})
         try:
             failed_step = int(t.state.step)
         except Exception:
@@ -516,6 +527,14 @@ class Supervisor:
                 f"[tpudp] resilience: recovery vote {seq} got no answer "
                 f"({why}); peer host dead or wedged — hard-exiting for "
                 f"scheduler relaunch (exit {VOTE_TIMEOUT_EXIT})")
+            # The killed-host black box: this process is about to
+            # disappear (exit 43) BECAUSE a peer died — the local dump
+            # is the only surviving timeline of what this host saw, and
+            # it must be strictly local (the dead peer can never be a
+            # dependency of its own post-mortem).
+            self.trainer.flight.dump("vote_timeout", extra={
+                "seq": seq, "reason": why,
+                "outcome": OUTCOME_NAMES.get(code)})
             os._exit(VOTE_TIMEOUT_EXIT)
         if any(s != seq for s in result["seqs"]):
             # Hosts disagree about WHICH decision this is — the protocol
@@ -527,6 +546,8 @@ class Supervisor:
                 f"[tpudp] resilience: vote sequence desync (local {seq}, "
                 f"peers {result['seqs']}); hard-exiting for scheduler "
                 f"relaunch (exit {VOTE_TIMEOUT_EXIT})")
+            self.trainer.flight.dump("vote_desync", extra={
+                "seq": seq, "peer_seqs": result["seqs"]})
             os._exit(VOTE_TIMEOUT_EXIT)
         worst = reduce_outcomes(result["codes"])
         self.record("vote", seq=seq, outcome=OUTCOME_NAMES.get(code),
@@ -560,6 +581,11 @@ class Supervisor:
         original = e if e is not None else RuntimeError(
             "a peer host faulted; this host joined the coordinated "
             "recovery")
+        # Every host banks its local black box for the voted recovery
+        # (each host's timeline differs — only one actually faulted).
+        t.flight.dump("coordinated_" + str(OUTCOME_NAMES.get(worst)),
+                      extra={"error": repr(original)[:500],
+                             "worst": OUTCOME_NAMES.get(worst)})
         if worst == OUTCOME_DIVERGENCE:
             if stats["rollbacks"] >= self.policy.max_rollbacks:
                 self.record("rollback_escalation", error=repr(original),
@@ -593,6 +619,15 @@ class Supervisor:
         if t.watchdog is not None:
             t.watchdog.arm()
         self._assert_replicas_agree()
+        if t.flight.enabled:
+            # Every host that reaches here is live (it just voted and
+            # restored), so the gather_host_values round inside
+            # coordinated_merge is safe — rank 0 folds the per-host
+            # dumps into one flightrec-merged.json.  Outside every hot
+            # path by construction (we are mid-recovery).
+            from tpudp.obs import coordinated_merge
+
+            coordinated_merge(t.flight.directory)
         epoch, skip = self._resume_position()
         if worst == OUTCOME_DIVERGENCE:
             self.record("rollback", error=repr(original), restored=path,

@@ -164,6 +164,22 @@ included):
     loop batches each model's decoding slots through that model's own
     step — per-request math is exactly the single-model engine's, so
     greedy outputs stay bit-identical to each model's ``generate()``.
+
+**Observability layer** (``tpudp.obs``, docs/OBSERVABILITY.md): every
+device call rides an allocation-free span named after its kind (the
+``_device`` seam — the same names the fault injectors and watchdog
+regions use), request lifecycle lands as events off the hot path
+(admit/finish/preempt/quarantine/containment, tenant+priority tagged),
+and each model's step programs accumulate ZERO-SYNC device counters
+(``OBS_DEVICE_COUNTERS``) fetched only by :meth:`Engine.metrics` —
+telemetry adds no host sync to any designated hot path, which
+``tpudp.analysis lint`` enforces.  Step-failure containment and
+watchdog timeouts dump the span ring to per-host flight records
+(``flight_dir`` / ``TPUDP_FLIGHT_DIR``; no directory = no writes), so
+a kill always leaves a timeline naming the failing region.
+``obs=False`` no-ops the host recorder (the device counters still
+ride the programs); the default engine's outputs, stats schema, and
+trace counts are unchanged either way.
 """
 
 from __future__ import annotations
@@ -183,6 +199,7 @@ from jax import lax
 
 from tpudp.models.generate import (KVCache, _forward_cached,
                                    validate_decode_config)
+from tpudp.obs import FlightRecorder, Recorder
 from tpudp.ops.sampling import sample_tokens, split_keys, verify_tokens
 from tpudp.utils.compile_cache import ProgramCache
 
@@ -191,6 +208,23 @@ from tpudp.utils.compile_cache import ProgramCache
 # compiles ONCE per engine geometry no matter how many requests churn
 # through the slots.
 TRACE_COUNTS = collections.Counter()
+
+#: Zero-sync device counters (tpudp.obs layer 2): per-step scalars
+#: accumulated INSIDE the step programs, in this order, in a tiny
+#: float32 vector each program takes (donated) and returns alongside
+#: its existing outputs — the counter values ride the result tuples the
+#: engine already fetches at window edges, so the telemetry adds no new
+#: device_get to any designated hot path (``tpudp.analysis lint``
+#: enforces that; ``Engine.metrics()`` is the only reader and fetches
+#: OFF the hot path).  "eos_exits" is counted only where the program
+#: knows the per-slot eos ids (the fused decode loop); the single-step
+#: paths account EOS on the host via FinishReason, as before.
+OBS_DEVICE_COUNTERS = ("steps", "tokens", "slot_steps",
+                       "draft_accepted", "eos_exits")
+
+
+def _zero_obs_counts():
+    return jnp.zeros((len(OBS_DEVICE_COUNTERS),), jnp.float32)
 
 
 class FinishReason(str, enum.Enum):
@@ -303,14 +337,16 @@ def _build_steps(cfg, params):
     identity) so engines sharing a weight tree share compiled programs.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(jax.jit, donate_argnums=(0, 8))
     def decode_step(cache, last_tokens, lengths, active, temps,
-                    top_k, top_p, keys):
+                    top_k, top_p, keys, counts):
         """One token for every slot: feed each row's last token at its
         own depth, sample per-row.  All sampling params and positions
         are traced arrays, so this compiles once per (num_slots,
         max_len).  The cache is donated: XLA updates the arena in place
-        instead of copying it every step."""
+        instead of copying it every step.  ``counts`` is the
+        OBS_DEVICE_COUNTERS accumulator (donated too — a handful of
+        float adds riding the step, fetched only by metrics())."""
         TRACE_COUNTS["decode_step"] += 1
         logits, new_cache = _forward_cached(cfg, params,
                                             last_tokens[:, None],
@@ -320,11 +356,15 @@ def _build_steps(cfg, params):
         # Only rows that actually sampled advance their key chain — a
         # request's draw stream must not depend on co-resident requests.
         new_keys = jnp.where(active[:, None], carry, keys)
-        return new_cache, toks, new_keys
+        zero = jnp.zeros((), counts.dtype)
+        one = jnp.ones((), counts.dtype)
+        act = jnp.sum(active).astype(counts.dtype)
+        new_counts = counts + jnp.stack([one, act, act, zero, zero])
+        return new_cache, toks, new_keys, new_counts
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(jax.jit, donate_argnums=(0, 9))
     def verify_step(cache, tokens, lengths, active, n_draft, temps,
-                    top_k, top_p, keys):
+                    top_k, top_p, keys, counts):
         """One speculative window for every slot: feed each row's
         ``[last, d_0 .. d_{k-1}]`` window at its own depth, accept the
         longest draft prefix the target model agrees with
@@ -342,13 +382,21 @@ def _build_steps(cfg, params):
         out, n_emit = verify_tokens(logits, tokens[:, 1:], n_draft,
                                     temps, top_k, top_p, sub)
         new_keys = jnp.where(active[:, None], carry, keys)
-        return new_cache, out, n_emit, new_keys
+        zero = jnp.zeros((), counts.dtype)
+        one = jnp.ones((), counts.dtype)
+        act = jnp.sum(active).astype(counts.dtype)
+        emitted = jnp.sum(jnp.where(active, n_emit, 0)).astype(counts.dtype)
+        accepted = jnp.sum(jnp.where(active & (n_draft > 0), n_emit - 1,
+                                     0)).astype(counts.dtype)
+        new_counts = counts + jnp.stack([one, emitted, act, accepted,
+                                         zero])
+        return new_cache, out, n_emit, new_keys, new_counts
 
-    @functools.partial(jax.jit, donate_argnums=(0,),
+    @functools.partial(jax.jit, donate_argnums=(0, 11),
                        static_argnames=("n_steps", "stream"))
     def fused_decode_step(cache, last_tokens, lengths, active, temps,
                           top_k, top_p, keys, budgets, eos_ids, ring_id,
-                          *, n_steps, stream=False):
+                          counts, *, n_steps, stream=False):
         """Up to ``n_steps`` decode iterations in ONE device program: a
         ``lax.while_loop`` whose body is exactly the decode step's math
         (same vector-position forward, same per-row masked sampling, the
@@ -367,19 +415,23 @@ def _build_steps(cfg, params):
         ``stream`` (static) an ordered ``io_callback`` taps each
         iteration's committed tokens into the host ring buffer named by
         ``ring_id`` — an observability side channel, never the commit
-        path.  Returns ``(cache, out, n_emit, keys, iters)``; the ONE
-        host fetch per window replaces the per-token fetch."""
+        path.  ``counts`` (the OBS_DEVICE_COUNTERS accumulator) rides
+        the loop carry: steps/tokens per iteration plus the EOS exits
+        only this program can see on device.  Returns ``(cache, out,
+        n_emit, keys, iters, counts)``; the ONE host fetch per window
+        replaces the per-token fetch."""
         TRACE_COUNTS["fused_decode"] += 1
         n_slots = last_tokens.shape[0]
         out0 = jnp.zeros((n_slots, n_steps), jnp.int32)
         n_emit0 = jnp.zeros((n_slots,), jnp.int32)
 
         def cond(carry):
-            i, _cache, _last, _lens, running, _keys, _out, _n_emit = carry
+            (i, _cache, _last, _lens, running, _keys, _out, _n_emit,
+             _counts) = carry
             return (i < n_steps) & jnp.any(running)
 
         def body(carry):
-            i, cache, last, lens, running, keys, out, n_emit = carry
+            i, cache, last, lens, running, keys, out, n_emit, counts = carry
             logits, cache = _forward_cached(cfg, params, last[:, None],
                                             cache, lens)
             carry_keys, sub = split_keys(keys)
@@ -399,14 +451,21 @@ def _build_steps(cfg, params):
             col = jnp.arange(n_steps)[None, :] == n_emit[:, None]
             out = jnp.where(col & running[:, None], toks[:, None], out)
             n_emit = jnp.where(running, n_emit + 1, n_emit)
+            zero = jnp.zeros((), counts.dtype)
+            one = jnp.ones((), counts.dtype)
+            run = jnp.sum(running).astype(counts.dtype)
+            eos_now = jnp.sum(running & (toks == eos_ids)).astype(
+                counts.dtype)
+            counts = counts + jnp.stack([one, run, run, zero, eos_now])
             running = running & (toks != eos_ids) & (n_emit < budgets)
-            return (i + 1, cache, toks, lens, running, keys, out, n_emit)
+            return (i + 1, cache, toks, lens, running, keys, out, n_emit,
+                    counts)
 
-        iters, cache, _last, _lens, _running, keys, out, n_emit = (
+        iters, cache, _last, _lens, _running, keys, out, n_emit, counts = (
             lax.while_loop(cond, body,
                            (jnp.int32(0), cache, last_tokens, lengths,
-                            active, keys, out0, n_emit0)))
-        return cache, out, n_emit, keys, iters
+                            active, keys, out0, n_emit0, counts)))
+        return cache, out, n_emit, keys, iters, counts
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def prefill_step(cache, slot, tokens, pos, last):
@@ -458,7 +517,7 @@ class _ModelState:
 
     __slots__ = ("name", "model", "config", "params", "decode_step",
                  "verify_step", "prefill_step", "fused_step", "cache",
-                 "prefix_cache")
+                 "prefix_cache", "obs_counts")
 
     def __init__(self, name, model, params, steps):
         self.name = name
@@ -469,6 +528,10 @@ class _ModelState:
          self.fused_step) = steps
         self.cache = None
         self.prefix_cache = None
+        # OBS_DEVICE_COUNTERS accumulator: rides this model's step
+        # programs (donated in, rebound from each result), fetched only
+        # by Engine.metrics().
+        self.obs_counts = _zero_obs_counts()
 
 
 @jax.jit
@@ -661,7 +724,8 @@ class Engine:
                  drafter_timeout_s: float | None = None,
                  watchdog=None, step_timeout_s: float | None = None,
                  step_fault_hook=None, tenants: dict | None = None,
-                 models: dict | None = None):
+                 models: dict | None = None, obs: bool = True,
+                 flight_dir: str | None = None):
         cfg = model.config
         validate_decode_config(cfg, "Engine")
         if num_slots < 1:
@@ -816,6 +880,24 @@ class Engine:
         self._drafter_quarantined = False
         self.drafter_quarantine_reason: str | None = None
         self.last_step_error: BaseException | None = None
+        # Structured telemetry (tpudp.obs): a bounded span/event ring —
+        # request lifecycle events off the hot path, allocation-free
+        # begin/end around every device call — plus a flight recorder
+        # that dumps the ring on step-failure containment and watchdog
+        # timeouts.  ``obs=False`` turns the recorder into O(1) no-ops;
+        # dumps are enabled by directory (``flight_dir`` or
+        # TPUDP_FLIGHT_DIR), so the default engine writes nothing.
+        self.obs = Recorder(name="serve", enabled=obs)
+        self.flight = FlightRecorder(self.obs, flight_dir,
+                                     component="serve")
+        if watchdog is not None and getattr(watchdog, "flight",
+                                            None) is None:
+            # A wedged device call must leave a black box even when the
+            # watchdog hard-exits: the monitor thread dumps this
+            # engine's ring before callbacks/kill (tpudp/utils/
+            # watchdog.py).  Only claim an unowned watchdog — a shared
+            # one keeps its first owner's recorder.
+            watchdog.flight = self.flight
 
     # -- model registry ------------------------------------------------
 
@@ -1173,6 +1255,41 @@ class Engine:
             return None
         return self.stats["draft_accepted"] / self.stats["draft_tokens"]
 
+    def metrics(self) -> dict:
+        """One structured snapshot of everything the engine knows about
+        itself: the host stats counters, queue/slot occupancy, the
+        per-model ZERO-SYNC device counters (OBS_DEVICE_COUNTERS — this
+        is their one read point, a single small fetch per model OFF the
+        designated hot paths), per-tenant counters, and the span
+        rollup from the obs ring.  The serve bench's metric sidecar and
+        the Prometheus exposition (``tpudp.obs.prometheus_text``) both
+        render this dict."""
+        device: dict[str, dict] = {}
+        totals = dict.fromkeys(OBS_DEVICE_COUNTERS, 0.0)
+        for name, ms in self._mstates.items():
+            vals = np.asarray(ms.obs_counts)
+            row = {k: float(v) for k, v in zip(OBS_DEVICE_COUNTERS, vals)}
+            device[name or "default"] = row
+            for k, v in row.items():
+                totals[k] += v
+        out = {
+            "stats": dict(self.stats),
+            "queue_depth": self.queue_depth,
+            "slots_in_use": self.slots_in_use,
+            "num_slots": self.num_slots,
+            "device_counters": totals,
+            "device_counters_per_model": device,
+            "spans": self.obs.summary(),
+            "obs_counters": dict(self.obs.counters),
+            "flight_dumps": self.flight.dumps,
+        }
+        if self._sched is not None:
+            out["tenants"] = {name: dict(c)
+                              for name, c in self.tenant_stats.items()}
+        if self.stats.get("draft_tokens"):
+            out["acceptance_rate"] = self.acceptance_rate
+        return out
+
     # -- internals -----------------------------------------------------
 
     def _pop_next(self) -> Request | None:
@@ -1205,6 +1322,13 @@ class Engine:
                    else jax.random.PRNGKey(r.seed))
             self._keys = self._keys.at[s].set(key)
             self.stats["admitted"] += 1
+            self.obs.event(
+                "admit", rid=r.id, slot=s, tenant=r.tenant,
+                model=r._ms.name,
+                priority=(self._priority_of(r)
+                          if self._sched is not None else None),
+                resumed=r._resume_key is not None,
+                fill=int(r._fill.size))
             if r.tenant is not None:
                 # A resume (preemption or step-failure requeue —
                 # _resume_key set at vacate) is not a fresh grant: it
@@ -1304,6 +1428,9 @@ class Engine:
         r.finish_reason = reason
         r.error = error
         self.stats[_FINISH_COUNTER[reason]] += 1
+        self.obs.event("finish", rid=r.id, reason=reason.value,
+                       tenant=r.tenant, tokens=len(r.tokens),
+                       preemptions=r.preemptions)
         if r.tenant is not None:
             self._sched.stats(r.tenant)[_FINISH_COUNTER[reason]] += 1
 
@@ -1333,11 +1460,12 @@ class Engine:
             if r is not None and self._deadline_passed(r, now):
                 self._retire(s, FinishReason.DEADLINE)
 
-    def _guard(self, timeout_s: float | None):
-        """Scoped watchdog deadline (no-op without a watchdog)."""
+    def _guard(self, timeout_s: float | None, name: str = "step"):
+        """Scoped watchdog deadline (no-op without a watchdog);
+        ``name`` labels the armed region in hang reports."""
         if self._watchdog is None:
             return contextlib.nullcontext()
-        return self._watchdog.step(timeout_s)
+        return self._watchdog.step(timeout_s, name=name)
 
     def _device(self, kind: str, fn, *args, guard_timeout_s=None,
                 **kwargs):
@@ -1353,14 +1481,24 @@ class Engine:
         runs up to ``decode_fuse`` decode steps in one call — judging it
         by one step's budget would misdiagnose a healthy window as a
         wedge).  Remaining ``kwargs`` pass through to ``fn`` (the fused
-        decode step's static ``n_steps``/``stream``)."""
+        decode step's static ``n_steps``/``stream``).
+
+        Every call rides an allocation-free obs span named ``kind`` —
+        the one instrumentation point covering the whole device-call
+        taxonomy (prefill/sample/decode/verify/fused_decode/prefix
+        copies), and the region name the watchdog reports on a hang."""
         idx = self._device_calls
         self._device_calls += 1
-        with self._guard(guard_timeout_s if guard_timeout_s is not None
-                         else self._step_timeout_s):
-            if self.step_fault_hook is not None:
-                self.step_fault_hook(kind, idx)
-            return fn(*args, **kwargs)
+        tok = self.obs.begin(kind)
+        try:
+            with self._guard(guard_timeout_s
+                             if guard_timeout_s is not None
+                             else self._step_timeout_s, name=kind):
+                if self.step_fault_hook is not None:
+                    self.step_fault_hook(kind, idx)
+                return fn(*args, **kwargs)
+        finally:
+            self.obs.end(tok)
 
     def _contain_step_failure(self, exc: BaseException) -> None:
         """An exception escaped a device step: rebuild the arena (the
@@ -1373,11 +1511,27 @@ class Engine:
         engine keeps serving."""
         self.stats["step_failures"] += 1
         self.last_step_error = exc
+        self.obs.event("containment", error=type(exc).__name__,
+                       detail=str(exc)[:200])
+        # Black box BEFORE the rebuild mutates state: the ring's tail is
+        # the timeline that led here (the failing device call's span is
+        # the most recent), which is what the post-mortem reads.
+        self.flight.dump("step_failure", extra={
+            "error": repr(exc)[:500],
+            "slots_in_use": self.slots_in_use,
+            "queue_depth": self.queue_depth,
+        })
         if self._watchdog is not None:
             self._watchdog.acknowledge()  # handled; next scope may proceed
         for ms in self._mstates.values():
             ms.cache = KVCache.zeros(ms.config, self.num_slots,
                                      self.max_len)
+            # The failed call may have consumed the donated counters
+            # buffer too — rebuild it.  The pre-fault values are LOST
+            # (fetching a possibly-donated buffer here could raise and
+            # mask the fault being contained); device counters are
+            # best-effort telemetry, host stats stay authoritative.
+            ms.obs_counts = _zero_obs_counts()
             # A rebuilt arena invalidates the published blocks
             # wholesale: the failed call may have been a block copy
             # with either buffer donated, and after an arbitrary device
@@ -1469,10 +1623,10 @@ class Engine:
             self._commit(s, int(tok), emitted)
 
     def _run_decode(self, ms: _ModelState, active, emitted) -> None:
-        ms.cache, toks, self._keys = self._device(
+        ms.cache, toks, self._keys, ms.obs_counts = self._device(
             "decode", ms.decode_step,
             ms.cache, self._last, self._len, active, self._temps,
-            self._topk, self._topp, self._keys)
+            self._topk, self._topp, self._keys, ms.obs_counts)
         # tpudp: lint-ok(host-sync): the single-step path's per-token
         # fetch — Engine(decode_fuse=N) amortizes it to one fetch per
         # fused lax.while_loop window (_run_decode_fused); this path
@@ -1512,11 +1666,13 @@ class Engine:
         # not misdiagnose a healthy window as a wedged call.
         budget_s = (self._step_timeout_s * self.decode_fuse
                     if self._step_timeout_s is not None else None)
-        ms.cache, out, n_emit, keys, iters = self._device(
+        (ms.cache, out, n_emit, keys, iters,
+         ms.obs_counts) = self._device(
             "fused_decode", ms.fused_step,
             ms.cache, self._last, self._len, active, self._temps,
             self._topk, self._topp, self._keys, budgets, eos,
-            np.int32(self._ring_id), guard_timeout_s=budget_s,
+            np.int32(self._ring_id), ms.obs_counts,
+            guard_timeout_s=budget_s,
             n_steps=self.decode_fuse, stream=self._fuse_stream)
         # tpudp: lint-ok(host-sync): the per-WINDOW fetch — one round
         # trip per up-to-decode_fuse-token window, the amortized
@@ -1559,6 +1715,7 @@ class Engine:
         acceptance accounting stays truthful."""
         self._drafter_quarantined = True
         self.drafter_quarantine_reason = reason
+        self.obs.event("drafter_quarantine", reason=reason[:200])
         self.stats["drafter_quarantined"] = 1
         if r is not None and proposed:
             r.draft_proposed += proposed
@@ -1588,7 +1745,8 @@ class Engine:
             t0 = time.perf_counter()
             try:
                 with self._guard(budget if budget is not None
-                                 else self._step_timeout_s):
+                                 else self._step_timeout_s,
+                                 name="draft_propose"):
                     raw = self.drafter.propose(context, k)
                 draft = np.asarray(raw).reshape(-1)[:k]
             except Exception as exc:  # noqa: BLE001 — isolation by design
@@ -1657,10 +1815,10 @@ class Engine:
             tokens[s, 1:1 + draft.size] = draft  # validated in-vocab
             n_draft[s] = draft.size
             self._slots[s].draft_proposed += int(draft.size)
-        ms.cache, out, n_emit, self._keys = self._device(
+        ms.cache, out, n_emit, self._keys, ms.obs_counts = self._device(
             "verify", ms.verify_step,
             ms.cache, tokens, self._len, active, n_draft, self._temps,
-            self._topk, self._topp, self._keys)
+            self._topk, self._topp, self._keys, ms.obs_counts)
         # tpudp: lint-ok(host-sync): the per-window verify fetch (one
         # round trip per k+1-token window, amortized over accepts) —
         # fusing the drafter into the device program removes it.
@@ -1749,6 +1907,8 @@ class Engine:
             self._publish_prefix(r._ms, s, r)
         self._vacate_slot(s)
         r.preemptions += 1
+        self.obs.event("preempt", rid=r.id, slot=s, tenant=r.tenant,
+                       tokens=len(r.tokens))
         self.stats["preempted"] += 1
         self._sched.stats(r.tenant)["preempted"] += 1
         self._sched.requeue_front(r)

@@ -51,6 +51,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudp.mesh import DATA_AXIS
+from tpudp.obs import reference_window_lines
 from tpudp.parallel.sync import get_sync
 from tpudp.utils.profiler import fetch_fence
 from tpudp.utils.watchdog import check_finite
@@ -61,13 +62,24 @@ class TrainState(struct.PyTreeNode):
     accumulated on device so the host never blocks on a per-step scalar
     fetch (a per-step ``float(loss)`` costs a full host↔device round trip —
     the async-dispatch hazard from SURVEY.md §7); the driver reads it once
-    per log window and differences on the host."""
+    per log window and differences on the host.
+
+    ``obs_norms`` extends the same zero-sync piggyback pattern to the
+    gradient norm (tpudp.obs device counters): when enabled
+    (``init_state(track_grad_norm=True)`` / ``Trainer(
+    track_grad_norm=True)``) it is a ``(2,)`` accumulator of
+    ``[sum(|g|), sum(|g|^2)]`` advanced INSIDE the jitted step — fetched
+    only by ``Trainer.metrics()``, never on the per-step path.  The
+    default ``None`` contributes no pytree leaf, so the state (and
+    every checkpoint/sharding/fingerprint consumer) is byte-for-byte
+    the pre-obs layout."""
 
     step: jnp.ndarray
     params: Any
     batch_stats: Any
     opt_state: Any
     loss_sum: jnp.ndarray
+    obs_norms: Any = None
 
 
 def make_optimizer(
@@ -197,10 +209,13 @@ def init_state(
     input_shape: tuple = (1, 32, 32, 3),
     seed: int = 0,
     input_dtype=None,
+    track_grad_norm: bool = False,
 ) -> TrainState:
     """Initialize params/batch_stats/optimizer state (reference seeds both
     RNGs with 0: ``src/Part 2a/main.py:20-21``).  ``input_dtype`` defaults to
-    float32 for image-shaped (>2-D) inputs and int32 for 2-D token inputs."""
+    float32 for image-shaped (>2-D) inputs and int32 for 2-D token inputs.
+    ``track_grad_norm`` allocates the ``obs_norms`` device accumulator
+    (see :class:`TrainState`); off — the default — adds no leaf."""
     if input_dtype is None:
         input_dtype = jnp.float32 if len(input_shape) > 2 else jnp.int32
     variables = model.init(jax.random.PRNGKey(seed),
@@ -213,6 +228,8 @@ def init_state(
         batch_stats=batch_stats,
         opt_state=tx.init(params),
         loss_sum=jnp.zeros((), jnp.float32),
+        obs_norms=(jnp.zeros((2,), jnp.float32) if track_grad_norm
+                   else None),
     )
 
 
@@ -312,6 +329,16 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
         loss = lax.pmean(loss, axis_name)
         if new_bs:
             new_bs = jax.tree.map(lambda x: lax.pmean(x, axis_name), new_bs)
+    # Zero-sync grad-norm telemetry (tpudp.obs): accumulated on device
+    # alongside loss_sum, fetched only by Trainer.metrics().  The
+    # presence test is PYTREE STRUCTURE (is the accumulator allocated?),
+    # static at trace time; grads here are already cross-device
+    # synchronized on the rungs that sync before the update, so the
+    # accumulated norm is host-uniform wherever the loss is.
+    new_norms = state.obs_norms
+    if new_norms is not None:
+        gn = optax.global_norm(grads)
+        new_norms = new_norms + jnp.stack([gn, gn * gn])
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
     return (
@@ -321,6 +348,7 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
             batch_stats=new_bs,
             opt_state=new_opt,
             loss_sum=state.loss_sum + loss,
+            obs_norms=new_norms,
         ),
         loss,
     )
@@ -762,15 +790,33 @@ class Trainer:
         compress: str | None = None,
         verify_replicas: bool = False,
         step_fault_hook: Callable[[str, int], None] | None = None,
+        track_grad_norm: bool = False,
+        flight_dir: str | None = None,
     ):
+        from tpudp.obs import FlightRecorder, Recorder
+
         self.model = model
         self.mesh = mesh
         self.sync = sync
         self.strategy = strategy
         self.watchdog = watchdog  # tpudp.utils.watchdog.Watchdog or None
+        # Structured telemetry (tpudp.obs): window/step spans on a
+        # bounded ring + a flight recorder the watchdog and the
+        # resilience supervisor dump on hangs/rollbacks.  Dumps are
+        # enabled by directory (flight_dir or TPUDP_FLIGHT_DIR); without
+        # one every dump is a no-op.
+        self.obs = Recorder(name="train")
+        self.flight = FlightRecorder(self.obs, flight_dir,
+                                     component="train")
+        if watchdog is not None and getattr(watchdog, "flight",
+                                            None) is None:
+            watchdog.flight = self.flight
+        self.track_grad_norm = track_grad_norm
         # Typed recovery counters/events, populated only when fit() runs
         # under a ResiliencePolicy (tpudp.resilience); stays {} otherwise.
         self.stats: dict = {}
+        self._last_window_loss: float | None = None
+        self._metrics_snapshot: dict = {}  # last good metrics() state read
         # The active fit's Supervisor (tpudp.resilience) or None; guards
         # the loss-spike observation and loader-containment seams below so
         # the default path pays nothing.
@@ -801,7 +847,8 @@ class Trainer:
             compress_devices=(mesh.shape[DATA_AXIS]
                               if compress is not None else None))
         self.state = init_state(model, self.tx, input_shape=input_shape,
-                                seed=seed)
+                                seed=seed,
+                                track_grad_norm=track_grad_norm)
         self.timing_mode = timing_mode
         self.log_every = log_every
         self.log = log_fn
@@ -923,12 +970,61 @@ class Trainer:
             loader.set_place(lambda b: tuple(put(x) for x in b))
 
     def _emit_metrics(self, record: dict) -> None:
+        if "loss" in record:
+            self._last_window_loss = record["loss"]
         if self.metrics_jsonl is None:
             return
         import json
 
         with open(self.metrics_jsonl, "a") as f:
             f.write(json.dumps(record) + "\n")
+
+    def metrics(self) -> dict:
+        """One structured snapshot for exposition (the Prometheus
+        endpoint in tpudp.cli renders this through
+        ``tpudp.obs.prometheus_text``): optimizer step, cumulative
+        device loss, the zero-sync grad-norm accumulator (when
+        ``track_grad_norm`` allocated it), span rollups, host counters,
+        and the resilience recovery counters.  The device fetches here
+        are OPERATOR-triggered — metrics() never sits on the per-step
+        hot path, which is what keeps the telemetry layer clean under
+        ``tpudp.analysis lint``.
+
+        Thread-safe against the train loop: the step donates
+        ``self.state`` (``donate_argnums=(0,)``), so a metrics request
+        landing mid-step — the ``--metrics-port`` endpoint serves from
+        a daemon thread — can catch the binding pointing at deleted
+        buffers.  The state reads are best-effort: a fetch that hits a
+        donated buffer falls back to the last successful snapshot
+        instead of turning the endpoint into an intermittent 500."""
+        try:
+            state = self.state  # one binding; the loop rebinds, never mutates
+            snap = {"step": int(state.step),
+                    "loss_sum": float(state.loss_sum)}
+            if state.obs_norms is not None:
+                s, s2 = (float(x) for x in np.asarray(state.obs_norms))
+                snap["norms"] = (s, s2)
+            self._metrics_snapshot = snap
+        except Exception:  # donated mid-step; serve the last snapshot
+            snap = self._metrics_snapshot
+        step = max(snap.get("step", 0), 1)
+        out = {
+            "step": snap.get("step", 0),
+            "loss_sum": snap.get("loss_sum", 0.0),
+            "loss_mean": snap.get("loss_sum", 0.0) / step,
+            "spans": self.obs.summary(),
+            "counters": dict(self.obs.counters),
+            "flight_dumps": self.flight.dumps,
+            "resilience": {k: v for k, v in self.stats.items()
+                           if isinstance(v, (int, float))},
+        }
+        if self._last_window_loss is not None:
+            out["last_window_loss"] = self._last_window_loss
+        if "norms" in snap:
+            s, s2 = snap["norms"]
+            out["grad_norm_mean"] = s / step
+            out["grad_norm_rms"] = float(np.sqrt(max(s2 / step, 0.0)))
+        return out
 
     def train_epoch(self, loader, epoch: int = 0, *,
                     skip_batches: int = 0) -> float:
@@ -971,7 +1067,13 @@ class Trainer:
         window_start = time.perf_counter()
         window_samples = 0
         it = 0
+        # Allocation-free span tokens (tpudp.obs begin/end — the only
+        # recorder API the obs-in-hot-path rule allows here): data-wait
+        # per iteration, dispatch per step, one span per log window.
+        win_tok = self.obs.begin("train.window")
+        data_tok = self.obs.begin("train.data")
         for it, (images, labels, _w) in enumerate(batches, start=1):
+            self.obs.end(data_tok)
             window_samples += _host_local_rows(images)
             images, labels = self._device_batch(images, labels)
             if self.step_fault_hook is not None:
@@ -997,14 +1099,18 @@ class Trainer:
                 # fused step recomputes fwd; attribute the remainder to bwd
                 bwd_t += max(t2 - t1 - (t1 - t0), 0.0)
             else:
+                step_tok = self.obs.begin("train.dispatch")
                 self.state, _ = self.train_step(self.state, images, labels)
+                self.obs.end(step_tok)
             if it % self.log_every == 0:
                 # Window barrier: a device->host FETCH of a parameter leaf —
                 # under some device transports (axon relay) even
                 # block_until_ready on the full state can return before the
                 # step's compute finished (see BASELINE.md); the fetched
                 # param data-depends on the window's last fwd+bwd+update.
+                fence_tok = self.obs.begin("train.fetch_fence")
                 fetch_fence(self.state.params)
+                self.obs.end(fence_tok)
                 window_time = time.perf_counter() - window_start
                 # tpudp: lint-ok(host-sync): the WINDOW-EDGE loss fetch
                 # — one round trip per log_every steps by design (the
@@ -1016,17 +1122,21 @@ class Trainer:
                     self._resilience.observe_window_loss(
                         losses[-1], epoch=epoch, it=it)
                 prev_loss_sum = cum
-                self.log(
-                    "Training loss after {} iterations is {}".format(it, losses[-1])
-                )
-                if it != self.log_every:  # first-window warmup exclusion
-                    if self.timing_mode == "split":
-                        self.log("Forward Pass time in iter {} is {}".format(
-                            it, fwd_t / self.log_every))
-                        self.log("Backward Pass time in iter {} is {}".format(
-                            it, bwd_t / self.log_every))
-                    self.log("Average Pass time in iter {} is {}".format(
-                        it, window_time / self.log_every))
+                # Reference-parity window lines through the span-backed
+                # formatter (tpudp.obs.reference_window_lines) — the
+                # strings are byte-identical to the reference's prints;
+                # only the formatting moved under one roof.
+                split = self.timing_mode == "split"
+                for line in reference_window_lines(
+                        it, losses[-1], window_time, self.log_every,
+                        fwd_t=fwd_t if split else None,
+                        bwd_t=bwd_t if split else None,
+                        first_window=it == self.log_every):
+                    self.log(line)
+                self.obs.end(win_tok)
+                win_tok = self.obs.begin("train.window")
+                self.obs.count("train.windows")
+                self.obs.count("train.samples", window_samples)
                 self._emit_metrics({
                     "kind": "train_window", "epoch": epoch, "iter": it,
                     "loss": losses[-1],
@@ -1044,6 +1154,9 @@ class Trainer:
                 fwd_t, bwd_t = 0.0, 0.0
                 window_start = time.perf_counter()
             beat()  # watchdog heartbeat: an iteration completed
+            data_tok = self.obs.begin("train.data")
+        self.obs.end(data_tok)
+        self.obs.end(win_tok)
         if it % self.log_every:  # flush ragged final window
             # tpudp: lint-ok(host-sync): ragged-final-window flush —
             # same once-per-window cadence as the edge fetch above.
@@ -1069,6 +1182,7 @@ class Trainer:
         beat = self.watchdog.beat if self.watchdog is not None else (lambda: None)
         loss_sum = correct = count = jnp.zeros((), jnp.float32)
         it = 0
+        eval_tok = self.obs.begin("eval")
         for images, labels, weights in loader:
             images, labels = self._device_batch(images, labels)
             if self._put is not None:
@@ -1076,14 +1190,19 @@ class Trainer:
             if self.step_fault_hook is not None:
                 self._device_calls += 1
                 self.step_fault_hook("eval", self._device_calls)
+            step_tok = self.obs.begin("eval.dispatch")
             ls, c, n = self.eval_step(self.state, images, labels, weights)
+            self.obs.end(step_tok)
             loss_sum, correct, count = loss_sum + ls, correct + c, count + n
             it += 1
             beat()
+        fence_tok = self.obs.begin("eval.fetch")
         # tpudp: lint-ok(host-sync): ONE fetch after the full eval pass
         # (metrics accumulate on device; this is the async-friendly end).
         loss_sum, correct, count = (float(loss_sum), float(correct),
                                     max(float(count), 1.0))  # tpudp: lint-ok(host-sync): same fetch
+        self.obs.end(fence_tok)
+        self.obs.end(eval_tok)
         avg_loss = check_finite(
             # tpudp: lint-ok(host-sync): error-context step fetch on the
             # already-synchronized end-of-eval path.
@@ -1142,9 +1261,11 @@ class Trainer:
              epoch_end_fn, skip_first=0) -> None:
         for epoch in range(start_epoch, epochs):
             start = time.perf_counter()
+            epoch_tok = self.obs.begin("train.epoch")
             skip = skip_first if epoch == start_epoch else 0
             self.train_epoch(train_loader, epoch, skip_batches=skip)
             fetch_fence(self.state.params)  # honest epoch wall-time edge
+            self.obs.end(epoch_tok)
             epoch_s = time.perf_counter() - start
             self.log(
                 "Training time after {} epoch is {}".format(

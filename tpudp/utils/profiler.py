@@ -18,31 +18,18 @@ the TPU-native layer the reference never had:
 
 from __future__ import annotations
 
-import contextlib
 import time
-from typing import Any, Iterator
+from typing import Any
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpudp.mesh import DATA_AXIS
-
-
-@contextlib.contextmanager
-def trace(log_dir: str | None) -> Iterator[None]:
-    """XLA profiler capture into ``log_dir`` (no-op when None).  View with
-    TensorBoard's profile plugin or xprof."""
-    if log_dir is None:
-        yield
-        return
-    with jax.profiler.trace(log_dir):
-        yield
-
-
-def step_annotation(step: int):
-    """Mark a training step in an active trace."""
-    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+# trace/step_annotation moved to tpudp.obs (PR 11 folded the one-off
+# timing/tracing APIs under the telemetry package); re-exported here so
+# existing `from tpudp.utils.profiler import trace` imports keep working.
+from tpudp.obs.tracing import step_annotation, trace  # noqa: F401
 
 
 def fetch_fence(tree: Any) -> None:

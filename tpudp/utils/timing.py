@@ -1,43 +1,5 @@
-"""Dispatch-honest timing helpers.
+"""Compatibility shim: :class:`StepTimer` moved to ``tpudp.obs.timing``
+(the one timing API — PR 11 folded the scattered timing helpers under
+``tpudp.obs``).  Import from ``tpudp.obs`` in new code."""
 
-The reference brackets ``time.time()`` around eager torch calls
-(``src/Part 2a/main.py:87-98``).  Under JAX async dispatch a naive bracket
-measures dispatch, not compute — every timer here FETCHES a leaf of the
-measured value before reading the clock (SURVEY.md §7 "timing honesty"
-hard part; BASELINE.md: under relay transports even ``block_until_ready``
-can return before device compute completes, so the shared
-:func:`tpudp.utils.profiler.fetch_fence` is the only reliable edge).
-"""
-
-from __future__ import annotations
-
-import time
-
-
-class StepTimer:
-    """Accumulates wall time across steps with fetch-fenced edges."""
-
-    def __init__(self):
-        self.total = 0.0
-        self.count = 0
-        self._t0 = None
-
-    def start(self) -> None:
-        self._t0 = time.perf_counter()
-
-    def stop(self, *block_on) -> float:
-        from tpudp.utils.profiler import fetch_fence
-
-        for x in block_on:
-            fetch_fence(x)
-        dt = time.perf_counter() - self._t0
-        self.total += dt
-        self.count += 1
-        return dt
-
-    @property
-    def mean(self) -> float:
-        return self.total / max(self.count, 1)
-
-    def reset(self) -> None:
-        self.total, self.count = 0.0, 0
+from tpudp.obs.timing import StepTimer  # noqa: F401
