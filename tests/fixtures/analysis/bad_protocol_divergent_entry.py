@@ -1,0 +1,26 @@
+# tpudp: protocol-module
+"""Seeded protocol-divergent-entry violations: entry into a rendezvous
+decided by per-host state — directly, and through a helper (the PR 7
+entry-probe bug shape: the probe is one function, the collective
+another)."""
+
+import os
+
+
+def resume_direct(root):
+    # BAD: a per-host filesystem probe decides whether this host joins
+    # the allgather — a peer with a stale listing never arrives.
+    if os.path.exists(root):
+        gather_host_values(1)  # noqa: F821
+
+
+def newest_checkpoint(root):
+    dirs = os.listdir(root)
+    return dirs[0] if dirs else None
+
+
+def resume_interprocedural(root):
+    # BAD: same bug, one call deep — the probe's host-locality travels
+    # through the helper's return-value summary.
+    if newest_checkpoint(root) is not None:
+        all_hosts_ok(True)  # noqa: F821

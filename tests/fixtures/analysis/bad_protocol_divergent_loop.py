@@ -1,0 +1,21 @@
+# tpudp: protocol-module
+"""Seeded protocol-divergent-loop violations: a rendezvous inside a
+loop whose trip count is per-host — hosts iterating different counts
+issue different numbers of collectives and desync."""
+
+import os
+
+
+def verify_all(root):
+    # BAD: the listing length differs per host (stale attribute cache),
+    # so hosts run different numbers of gathers.
+    for name in os.listdir(root):
+        all_hosts_ok(True)  # noqa: F821
+
+
+def drain(root):
+    # BAD: while-loop twin — the continuation condition is host-local.
+    pending = os.listdir(root)
+    while pending:
+        gather_host_values(len(pending))  # noqa: F821
+        pending = pending[1:]

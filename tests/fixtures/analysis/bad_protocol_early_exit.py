@@ -1,0 +1,21 @@
+# tpudp: protocol-module
+"""Seeded protocol-early-exit violations: a return/raise under a
+per-host guard skips a rendezvous peers still issue — the unmatched-
+gather deadlock (one host departs, its peer parks alone)."""
+
+import os
+
+
+def restore(root):
+    # BAD: a host whose listing probe fails returns early; its peer
+    # proceeds into the gather and waits forever.
+    if not os.path.exists(root):
+        return None
+    return gather_host_values(1)  # noqa: F821
+
+
+def save(root, state):
+    # BAD: same shape, raising instead of returning.
+    if os.stat(root).st_size == 0:
+        raise RuntimeError("empty root")
+    commit_after_all_hosts(root)  # noqa: F821

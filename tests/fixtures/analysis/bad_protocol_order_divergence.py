@@ -1,0 +1,16 @@
+# tpudp: protocol-module
+"""Seeded protocol-order-divergence violation: both arms rendezvous,
+but in different orders — hosts taking different arms deadlock pairwise
+(one waits in the vote, its peer in the barrier)."""
+
+import os
+
+
+def commit(root):
+    # BAD: a per-host probe picks WHICH order the two collectives run.
+    if os.path.exists(root):
+        _vote(1)  # noqa: F821
+        commit_after_all_hosts(root)  # noqa: F821
+    else:
+        commit_after_all_hosts(root)  # noqa: F821
+        _vote(0)  # noqa: F821

@@ -1,0 +1,22 @@
+# tpudp: protocol-module
+"""Corrected twin: entry into the rendezvous is itself a collective
+decision — the per-host fact travels THROUGH the vote, so every host
+takes the same arm."""
+
+import os
+
+
+def resume_direct(root):
+    # GOOD: coordinated_any's result is host-uniform by construction.
+    if coordinated_any(os.path.exists(root)):  # noqa: F821
+        gather_host_values(1)  # noqa: F821
+
+
+def newest_checkpoint(root):
+    dirs = os.listdir(root)
+    return dirs[0] if dirs else None
+
+
+def resume_interprocedural(root):
+    if coordinated_any(newest_checkpoint(root) is not None):  # noqa: F821
+        all_hosts_ok(True)  # noqa: F821

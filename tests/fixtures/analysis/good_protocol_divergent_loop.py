@@ -1,0 +1,20 @@
+# tpudp: protocol-module
+"""Corrected twin: the trip count is itself collectively agreed (the
+aligned minimum over hosts), so every host loops the same number of
+times."""
+
+import os
+
+
+def verify_all(root):
+    count = min(gather_host_values(len(os.listdir(root))))  # noqa: F821
+    for _ in range(count):
+        all_hosts_ok(True)  # noqa: F821
+
+
+def drain(root):
+    rounds = min(gather_host_values(len(os.listdir(root))))  # noqa: F821
+    remaining = rounds
+    while remaining:
+        gather_host_values(remaining)  # noqa: F821
+        remaining -= 1

@@ -1,0 +1,17 @@
+# tpudp: protocol-module
+"""Corrected twin: the early exits are guarded by collectively-agreed
+predicates, so every host departs (or proceeds) together."""
+
+import os
+
+
+def restore(root):
+    if not coordinated_any(os.path.exists(root)):  # noqa: F821
+        return None
+    return gather_host_values(1)  # noqa: F821
+
+
+def save(root, state):
+    if not all_hosts_ok(os.stat(root).st_size > 0):  # noqa: F821
+        raise RuntimeError("empty root on some host")
+    commit_after_all_hosts(root)  # noqa: F821

@@ -1,0 +1,11 @@
+# tpudp: protocol-module
+"""Corrected twin: the per-host fact feeds the vote's PAYLOAD, never
+the collective order — both arms issue the identical sequence."""
+
+import os
+
+
+def commit(root):
+    have = 1 if os.path.exists(root) else 0
+    _vote(have)  # noqa: F821
+    commit_after_all_hosts(root)  # noqa: F821

@@ -1,6 +1,6 @@
 """Tier-1 gates: the tree itself must satisfy its own static analysis.
 
-Two pins (ISSUE 8 acceptance bar):
+Four pins (ISSUE 8 + ISSUE 12 acceptance bars):
 
   * ``lint``: zero unsuppressed findings over tpudp/ — every sanctioned
     exception is a visible ``# tpudp: lint-ok(rule)`` in the diff, and
@@ -13,6 +13,15 @@ Two pins (ISSUE 8 acceptance bar):
     path is an explicit `audit --update` + lockfile diff, never a
     silent serve_bench regression.  Source digests must be fresh too,
     so the lock's provenance tracks every hot-path edit.
+  * ``protocol``: the cross-host protocol verifier reports zero
+    unsuppressed findings over the multihost modules, and the vote
+    state machine extracted from the live resilience source explores
+    deadlock-free — any new per-host-guarded rendezvous divergence is
+    an explicit reviewed suppression, never a latent pod deadlock.
+  * ``budget``: every pinned program's resource ledger (peak live
+    bytes, collective payload) is committed in the lock together with
+    the capture geometry — the upcoming paged-attention/TP-serving
+    work cannot silently regress HBM footprint or comms volume.
 """
 
 import os
@@ -47,3 +56,54 @@ def test_audit_matches_committed_lock(audit_capture):
         "\n\nif the trace change is intended: "
         "`python -m tpudp.analysis audit --update` and commit the "
         "tools/trace_lock.json diff (docs/ANALYSIS.md)")
+
+
+def test_protocol_clean_over_tree():
+    """Zero unsuppressed protocol findings tree-wide: every sanctioned
+    divergence (bounded-vote arms, the coordinated walk's alignment
+    loop, single-host-only exits) is a visible
+    `# tpudp: lint-ok(protocol-*)` with its justification."""
+    from tpudp.analysis.protocol import verify_paths
+
+    findings, errors = verify_paths(["tpudp"], ROOT)
+    assert errors == [], errors
+    assert findings == [], "\n".join(f.render() for f in findings) + (
+        "\n\nmake the rendezvous host-uniform (route the per-host fact "
+        "through a vote), or justify it with an explicit "
+        "`# tpudp: lint-ok(protocol-rule): why` (docs/ANALYSIS.md)")
+
+
+def test_vote_machine_spec_holds():
+    """The extracted vote/park spec must keep both load-bearing
+    properties (completion park + bounded timeout) and explore
+    deadlock-free — deleting either from resilience.py fails tier-1."""
+    from tpudp.analysis.protocol import (explore_vote_machine,
+                                         extract_vote_spec)
+
+    with open(os.path.join(ROOT, "tpudp", "resilience.py")) as f:
+        spec = extract_vote_spec(f.read(), n_hosts=3, max_faults=2,
+                                 max_crashes=1)
+    assert spec.completion_park, (
+        "Supervisor.run no longer parks clean finishers at a "
+        "completion vote — a late faulter would find no vote partner")
+    assert spec.bounded_timeout, (
+        "Supervisor._vote no longer bounds the vote wait — a dead peer "
+        "would hang survivors forever")
+    result = explore_vote_machine(spec)
+    assert result["violations"] == [], result["violations"][:3]
+
+
+def test_budget_ledgers_fresh_in_lock(audit_capture):
+    """Every pinned program carries a committed resource ledger, and
+    the committed ledgers equal the live capture's (the audit-compare
+    gate above covers deltas; this pins PRESENCE, so a lock written by
+    an old auditor cannot silently drop the budgets)."""
+    import json
+
+    with open(LOCK) as f:
+        lock = json.load(f)
+    assert lock.get("geometry") == audit_capture["geometry"]
+    assert set(lock["programs"]) == set(audit_capture["programs"])
+    for name, rec in lock["programs"].items():
+        assert "budget" in rec, f"{name} has no committed budget ledger"
+        assert rec["budget"] == audit_capture["programs"][name]["budget"]

@@ -288,9 +288,11 @@ def test_collective_gap_gate(tmp_path):
 
 def test_analysis_gap_stage(tmp_path):
     """The correctness-gate stage: a clean tree reports no gaps; a tree
-    with an unsuppressed finding owes `lint`, and a missing/stale
-    trace lock owes `audit` — all without importing jax (the poll-path
-    contract; tests/test_analysis.py proves the jax-free load)."""
+    with an unsuppressed finding owes `lint`, a missing/stale trace
+    lock owes `audit` (and, ledger-less, `budget`), and a protocol
+    divergence in a multihost module owes `protocol` — all without
+    importing jax (the poll-path contract; tests/test_analysis.py
+    proves the jax-free load)."""
     from tools.bench_gaps import analysis_missing
 
     # the real tree is the clean case — tier-1 pins it clean, so the
@@ -298,6 +300,7 @@ def test_analysis_gap_stage(tmp_path):
     assert analysis_missing() == []
 
     # seeded tree: one traced-branch violation + no lockfile at all
+    # (which owes both the audit staleness AND the budget ledgers)
     pkg = tmp_path / "tpudp"
     pkg.mkdir()
     (tmp_path / "tools").mkdir()       # configured lint paths must
@@ -309,7 +312,7 @@ def test_analysis_gap_stage(tmp_path):
         "    if x > 0:\n"
         "        return x\n"
         "    return -x\n")
-    assert analysis_missing(str(tmp_path)) == ["lint", "audit"]
+    assert analysis_missing(str(tmp_path)) == ["lint", "audit", "budget"]
 
     # fixing the violation (suppression counts: it is explicit in the
     # diff) leaves only the missing lock owed
@@ -319,10 +322,26 @@ def test_analysis_gap_stage(tmp_path):
         "@jax.jit\n"
         "def f(x):\n"
         "    return jax.numpy.where(x > 0, x, -x)\n")
-    assert analysis_missing(str(tmp_path)) == ["audit"]
+    assert analysis_missing(str(tmp_path)) == ["audit", "budget"]
+
+    # a protocol divergence in a module the verifier scopes (the PR 7
+    # entry-probe shape, in a file named like a multihost module) adds
+    # the protocol gap — INTERPROCEDURAL on purpose, so the lexical
+    # lint rule stays silent and the gap is the verifier's alone
+    (pkg / "resilience.py").write_text(
+        "import os\n\n\n"
+        "def probe(root):\n"
+        "    dirs = sorted(os.listdir(root))\n"
+        "    return dirs[0] if dirs else None\n\n\n"
+        "def resume(root):\n"
+        "    if probe(root) is not None:\n"
+        "        gather_host_values(1)  # noqa: F821\n")
+    assert analysis_missing(str(tmp_path)) == ["audit", "protocol",
+                                               "budget"]
+    (pkg / "resilience.py").unlink()
 
     # a configured lint path vanishing must read as a lint gap, not as
     # "clean" — the CLI exits 2 on the same condition and the two gates
     # must agree
     (tmp_path / "benchmarks").rmdir()
-    assert analysis_missing(str(tmp_path)) == ["lint", "audit"]
+    assert analysis_missing(str(tmp_path)) == ["lint", "audit", "budget"]

@@ -33,15 +33,18 @@ the missing work as arguments the benches accept:
                                            pod-scale kill-one-host soak
                                            seeds (multi-host resilience
                                            rows missing)
-    python tools/bench_gaps.py analysis -> "lint" if tpudp.analysis has
-                                           unsuppressed findings and/or
-                                           "audit" if tools/
-                                           trace_lock.json is stale
-                                           against the pinned hot-path
-                                           sources (correctness gates,
-                                           not TPU measurements — they
-                                           key off the TREE, not
-                                           bench_results/)
+    python tools/bench_gaps.py analysis -> any of "lint" (unsuppressed
+                                           findings), "audit" (tools/
+                                           trace_lock.json stale against
+                                           the pinned hot-path sources),
+                                           "protocol" (cross-host
+                                           protocol verifier findings
+                                           over the multihost modules),
+                                           "budget" (lockfile missing
+                                           resource ledgers/geometry)
+                                           (correctness gates, not TPU
+                                           measurements — they key off
+                                           the TREE, not bench_results/)
     python tools/bench_gaps.py obs      -> "sidecar" if serve-bench rows
                                            were measured without the
                                            tpudp.obs metrics sidecar
@@ -515,11 +518,17 @@ def analysis_missing(root: str | None = None) -> list[str]:
     ``audit`` when tools/trace_lock.json no longer matches the pinned
     hot-path sources (an edit landed without `audit --update`; the full
     jaxpr re-trace is the tier-1 test's job — this is the cheap stdlib
-    staleness proxy for the poll path)."""
+    staleness proxy for the poll path), ``protocol`` when the
+    cross-host protocol verifier has unsuppressed findings over the
+    multihost modules (stdlib, same file-path load), and ``budget``
+    when the lockfile lacks a resource ledger or capture geometry for
+    any pinned program (the jaxpr re-derivation is the tier-1 test's
+    job — this checks the committed artifact)."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     mod = _load_analysis()
     audit = importlib.import_module("_tpudp_analysis.audit")
+    protocol = importlib.import_module("_tpudp_analysis.protocol")
     gaps = []
     # a configured path that vanished must NOT read as "clean" — the
     # CLI exits 2 on exactly this ('no such path'), and the poll gate
@@ -533,6 +542,21 @@ def analysis_missing(root: str | None = None) -> list[str]:
     if audit.sources_stale(os.path.join(root, "tools", "trace_lock.json"),
                            root):
         gaps.append("audit")
+    pfindings, perrors = protocol.verify_paths(
+        ["tpudp"] if os.path.exists(os.path.join(root, "tpudp")) else [],
+        root)
+    if pfindings or perrors or not os.path.exists(
+            os.path.join(root, "tpudp")):
+        gaps.append("protocol")
+    budget = importlib.import_module("_tpudp_analysis.budget")
+    try:
+        with open(os.path.join(root, "tools", "trace_lock.json")) as f:
+            lock = json.load(f)
+        budget_ok = budget.lock_has_ledgers(lock)
+    except (OSError, json.JSONDecodeError):
+        budget_ok = False
+    if not budget_ok:
+        gaps.append("budget")
     return gaps
 
 

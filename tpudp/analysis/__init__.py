@@ -26,5 +26,9 @@ path without paying a jax import.
 # Relative imports throughout the package: tools/bench_gaps.py loads it
 # standalone (by file path, under a synthetic package name) to run the
 # lint gate without importing the jax-heavy `tpudp` parent package.
-from .core import Finding, Module, Rule, lint_paths  # noqa: F401
+from .core import (PROTOCOL_RULE_NAMES, Finding, Module,  # noqa: F401
+                   Rule, lint_paths)
+from .protocol import (VoteSpec, explore_vote_machine,  # noqa: F401
+                       extract_vote_spec)
+from .protocol import verify_paths as verify_protocol  # noqa: F401
 from .rules import RULES, RULES_BY_NAME  # noqa: F401

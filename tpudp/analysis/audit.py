@@ -35,7 +35,11 @@ import json
 import os
 import re
 
-LOCK_VERSION = 1
+#: Bumped whenever the lock schema changes shape (v2: per-program
+#: "budget" ledgers + top-level "geometry"), so an old committed lock
+#: fails with the version diagnostic and its --update advice instead
+#: of a misleading field-level mismatch.
+LOCK_VERSION = 2
 
 #: Substrings identifying collective primitives (matched against
 #: primitive names so jax renames like psum→psum2 keep being counted
@@ -152,9 +156,14 @@ def _census(jaxpr, acc) -> None:
                     _census(sub, acc)
 
 
-def fingerprint(fn, args) -> dict:
-    """Trace ``fn(*args)`` and reduce the jaxpr to its lock record."""
+def fingerprint(fn, args, donate_argnums=()) -> dict:
+    """Trace ``fn(*args)`` and reduce the jaxpr to its lock record —
+    fingerprint + census + the static resource ledger
+    (:mod:`tpudp.analysis.budget`, donation-aware via
+    ``donate_argnums``)."""
     import jax
+
+    from . import budget as _budget
 
     closed = jax.make_jaxpr(fn)(*args)
     text = _ADDR_RE.sub("0xX", str(closed))
@@ -166,12 +175,26 @@ def fingerprint(fn, args) -> dict:
         "collectives": acc["collectives"],
         "callbacks": acc["callbacks"],
         "transfers": acc["transfers"],
+        "budget": _budget.ledger(
+            closed, _budget.donated_flat_indices(args, donate_argnums)),
     }
+
+
+def geometry() -> dict:
+    """The capture environment's identity: the lock is only comparable
+    under the same jax backend/device-count (the audit pins cpu+8
+    virtual devices precisely so this never varies between hosts)."""
+    import jax
+
+    return {"platform": jax.default_backend(),
+            "devices": jax.device_count()}
 
 
 def capture(programs: dict | None = None) -> dict:
     """Trace every registered program → a lockfile-shaped dict."""
     import jax
+
+    from .programs import PROGRAM_DONATIONS
 
     if programs is None:
         from .programs import build_programs
@@ -179,10 +202,41 @@ def capture(programs: dict | None = None) -> dict:
     return {
         "version": LOCK_VERSION,
         "jax": jax.__version__,
-        "programs": {name: fingerprint(fn, args)
-                     for name, (fn, args) in programs.items()},
+        "geometry": geometry(),
+        "programs": {
+            name: fingerprint(
+                fn, args,
+                PROGRAM_DONATIONS.get(name.split("@")[0], ()))
+            for name, (fn, args) in programs.items()},
         "sources": source_digests(),
     }
+
+
+def identity_skew(lock: dict, current: dict) -> list[str]:
+    """NAMED version/geometry-skew diagnostics, checked BEFORE any
+    per-program diff: a different jax re-prints every jaxpr (and a
+    different device count re-derives every ledger), so reporting that
+    as thirteen per-program mismatches would bury the one actual
+    cause.  Shared by ``compare`` and the ``budget`` subcommand — any
+    consumer diffing lock records against a live capture must gate on
+    this first."""
+    problems: list[str] = []
+    if lock.get("jax") != current.get("jax"):
+        problems.append(
+            f"jax version skew: lock was generated under jax "
+            f"{lock.get('jax')}, this environment runs "
+            f"{current.get('jax')} — jaxpr text is only comparable "
+            f"within one jax version; regenerate with --update under "
+            f"the pinned toolchain")
+    elif lock.get("geometry") != current.get("geometry"):
+        problems.append(
+            f"capture geometry skew: lock was generated on "
+            f"{lock.get('geometry')}, this capture ran on "
+            f"{current.get('geometry')} — device count/backend are part "
+            f"of the lock identity (the audit pins cpu+8 virtual "
+            f"devices); rerun `python -m tpudp.analysis audit` in a "
+            f"fresh process, or --update if the pinned geometry changed")
+    return problems
 
 
 def compare(lock: dict, current: dict) -> list[str]:
@@ -193,11 +247,9 @@ def compare(lock: dict, current: dict) -> list[str]:
             f"lock version {lock.get('version')} != auditor version "
             f"{current['version']} — regenerate with --update")
         return problems
-    if lock.get("jax") != current["jax"]:
-        problems.append(
-            f"lock was generated under jax {lock.get('jax')}, this "
-            f"environment runs {current['jax']} — jaxpr text is only "
-            f"comparable within one jax version; regenerate with --update")
+    skew = identity_skew(lock, current)
+    if skew:
+        problems.extend(skew)
         return problems
     locked = lock.get("programs", {})
     live = current["programs"]
@@ -232,9 +284,25 @@ def compare(lock: dict, current: dict) -> list[str]:
                           f"{rec['transfers']}")
         if old.get("eqns") != rec["eqns"]:
             deltas.append(f"eqn count {old.get('eqns')} -> {rec['eqns']}")
+        from . import budget as _budget
+
+        budget_problems = _budget.compare_budgets(
+            name, old.get("budget"), rec.get("budget"))
+        deltas.extend(p.split(": ", 1)[1] for p in budget_problems)
         if not deltas:
-            deltas.append("jaxpr fingerprint changed at identical census "
-                          "— the traced math itself differs")
+            if old.get("fingerprint") == rec.get("fingerprint"):
+                # identical trace, differing record fields that cleared
+                # their tolerance bands (e.g. a donation-table edit
+                # re-derived peak_live_bytes within ±10%) — the lock is
+                # stale, not the math
+                deltas.append(
+                    "record fields changed within tolerance bands "
+                    "(budget ledger re-derived under new donation "
+                    "facts?) — the trace itself is identical; "
+                    "regenerate with --update to refresh the lock")
+            else:
+                deltas.append("jaxpr fingerprint changed at identical "
+                              "census — the traced math itself differs")
         problems.append(f"{name}: trace changed — " + "; ".join(deltas))
     cur_sources = current.get("sources", {})
     lock_sources = lock.get("sources", {})

@@ -1,13 +1,15 @@
-"""``python -m tpudp.analysis`` — lint and audit entry points.
+"""``python -m tpudp.analysis`` — lint, audit, protocol, budget, and
+the ``check`` umbrella.
 
 Exit codes compose with ``set -o pipefail`` harnesses: 0 = clean,
-1 = findings / audit mismatch, 2 = usage or internal error.
+1 = findings / audit mismatch, 2 = usage or internal error.  ``check``
+runs every gate and composes their codes (2 beats 1 beats 0).
 
-``lint`` is pure stdlib and runs anywhere; ``audit`` forces the CPU
-backend at the pinned smoke geometry (8 virtual devices) BEFORE jax
-initializes, so the committed lockfile is reproducible on any host —
-laptop, CI, or a TPU VM — and never depends on what accelerator
-happens to be attached.
+``lint`` and ``protocol`` are pure stdlib and run anywhere; ``audit``
+and ``budget`` force the CPU backend at the pinned smoke geometry
+(8 virtual devices) BEFORE jax initializes, so the committed lockfile
+is reproducible on any host — laptop, CI, or a TPU VM — and never
+depends on what accelerator happens to be attached.
 """
 
 from __future__ import annotations
@@ -20,6 +22,9 @@ import sys
 from .audit import repo_root
 
 DEFAULT_LOCK = os.path.join("tools", "trace_lock.json")
+
+#: What `check` lints (tier-1's tree-wide scope) when no paths given.
+CHECK_LINT_PATHS = ("tpudp", "tools", "benchmarks")
 
 
 def _cmd_lint(args) -> int:
@@ -96,6 +101,147 @@ def _cmd_audit(args) -> int:
     return 0
 
 
+def _cmd_protocol(args) -> int:
+    from .protocol import (PROTOCOL_MODULES, VoteSpec, explore_vote_machine,
+                           extract_vote_spec, verify_paths)
+
+    root = repo_root()
+    paths = args.paths or ["tpudp"]
+    missing = [p for p in paths if not os.path.exists(
+        p if os.path.isabs(p) else os.path.join(root, p))]
+    if missing:
+        print(f"error: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings, errors = verify_paths(paths, root)
+    for err in errors:
+        print(f"error: {err}", file=sys.stderr)
+    for f in findings:
+        print(f.render())
+    rc = 1 if findings or errors else 0
+    n = len(findings)
+    print(f"tpudp.analysis protocol: {n} finding{'s' if n != 1 else ''} "
+          f"over the multihost modules ({len(PROTOCOL_MODULES)} in scope)")
+    if not args.no_model_check:
+        # Bounded interleaving exploration of the vote/park machine, the
+        # spec extracted from the LIVE resilience source: deleting the
+        # completion park or the bounded timeout fails here.
+        res_path = os.path.join(root, "tpudp", "resilience.py")
+        try:
+            with open(res_path, encoding="utf-8") as f:
+                spec = extract_vote_spec(f.read(), n_hosts=args.hosts,
+                                         max_faults=2, max_crashes=1)
+        except OSError as exc:
+            print(f"error: cannot read {res_path}: {exc}", file=sys.stderr)
+            return 2
+        result = explore_vote_machine(spec)
+        if result["violations"]:
+            for v in result["violations"][:8]:
+                print(f"vote machine {v['kind']}: {v['detail']} "
+                      f"[state {v['state']}]")
+            print(f"tpudp.analysis protocol: vote state machine has "
+                  f"{len(result['violations'])} violation(s) within bounds "
+                  f"(hosts={spec.n_hosts}, faults<=2/host, crashes<=1; "
+                  f"extracted spec: completion_park={spec.completion_park}, "
+                  f"bounded_timeout={spec.bounded_timeout})")
+            rc = max(rc, 1)
+        else:
+            print(f"tpudp.analysis protocol: vote state machine "
+                  f"deadlock-free within bounds ({result['states']} states; "
+                  f"hosts={spec.n_hosts}, faults<=2/host, crashes<=1)")
+        # the spec a correct protocol must extract to
+        if not (spec.completion_park and spec.bounded_timeout):
+            rc = max(rc, 1)
+    return rc
+
+
+def _cmd_budget(args) -> int:
+    import json as _json
+
+    from . import audit, budget
+
+    root = repo_root()
+    lock_path = os.path.join(root, args.lock)
+    try:
+        lock = audit.load_lock(lock_path)
+    except FileNotFoundError:
+        print(f"error: no lockfile at {args.lock} — run "
+              f"`python -m tpudp.analysis audit --update` and commit it",
+              file=sys.stderr)
+        return 1
+    except (OSError, _json.JSONDecodeError) as exc:
+        print(f"error: unreadable lockfile {args.lock} "
+              f"({type(exc).__name__}: {exc})", file=sys.stderr)
+        return 1
+    if args.table:
+        print(budget.render_table(lock.get("programs", {})))
+        if not budget.lock_has_ledgers(lock):
+            missing = sorted(n for n, rec in lock.get("programs",
+                                                      {}).items()
+                             if "budget" not in rec)
+            what = (f"{len(missing)} program(s) without a ledger: "
+                    f"{', '.join(missing)}" if missing
+                    else "no capture geometry recorded")
+            print(f"tpudp.analysis budget: lock is not budget-complete "
+                  f"({what}) — regenerate with `audit --update`")
+            return 1
+        return 0
+    try:
+        audit.force_smoke_backend()
+    except RuntimeError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    current = audit.capture()
+    # same skew gate as `audit`: a jax/geometry mismatch must be ONE
+    # named diagnostic, never a per-program budget-mismatch storm with
+    # misleading --update advice
+    skew = audit.identity_skew(lock, current)
+    if skew:
+        for p in skew:
+            print(p)
+        return 1
+    problems = []
+    locked = lock.get("programs", {})
+    for name, rec in current["programs"].items():
+        problems.extend(budget.compare_budgets(
+            name, locked.get(name, {}).get("budget"), rec.get("budget")))
+    for p in problems:
+        print(p)
+    n = len(current["programs"])
+    if problems:
+        print(f"tpudp.analysis budget: {len(problems)} budget "
+              f"mismatch{'es' if len(problems) != 1 else ''} against "
+              f"{args.lock}")
+        return 1
+    print(f"tpudp.analysis budget: {n} program ledgers within tolerance "
+          f"of {args.lock}")
+    return 0
+
+
+def _cmd_check(args) -> int:
+    """The umbrella gate: lint + protocol (stdlib) then audit incl.
+    budget (jax), exit codes composed — 2 (usage/internal) beats 1
+    (findings) beats 0."""
+    import argparse as _argparse
+
+    rcs = []
+    print("== lint ==")
+    rcs.append(_cmd_lint(_argparse.Namespace(
+        paths=list(CHECK_LINT_PATHS), list_rules=False)))
+    print("== protocol ==")
+    rcs.append(_cmd_protocol(_argparse.Namespace(
+        paths=["tpudp"], no_model_check=False, hosts=3)))
+    print("== audit (trace + budget ledgers) ==")
+    rcs.append(_cmd_audit(_argparse.Namespace(
+        update=False, lock=args.lock)))
+    rc = max(rcs)
+    names = ["lint", "protocol", "audit+budget"]
+    status = ", ".join(f"{n}={'ok' if c == 0 else f'FAIL({c})'}"
+                       for n, c in zip(names, rcs))
+    print(f"tpudp.analysis check: {status}")
+    return rc
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpudp.analysis",
@@ -122,6 +268,41 @@ def main(argv=None) -> int:
     aud.add_argument("--lock", default=DEFAULT_LOCK,
                      help="lockfile path relative to the repo root")
     aud.set_defaults(fn=_cmd_audit)
+
+    proto = sub.add_parser(
+        "protocol", help="path-sensitive cross-host protocol verifier "
+                         "over the multihost modules (host-uniform "
+                         "collective sequences) + bounded vote-machine "
+                         "model check; stdlib-only")
+    proto.add_argument("paths", nargs="*",
+                       help="files/directories, relative to the repo root "
+                            "(default: tpudp/)")
+    proto.add_argument("--no-model-check", action="store_true",
+                       help="skip the vote state-machine exploration")
+    proto.add_argument("--hosts", type=int, default=3,
+                       help="host count bound for the interleaving "
+                            "explorer (default 3)")
+    proto.set_defaults(fn=_cmd_protocol)
+
+    bud = sub.add_parser(
+        "budget", help="diff the per-program resource ledgers (peak live "
+                       "bytes, collective payload) against the lockfile; "
+                       "--table prints the committed ledgers without "
+                       "tracing (stdlib)")
+    bud.add_argument("--lock", default=DEFAULT_LOCK,
+                     help="lockfile path relative to the repo root")
+    bud.add_argument("--table", action="store_true",
+                     help="print the committed ledger table and exit "
+                          "(no jax import)")
+    bud.set_defaults(fn=_cmd_budget)
+
+    chk = sub.add_parser(
+        "check", help="umbrella gate: lint + protocol + audit (with "
+                      "budget ledgers), exit codes composed — nonzero "
+                      "if ANY gate fails")
+    chk.add_argument("--lock", default=DEFAULT_LOCK,
+                     help="lockfile path relative to the repo root")
+    chk.set_defaults(fn=_cmd_check)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -31,6 +31,48 @@ import tokenize
 SUPPRESS_RE = re.compile(r"#\s*tpudp:\s*lint-ok\(([a-z0-9_\-,\s]+)\)")
 MARKER_RE = re.compile(r"#\s*tpudp:\s*([a-z0-9\-]+)\b")
 
+#: Rules owned by the protocol verifier (tpudp/analysis/protocol.py).
+#: The lint pass and the protocol pass share one suppression syntax but
+#: check different rule sets, so each pass reports useless suppressions
+#: only for the names IT owns — a `lint-ok(protocol-*)` that matches
+#: nothing is flagged by the protocol pass, a typo'd name that belongs
+#: to neither is still flagged by lint.  Defined here (not in
+#: protocol.py) to keep the import graph acyclic; protocol.py re-uses
+#: this set and a test pins it against the shipped protocol rules.
+PROTOCOL_RULE_NAMES = frozenset({
+    "protocol-divergent-entry",
+    "protocol-order-divergence",
+    "protocol-early-exit",
+    "protocol-divergent-loop",
+})
+
+#: The multihost modules the protocol verifier covers by default:
+#: everywhere a cross-process rendezvous is issued or decided.  Files
+#: outside this scope (and without a ``# tpudp: protocol-module``
+#: marker) are never verified, so lint must NOT defer their
+#: protocol-rule suppressions — a stale `lint-ok(protocol-*)` in an
+#: out-of-scope file would otherwise be flagged by neither pass.
+#: Defined here (not in protocol.py) so lint can make that scope
+#: decision without a circular import; protocol.py re-exports it.
+PROTOCOL_MODULES = (
+    "tpudp/resilience.py",
+    "tpudp/utils/checkpoint.py",
+    "tpudp/utils/consistency.py",
+    "tpudp/mesh.py",
+    "tpudp/cli.py",
+    "tpudp/train.py",
+    "tpudp/serve/engine.py",
+    "tpudp/obs/flight.py",
+)
+
+
+def in_protocol_scope(rel: str, markers: set[str]) -> bool:
+    """Is this file one the protocol verifier analyzes?  By configured
+    module path, or by an explicit first-lines marker."""
+    rel = rel.replace(os.sep, "/")
+    return ("protocol-module" in markers
+            or any(rel.endswith(m) for m in PROTOCOL_MODULES))
+
 #: Attribute reads that yield *static* (host, trace-time-constant)
 #: values even on traced arrays — branching or syncing on these is fine.
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
@@ -475,7 +517,11 @@ def lint_paths(paths: list[str], root: str, rules=None,
                 if not mod.suppressions.allows(finding.line, rule.name):
                     findings.append(finding)
         if report_useless:
+            in_protocol = in_protocol_scope(mod.rel, mod.markers)
             for line, rule_name in mod.suppressions.unused():
+                if rule_name in PROTOCOL_RULE_NAMES and in_protocol:
+                    continue  # the protocol pass owns these names HERE;
+                    # out of its scope nothing would ever report them
                 findings.append(Finding(
                     "useless-suppression", mod.rel, line, 0,
                     f"lint-ok({rule_name}) suppresses nothing — remove it "

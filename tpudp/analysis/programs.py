@@ -61,6 +61,29 @@ TRACE_COUNTER_PROGRAMS = {
     "draft_model": "serve.draft_model",
 }
 
+#: Donated ARGUMENT positions per program (name before the ``@``),
+#: mirroring the runtime ``donate_argnums`` at each build site (the
+#: same facts the use-after-donation rule tables in rules.py).  The
+#: budget pass (tpudp/analysis/budget.py) uses these for its
+#: donation-aware peak-live-bytes sweep: a donated buffer's storage is
+#: reusable after its last read, a non-donated one is resident for the
+#: whole call.
+PROGRAM_DONATIONS = {
+    "serve.decode_step": (0, 8),
+    "serve.verify_step": (0, 9),
+    "serve.prefill_chunk": (0,),
+    "serve.fused_decode": (0, 11),
+    "serve.fused_decode_stream": (0, 11),
+    "serve.sample_row": (),
+    "serve.draft_model": (),
+    "prefix.copy_block_in": (0,),
+    "prefix.copy_block_out": (1,),
+    "train.step_single": (0,),
+    "train.step_dp_allreduce": (0,),
+    "train.step_dp_ring": (0,),
+    "train.eval_step": (),
+}
+
 # Serve smoke geometry: 2 slots x 32 arena positions, chunk 8, k=3,
 # fused window 4 — the same scale tests/test_serve.py exercises.
 SERVE = dict(vocab=64, seq=64, layers=2, heads=2, d_model=32,

@@ -369,6 +369,11 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
             # Stale listing on this host; the dump's location is fixed,
             # and the voted restore below decides its fate for all hosts.
             emerg = os.path.join(args.checkpoint_dir, "emergency")
+        # tpudp: lint-ok(protocol-early-exit): `emerg` is host-uniform
+        # by protocol at this point — coordinated_any above agreed on
+        # whether a dump exists, and hosts with a stale listing were
+        # fixed up to the shared dump path, so every host takes the
+        # same arm here (the voted restore inside decides its fate).
         if emerg:
             # Refuse a mismatched relaunch BEFORE the dump is consumed:
             # the fast-forward below maps the optimizer-step counter onto
@@ -383,6 +388,11 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
             dumped_pe = sent.get("per_epoch_batches")
             if (not args.eval_only and dumped_pe is not None
                     and dumped_pe != len(train_loader)):
+                # tpudp: lint-ok(protocol-early-exit): every host reads
+                # the SAME sentinel file and computes the same loader
+                # length from the same dataset/--batch-size, so a
+                # batch-grid mismatch aborts the whole pod together —
+                # no peer proceeds to the voted restore alone.
                 raise SystemExit(
                     f"error: emergency dump at {emerg} was written with "
                     f"{dumped_pe} batches/epoch but this relaunch has "
@@ -409,6 +419,11 @@ def run_part(sync: str, description: str, *, spmd_mode: str = "shard_map",
                 trainer.state = dump_state
             else:
                 emerg = None
+        # tpudp: lint-ok(protocol-early-exit): same justification as
+        # the first `if emerg:` above — after the coordinated_any
+        # fixup, emerg is None on every host or on none (and the voted
+        # restore's outcome is collectively agreed), so all hosts take
+        # the same arm into the consume barrier.
         if emerg:
             restored = True
             if args.eval_only:

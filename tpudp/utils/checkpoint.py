@@ -574,6 +574,11 @@ def restore_latest_verified(root: str | os.PathLike, target: Any, *,
     multihost = jax.process_count() > 1
     pending = step_dirs_newest_first(root)
     if not pending and not multihost:
+        # tpudp: lint-ok(protocol-early-exit): single-host-only raise —
+        # the `not multihost` conjunct (process_count, host-uniform)
+        # makes this arm unreachable on a pod; multihost exhaustion is
+        # voted through the alignment gather below (-1 proposal), which
+        # aborts every host together.
         raise FileNotFoundError(f"no step_N checkpoints under {os.fspath(root)!r}")
 
     def _barrier(tag: str) -> None:
@@ -601,6 +606,13 @@ def restore_latest_verified(root: str | os.PathLike, target: Any, *,
             # in a collective nobody else will join.
             while True:
                 head = _step_of(pending[0]) if pending else -1
+                # tpudp: lint-ok(protocol-divergent-loop): the outer
+                # walk loop's condition is `pending or multihost` — on a
+                # pod the multihost flag alone keeps every host in the
+                # loop regardless of its per-host listing, and a host
+                # whose series is exhausted proposes -1 through this
+                # gather, aborting ALL hosts in the same round; trip
+                # counts therefore agree pod-wide by protocol.
                 proposals = gather_host_values(head)
                 aligned = min(proposals)
                 if aligned < 0:
@@ -660,6 +672,13 @@ def restore_latest_verified(root: str | os.PathLike, target: Any, *,
             except Exception as e:
                 reason = f"{type(e).__name__}: {e}"
             if all_hosts_ok(reason is None, step_no):
+                # tpudp: lint-ok(protocol-early-exit): the ternary's
+                # arm choice is host-uniform in practice — coverage has
+                # one flag per manifest shard record, and every host
+                # reads the SAME manifest files in the same order
+                # (verify_restored_coverage's documented contract), so
+                # `coverage` is empty on every host or on none and all
+                # hosts enter the coverage-union gather together.
                 uncovered = (_coverage_union_uncovered(coverage)
                              if multihost and coverage else 0)
                 if not uncovered:
