@@ -76,6 +76,17 @@ TTFT p50/p99 for both, the hit-token counts
 (``stats["prefix_hit_tokens"]``/``["prefix_lookups"]``), and the
 headline ``value`` = uncached/cached TTFT p50 ratio.
 
+With ``--paged shared_prefix`` (or SERVE_PAGED) the bench instead emits
+one ``serve_paged`` row per workload: the TRUE paged engine
+(``Engine(kv_pages=N)`` — per-slot block tables into one shared page
+pool, cache hits as table writes with copy-on-write at the divergence
+block) vs the dense copy-cache engine at the SAME KV byte budget.
+The row reports the peak co-resident contexts each engine sustained
+(headline ``value`` = their ratio, gated >= 1.5x with zero
+page-pressure vacates — ``capacity_ok``), TTFT p50/p99 for both, the
+paged engine's table-hit accounting, and the in-bench greedy
+``parity_ok``.
+
 With ``--soak SEED1,SEED2`` (or SERVE_SOAK) the bench instead runs the
 fault-injection SOAK harness (one ``serve_soak`` row per seed): a
 deterministic per-seed mix of random cancels, impossible and tight
@@ -131,16 +142,23 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
-                              SERVE_FUSED_NS, SERVE_PREFIX_WORKLOADS,
-                              SERVE_SOAK_SEEDS, SERVE_SPEC_KS,
-                              SERVE_TENANCY_SEEDS)
+                              SERVE_FUSED_NS, SERVE_PAGED_WORKLOADS,
+                              SERVE_PREFIX_WORKLOADS, SERVE_SOAK_SEEDS,
+                              SERVE_SPEC_KS, SERVE_TENANCY_SEEDS)
 
 METRIC = "serve_tokens_per_sec"
 SPEC_METRIC = "serve_spec_tokens_per_sec"
 SOAK_METRIC = "serve_soak"
 PREFIX_METRIC = "serve_prefix"
+PAGED_METRIC = "serve_paged"
 TENANCY_METRIC = "serve_tenancy"
 FUSED_METRIC = "serve_fused"
+
+#: The serve_paged capacity gate: the paged engine must sustain at
+#: least this many times the dense engine's co-resident contexts at
+#: the same KV byte budget (with zero page-pressure vacates) for the
+#: row to count (ISSUE 13 acceptance bar).
+PAGED_CAPACITY_BOUND = 1.5
 
 #: Slack on the fused dispatch gate: staggered prefill completions pay
 #: a few single-step decodes before the first window, so the measured
@@ -181,6 +199,12 @@ def main() -> None:
                          "(shared_prefix, multiturn); emits TTFT "
                          "cache-on/off rows instead of the concurrency "
                          "sweep (env: SERVE_PREFIX)")
+    ap.add_argument("--paged", default=None,
+                    help="comma-separated paged-attention workloads "
+                         "(shared_prefix); emits the paged-vs-copy "
+                         "capacity + TTFT row — Engine(kv_pages=N) vs "
+                         "the dense copy-cache engine at the same KV "
+                         "byte budget (env: SERVE_PAGED)")
     ap.add_argument("--tenants", default=None,
                     help="comma-separated multi-tenant seeds; runs the "
                          "mixed-priority tenancy workload (per-tier "
@@ -234,13 +258,21 @@ def main() -> None:
         # workload name is a typo, not an unregistered sweep point).
         raise SystemExit(f"error: unknown prefix workloads {bad_w} "
                          f"(registry: {list(SERVE_PREFIX_WORKLOADS)})")
+    paged_env = args.paged or os.environ.get("SERVE_PAGED")
+    paged_workloads = ([w for w in paged_env.split(",") if w]
+                       if paged_env else [])
+    bad_p = [w for w in paged_workloads if w not in SERVE_PAGED_WORKLOADS]
+    if bad_p:
+        raise SystemExit(f"error: unknown paged workloads {bad_p} "
+                         f"(registry: {list(SERVE_PAGED_WORKLOADS)})")
     levels_env = os.environ.get("SERVE_CONCURRENCY")
     levels = (_parse_levels(levels_env)
               if levels_env else list(SERVE_CONCURRENCIES))
     if os.environ.get("SERVE_STRICT_LEVELS") == "1":
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
         if (not spec_ks and not soak_seeds and not prefix_workloads
-                and not tenancy_seeds and not fused_ns and bad):
+                and not paged_workloads and not tenancy_seeds
+                and not fused_ns and bad):
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
         bad_k = [k for k in spec_ks if k not in SERVE_SPEC_KS]
@@ -304,10 +336,11 @@ def main() -> None:
     prefix_turns = int(os.environ.get("SERVE_PREFIX_TURNS", 3))
     prefix_tail = max(chunk // 2, 1)
     slack = max(spec_ks, default=0)  # speculative windows need k scratch
-    if prefix_workloads:
+    if prefix_workloads or paged_workloads:
         # The deepest multiturn prompt is the whole prior conversation:
         # shared prefix + `turns` user tails + (turns-1) responses, plus
-        # this turn's generation.
+        # this turn's generation.  (The paged rows only need one turn's
+        # worth; sharing the geometry keeps the two stages comparable.)
         need = (prefix_len + prefix_turns * prefix_tail
                 + prefix_turns * max_new)
     else:
@@ -484,7 +517,8 @@ def main() -> None:
     seq_tps = per_req_s = None
     seq_latencies = []
     if (not spec_ks and not soak_seeds and not prefix_workloads
-            and not tenancy_seeds and not fused_ns):
+            and not paged_workloads and not tenancy_seeds
+            and not fused_ns):
         np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
                             max_new))
         t0 = time.perf_counter()
@@ -1175,6 +1209,111 @@ def main() -> None:
             "device_kind": kind,
         })
 
+    def run_paged(workload: str) -> None:
+        """One paged-vs-copy row: the TRUE paged engine
+        (``Engine(kv_pages=N)`` — per-slot block tables into one shared
+        page pool, cache hits as table writes, copy-on-write at the
+        divergence block) against the dense copy-cache engine
+        (``prefix_cache_blocks=N``) at the SAME KV byte budget, on the
+        shared-system-prompt workload paging exists for.
+
+        The byte budget is the dense engine's arena: ``dense_slots x
+        max_len`` tokens, i.e. ``kv_pages = dense_slots x max_len /
+        chunk`` pages (the copy engine additionally keeps its own
+        block pool on top — a handicap AGAINST the paged row).  Both
+        engines are warmed with one shared-prefix request (compiles
+        off the clock AND publishes the prefix), then serve the
+        identical burst.  Columns: ``contexts_paged`` /
+        ``contexts_dense`` — the peak co-resident in-flight contexts
+        each engine sustained (the paged engine runs ``2 x
+        dense_slots`` slots and must hold them with ZERO page-pressure
+        vacates for ``capacity_ok``); headline ``value`` = their
+        ratio, gated at >= PAGED_CAPACITY_BOUND; TTFT p50/p99 for
+        both; and the in-bench greedy ``parity_ok`` (paged outputs
+        bit-identical to the copy engine's)."""
+        prng = np.random.default_rng(seed + 5)
+        shared = prng.integers(0, cfg.vocab_size,
+                               size=prefix_len).astype(np.int32)
+        dense_slots = prefix_conc
+        paged_slots = 2 * dense_slots
+        n_burst = max(n_requests, 2 * paged_slots)
+        reqs = [np.concatenate([shared, prng.integers(
+            0, cfg.vocab_size, size=prefix_tail).astype(np.int32)])
+            for _ in range(n_burst)]
+        pages_per_slot = cfg.max_seq_len // chunk
+        kv_pages = dense_slots * pages_per_slot
+
+        def run(e):
+            # Warm: compile programs off the clock AND publish the
+            # shared prefix, so the measured burst's hits are the
+            # steady-state traffic shape (the warm handle's output
+            # also rides the parity check).
+            warm = e.submit(reqs[0], max_new, seed=seed)
+            e.run_until_complete()
+            outputs = [warm.tokens]
+            handles = [e.submit(p, max_new, seed=seed + 1 + i)
+                       for i, p in enumerate(reqs[1:])]
+            peak = 0
+            while e.slots_in_use or e.queue_depth:
+                e.step()
+                peak = max(peak, e.slots_in_use)
+            outputs += [h.tokens for h in handles]
+            ttfts = [h.token_times[0] - h.submit_time for h in handles
+                     if h.token_times]
+            return outputs, peak, ttfts
+
+        dense = Engine(model, params, num_slots=dense_slots,
+                       max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                       prefix_cache_blocks=prefix_blocks)
+        dense_out, dense_peak, dense_ttfts = run(dense)
+        paged = Engine(model, params, num_slots=paged_slots,
+                       max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                       kv_pages=kv_pages)
+        paged_out, paged_peak, paged_ttfts = run(paged)
+        paged.check_paged()
+        vacates = int(paged.stats["page_pressure_vacates"])
+        ratio = paged_peak / dense_peak if dense_peak else None
+        capacity_ok = (ratio is not None and vacates == 0
+                       and ratio >= PAGED_CAPACITY_BOUND)
+        pool = paged.page_pool
+        emit({
+            "metric": PAGED_METRIC,
+            "workload": workload,
+            "value": round(ratio, 3) if ratio is not None else None,
+            "unit": "co_resident_contexts_vs_dense_at_fixed_pool_bytes",
+            "capacity_ok": capacity_ok,
+            "capacity_bound": PAGED_CAPACITY_BOUND,
+            "contexts_paged": paged_peak,
+            "contexts_dense": dense_peak,
+            "page_pressure_vacates": vacates,
+            "kv_pages": kv_pages,
+            "page_tokens": chunk,
+            "pool_bytes": kv_pages * pool.page_bytes(),
+            "pages_used_end": int(pool.used_pages),
+            "prefix_hit_tokens": int(paged.stats["prefix_hit_tokens"]),
+            "prefix_lookups": int(paged.stats["prefix_lookups"]),
+            "prefix_published_blocks": int(
+                paged.stats["prefix_published_blocks"]),
+            "ttft_p50_ms": round(_percentile(paged_ttfts, 50) * 1e3, 3),
+            "ttft_p99_ms": round(_percentile(paged_ttfts, 99) * 1e3, 3),
+            "ttft_p50_copy_ms": round(
+                _percentile(dense_ttfts, 50) * 1e3, 3),
+            "ttft_p99_copy_ms": round(
+                _percentile(dense_ttfts, 99) * 1e3, 3),
+            "parity_ok": paged_out == dense_out,
+            "dense_slots": dense_slots,
+            "paged_slots": paged_slots,
+            "requests": n_burst,
+            "prefix_len": prefix_len,
+            "max_new_tokens": max_new,
+            "prefill_chunk": chunk,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        })
+        bank_metrics("serve_paged", workload, paged.metrics())
+
     # One level crashing (OOM, transient backend fault) must not cost
     # the remaining rows — same isolation contract as matrix_bench.
     if tenancy_seeds:
@@ -1206,6 +1345,16 @@ def main() -> None:
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
         write_sidecar()
         print(json.dumps({"serve_prefix": results}))
+        return
+    if paged_workloads:
+        for w in paged_workloads:
+            try:
+                run_paged(w)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": PAGED_METRIC, "workload": w,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
+        print(json.dumps({"serve_paged": results}))
         return
     if fused_ns:
         for n in fused_ns:

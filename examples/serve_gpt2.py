@@ -20,6 +20,11 @@ this demo is about throughput and interleaving, not different text.
   # blocks instead of re-prefilling (outputs bit-identical either way):
   python examples/serve_gpt2.py --prefix-cache-blocks 64 --platform cpu
 
+  # True paged attention: slots read KV through per-slot block tables
+  # into one shared refcounted page pool — a shared-prefix hit is a
+  # TABLE WRITE, not a copy (outputs bit-identical either way):
+  python examples/serve_gpt2.py --paged 64 --platform cpu
+
   # Multi-tenant tiers: 2 high-priority requests ride over 6 low ones;
   # the high tier preempts low in-flight slots, every preempted request
   # resumes and finishes bit-identically (first listed = highest tier):
@@ -78,6 +83,19 @@ def main() -> None:
                         "requests sharing a prompt prefix copy cached "
                         "blocks instead of re-prefilling (0 = off; "
                         "output is identical either way)")
+    p.add_argument("--paged", type=int, default=0, metavar="KV_PAGES",
+                   help="true paged attention: replace the dense slot "
+                        "arena with this many shared KV pool pages read "
+                        "through per-slot block tables — prefix hits "
+                        "become table writes with copy-on-write at the "
+                        "divergence block (0 = off; output is identical "
+                        "either way; mutually exclusive with "
+                        "--prefix-cache-blocks)")
+    p.add_argument("--kv-dtype", choices=["int8"], default=None,
+                   help="with --paged: store page payloads quantized "
+                        "int8 (~2x tokens per pool byte; outputs then "
+                        "match within quantization tolerance, not "
+                        "bit-exactly)")
     p.add_argument("--tenants", type=str, default=None,
                    help="multi-tenant demo: comma-separated name:count "
                         "pairs (e.g. high:2,low:6); each name becomes a "
@@ -123,6 +141,10 @@ def main() -> None:
     if args.prefix_cache_blocks < 0:
         raise SystemExit(f"error: --prefix-cache-blocks must be >= 0 "
                          f"(got {args.prefix_cache_blocks})")
+    if args.paged < 0:
+        raise SystemExit(f"error: --paged must be >= 0 (got {args.paged})")
+    if args.kv_dtype and not args.paged:
+        raise SystemExit("error: --kv-dtype requires --paged")
     if args.decode_fuse < 1:
         raise SystemExit(f"error: --decode-fuse must be >= 1 "
                          f"(got {args.decode_fuse})")
@@ -185,6 +207,7 @@ def main() -> None:
                                            args.seq_len),
                     speculate_k=args.speculate_k,
                     prefix_cache_blocks=args.prefix_cache_blocks,
+                    kv_pages=args.paged, kv_dtype=args.kv_dtype,
                     decode_fuse=args.decode_fuse,
                     tenants=tenants)
 
@@ -249,6 +272,13 @@ def main() -> None:
                  f"{engine.stats['prefix_hit_tokens']} "
                  f"(pool {engine.prefix_cache.used_blocks}"
                  f"/{args.prefix_cache_blocks} blocks)")
+    if args.paged:
+        pool = engine.page_pool
+        spec += (f" | paged: hit tokens="
+                 f"{engine.stats['prefix_hit_tokens']} via table "
+                 f"writes, pool {pool.used_pages}/{pool.num_pages} "
+                 f"pages ({engine.stats['page_pressure_vacates']} "
+                 f"pressure vacates)")
     if args.decode_fuse > 1:
         spec += (f" | fused windows={engine.stats['fused_windows']} "
                  f"({engine.stats['fused_steps']} on-device decode "

@@ -260,6 +260,85 @@ def test_serve_prefix_gap_gate(tmp_path):
     assert serve_prefix_missing(d) == []  # banked history row counts
 
 
+def test_serve_paged_bench_rows_parse():
+    """The serve_paged stage's CPU smoke (tier-1's guard on the
+    paged-attention bench the TPU watcher resumes): the registered
+    workload emits a parseable row where the paged engine sustained
+    >= 1.5x the dense copy engine's co-resident contexts at the same
+    KV byte budget (capacity_ok, zero page-pressure vacates), with
+    real table-indirected cache traffic and bit-exact parity."""
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu",
+        "SERVE_PAGED": "shared_prefix",
+        "SERVE_LAYERS": "1", "SERVE_DMODEL": "64", "SERVE_VOCAB": "128",
+        "SERVE_REQUESTS": "8", "SERVE_MAX_NEW": "8", "SERVE_CHUNK": "8",
+        "SERVE_PREFIX_LEN": "24", "SERVE_PREFIX_TURNS": "2",
+        "SERVE_PREFIX_USERS": "2", "SERVE_PREFIX_CONCURRENCY": "2",
+        "SERVE_PREFIX_BLOCKS": "16",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byw = {r["workload"]: r for r in rows
+           if r.get("metric") == "serve_paged" and "workload" in r}
+    assert set(byw) == {"shared_prefix"}, proc.stderr[-800:]
+    r = byw["shared_prefix"]
+    assert "error" not in r, r
+    assert r["value"] >= 1.5                # the capacity bar itself
+    assert r["capacity_ok"] is True
+    assert r["page_pressure_vacates"] == 0  # the pool genuinely held them
+    assert r["contexts_paged"] > r["contexts_dense"]
+    assert r["prefix_hit_tokens"] > 0       # hits were table writes
+    assert r["parity_ok"] is True           # bit-exact vs the copy engine
+    assert r["ttft_p50_ms"] > 0 and r["ttft_p50_copy_ms"] > 0
+    assert r["pool_bytes"] > 0 and r["kv_pages"] > 0
+    # unregistered workload names fail fast, like the prefix stage
+    bad = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu", "SERVE_PAGED": "shared_prefx"},
+        timeout=300)
+    assert bad.returncode != 0
+    assert "paged workloads" in (bad.stderr + bad.stdout)
+
+
+def test_serve_paged_gap_gate(tmp_path):
+    """tools/bench_gaps serve_paged stage: CPU smoke rows, error rows,
+    parity-broken rows, capacity-missed rows, and zero-hit rows never
+    close the workload; a banked TPU row passing every gate does."""
+    from tools.bench_gaps import SERVE_PAGED_WORKLOADS, serve_paged_missing
+
+    d = str(tmp_path)
+    assert serve_paged_missing(d) == list(SERVE_PAGED_WORKLOADS)
+    rows = [
+        {"metric": "serve_paged", "workload": "shared_prefix",
+         "value": 2.0, "capacity_ok": True, "prefix_hit_tokens": 320,
+         "parity_ok": True, "device_kind": "cpu"},     # smoke: no
+        {"metric": "serve_paged", "workload": "shared_prefix",
+         "error": "relay wedged"},                     # error: no
+        {"metric": "serve_paged", "workload": "shared_prefix",
+         "value": 1.2, "capacity_ok": False, "prefix_hit_tokens": 320,
+         "parity_ok": True,
+         "device_kind": "TPU v5 lite"},                # capacity: no
+        {"metric": "serve_paged", "workload": "shared_prefix",
+         "value": 2.0, "capacity_ok": True, "prefix_hit_tokens": 0,
+         "parity_ok": True,
+         "device_kind": "TPU v5 lite"},                # no hits: no
+        {"metric": "serve_paged", "workload": "shared_prefix",
+         "value": 2.0, "capacity_ok": True, "prefix_hit_tokens": 320,
+         "parity_ok": False,
+         "device_kind": "TPU v5 lite"},                # parity broken: no
+    ]
+    with open(os.path.join(d, "serve_paged.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_paged_missing(d) == ["shared_prefix"]
+    with open(os.path.join(d, "serve_paged.history.jsonl"), "w") as f:
+        f.write(json.dumps(
+            {"metric": "serve_paged", "workload": "shared_prefix",
+             "value": 1.8, "capacity_ok": True, "prefix_hit_tokens": 96,
+             "parity_ok": True,
+             "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_paged_missing(d) == []  # banked history row counts
+
+
 def test_serve_fused_bench_rows_parse():
     """The serve_fused stage's CPU smoke (tier-1's guard on the
     fused-decode bench the TPU watcher resumes): every registered

@@ -487,6 +487,48 @@ def test_watchdog_hang_in_publish_is_contained_not_charged(
     _assert_parity(model, params, p, 6, h2)
 
 
+def test_full_prefix_hit_admits_via_table_writes_only(model_and_params):
+    """Paged-mode satellite (ISSUE 13): a FULL-prefix cache hit on the
+    paged engine admits through table writes alone — zero
+    ``copy_block_in`` invocations (the dense copy program never runs),
+    the slot's table maps the tree's very pages, the final chunk still
+    re-prefills into a fresh COW page (generate()'s prefill-then-
+    sample order), and hit/miss admission churn compiles the paged
+    programs exactly once."""
+    model, params = model_and_params
+    rng = np.random.default_rng(20)
+    p = rng.integers(0, 61, size=16).astype(np.int32)
+    in_before = TRACE_COUNTS["prefix_block_in"]
+    out_before = TRACE_COUNTS["prefix_block_out"]
+    eng = Engine(model, params, num_slots=1, max_len=48, prefill_chunk=8,
+                 kv_pages=12)
+    h1 = eng.submit(p, 4)
+    eng.run_until_complete()          # cold: publishes 2 pages
+    base_chunks = eng.stats["prefill_chunks"]
+    traced = {k: TRACE_COUNTS[k] for k in ("decode_paged",
+                                           "prefill_paged")}
+    h2 = eng.submit(p, 4)             # FULL-prefix hit
+    eng.step()                        # admit (+ final-chunk prefill)
+    ms = eng._mstates[None]
+    tree_pages = [n.block for n in eng.page_index.lookup(p)]
+    assert ms.table[0, 0] == tree_pages[0]    # the tree's page, mapped
+    assert ms.table[0, 1] != tree_pages[1]    # divergence chunk: COW —
+    #                                           a fresh private page,
+    #                                           never the shared one
+    eng.run_until_complete()
+    assert eng.stats["prefix_hit_tokens"] == 8  # capped at 1 of 2 blocks
+    assert eng.stats["prefill_chunks"] == base_chunks + 1
+    _assert_parity(model, params, p, 4, h1)
+    _assert_parity(model, params, p, 4, h2)
+    # the whole hit/miss cycle ran ZERO block copies...
+    assert TRACE_COUNTS["prefix_block_in"] == in_before
+    assert TRACE_COUNTS["prefix_block_out"] == out_before
+    # ...and re-traced nothing (compile-once across hit/miss admissions)
+    for k, v in traced.items():
+        assert TRACE_COUNTS[k] == v, f"{k} re-traced on the hit"
+    eng.check_paged()
+
+
 def test_eviction_under_budget_keeps_parity(model_and_params):
     """A pool far smaller than the traffic (constant eviction churn)
     still never serves a wrong block: every request stays bit-identical

@@ -328,6 +328,11 @@ def test_program_donations_mirror_rules_tables():
         "serve.prefill_chunk": "prefill_step",
         "serve.fused_decode": "fused_step",
         "serve.fused_decode_stream": "fused_step",
+        "serve.decode_paged": "decode_paged",
+        "serve.verify_paged": "verify_paged",
+        "serve.prefill_paged": "prefill_paged",
+        "serve.fused_decode_paged": "fused_paged",
+        "serve.fused_decode_paged_stream": "fused_paged",
         "prefix.copy_block_in": "copy_block_in",
         "prefix.copy_block_out": "copy_block_out",
         "train.step_single": "train_step",

@@ -86,6 +86,16 @@ SERVE_SPEC_KS = (2, 4, 8)
 # (prefix_hit_tokens > 0) and bit-exact parity between the cached and
 # uncached engines.
 SERVE_PREFIX_WORKLOADS = ("shared_prefix", "multiturn")
+# Paged-attention workloads (serve_bench.py --paged: the TRUE paged
+# engine — per-slot block tables into one shared page pool,
+# Engine(kv_pages=N) — vs the dense copy-cache engine at the SAME KV
+# byte budget) that must be measured on the TPU; same registry
+# contract.  A row closes its workload only when the paged engine
+# sustained >= 1.5x the dense engine's co-resident contexts at fixed
+# pool bytes without a single page-pressure vacate (capacity_ok), the
+# cache actually served (prefix_hit_tokens > 0), and greedy outputs
+# were bit-identical between the two engines (parity_ok).
+SERVE_PAGED_WORKLOADS = ("shared_prefix",)
 # Fused decode window sizes (serve_bench.py --decode-fuse: one
 # lax.while_loop program runs up to N decode steps on device per host
 # dispatch — the on-device decode loop, ROADMAP "kill the per-token
@@ -263,6 +273,31 @@ def serve_prefix_missing(d: str) -> list[str]:
                 and "TPU" in str(r.get("device_kind", ""))):
             done.add(r["workload"])
     return [w for w in SERVE_PREFIX_WORKLOADS if w not in done]
+
+
+def serve_paged_missing(d: str) -> list[str]:
+    """Paged-attention workloads still lacking a real TPU measurement.
+    A row closes its workload only when it measured something (a
+    positive capacity ratio), the paged engine actually held the extra
+    contexts (``capacity_ok`` — >= 1.5x the dense engine's co-resident
+    contexts at the same KV byte budget with zero page-pressure
+    vacates), prefix reuse actually happened through the tables
+    (``prefix_hit_tokens > 0``), and greedy outputs stayed
+    bit-identical between the paged and dense-copy engines
+    (``parity_ok``).  CPU smoke and error rows never close a workload
+    (same rules as serve_missing).  Comma-ready for SERVE_PAGED so a
+    window resumes the sweep mid-way."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_paged.jsonl")):
+        if (r.get("metric") == "serve_paged"
+                and r.get("workload") in SERVE_PAGED_WORKLOADS
+                and measured(r)
+                and r.get("capacity_ok") is True
+                and r.get("prefix_hit_tokens", 0) > 0
+                and r.get("parity_ok") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["workload"])
+    return [w for w in SERVE_PAGED_WORKLOADS if w not in done]
 
 
 def serve_fused_missing(d: str) -> list[int]:
@@ -490,7 +525,8 @@ ANALYSIS_LINT_PATHS = ("tpudp", "tools", "benchmarks")
 #: metrics sidecar (serve_bench_metrics.json — per-stage
 #: Engine.metrics() snapshots: device counters, span rollups, stats).
 OBS_SIDECAR_STAGES = ("serve.jsonl", "serve_spec.jsonl",
-                      "serve_fused.jsonl", "serve_prefix.jsonl")
+                      "serve_fused.jsonl", "serve_prefix.jsonl",
+                      "serve_paged.jsonl")
 OBS_SIDECAR_NAME = "serve_bench_metrics.json"
 
 
@@ -566,7 +602,8 @@ def main() -> None:
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_fused",
                                      "serve_soak", "serve_prefix",
-                                     "serve_tenancy", "train_soak",
+                                     "serve_paged", "serve_tenancy",
+                                     "train_soak",
                                      "train_soak_multihost", "analysis",
                                      "obs"])
     p.add_argument("--dir", default="bench_results")
@@ -600,6 +637,8 @@ def main() -> None:
               end="")
     elif args.stage == "serve_prefix":
         print(",".join(serve_prefix_missing(args.dir)), end="")
+    elif args.stage == "serve_paged":
+        print(",".join(serve_paged_missing(args.dir)), end="")
     elif args.stage == "analysis":
         print(",".join(analysis_missing()), end="")
     elif args.stage == "obs":

@@ -259,6 +259,39 @@ def main() -> None:
                   f"{r.get('prefix_lookups')} lookups, parity intact) | "
                   f"`serve_bench.py --prefix-cache` | |")
 
+    # Paged-attention rows render pass/fail on the capacity gates: the
+    # paged engine must have sustained >= 1.5x the dense engine's
+    # co-resident contexts at the same KV byte budget with zero
+    # page-pressure vacates, with real table-indirected cache traffic
+    # and bit-exact parity — the same criteria as
+    # bench_gaps.serve_paged_missing, so recorder and gate can't
+    # disagree.
+    paged = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_paged.jsonl"))
+         if "workload" in r and "serve_paged" not in r), "workload")
+    for r in sorted(paged.values(), key=lambda r: str(r.get("workload"))):
+        if (not measured(r) or r.get("capacity_ok") is not True
+                or r.get("parity_ok") is not True):
+            why = r.get("error") or (
+                "parity broken" if r.get("parity_ok") is False
+                else "capacity bound missed"
+                if r.get("capacity_ok") is False
+                else "no real measurement")
+            print(f"| serve_paged {r.get('workload')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --paged` | |")
+        else:
+            print(f"| paged attention, {r['workload']} "
+                  f"({r.get('kv_pages')} pages shared pool) | "
+                  f"**{r['value']}x capacity** "
+                  f"({r.get('contexts_paged')} vs "
+                  f"{r.get('contexts_dense')} co-resident contexts at "
+                  f"{r.get('pool_bytes')} pool bytes), TTFT p50 "
+                  f"{r.get('ttft_p50_ms')} ms vs "
+                  f"{r.get('ttft_p50_copy_ms')} ms copy-based, "
+                  f"{r.get('prefix_hit_tokens')} hit tokens via table "
+                  f"writes, parity intact | "
+                  f"`serve_bench.py --paged` | |")
+
     # Multi-tenant rows render pass/fail on the tenancy gates: the high
     # tier's overload TTFT p99 held within the bound of its no-load
     # baseline, every completed request (preempted and resumed included)
@@ -411,6 +444,7 @@ STAGE_FILES = {
     "serve": "serve.jsonl", "serve_spec": "serve_spec.jsonl",
     "serve_fused": "serve_fused.jsonl",
     "serve_prefix": "serve_prefix.jsonl",
+    "serve_paged": "serve_paged.jsonl",
     "serve_soak": "serve_soak.jsonl",
     "serve_tenancy": "serve_tenancy.jsonl",
     "train_soak": "train_soak.jsonl",

@@ -103,6 +103,10 @@ serve_prefix_ok() {
   local out; out=$(python tools/bench_gaps.py serve_prefix) || return 1
   [ -z "$out" ]
 }
+serve_paged_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_paged) || return 1
+  [ -z "$out" ]
+}
 serve_tenancy_ok() {
   local out; out=$(python tools/bench_gaps.py serve_tenancy) || return 1
   [ -z "$out" ]
@@ -392,6 +396,23 @@ while true; do
         > bench_results/serve_prefix.jsonl 2> bench_results/serve_prefix.err
       log "serve_prefix_bench rc=$? -> bench_results/serve_prefix.jsonl"
     fi
+    if serve_paged_ok; then
+      log "serve_paged.jsonl already good; skipping paged-attention bench"
+    else
+      # True paged attention (per-slot block tables into one shared
+      # page pool, Engine(kv_pages=N)): co-resident contexts at fixed
+      # pool bytes + TTFT vs the dense copy-cache engine on the
+      # shared-prefix workload; a row closes only with >= 1.5x
+      # capacity, zero page-pressure vacates, real table-indirected
+      # hits, and bit-exact parity — resumes at workload granularity
+      # via bench_gaps, like the serve_prefix stage.
+      bank bench_results/serve_paged.jsonl
+      ensure_window
+      SERVE_PAGED="$(python tools/bench_gaps.py serve_paged)" \
+        timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
+        > bench_results/serve_paged.jsonl 2> bench_results/serve_paged.err
+      log "serve_paged_bench rc=$? -> bench_results/serve_paged.jsonl"
+    fi
     if serve_tenancy_ok; then
       log "serve_tenancy.jsonl already good; skipping tenancy bench"
     else
@@ -489,7 +510,8 @@ while true; do
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
         && lever_ok && collective_ok && serve_ok && serve_spec_ok \
-        && serve_soak_ok && serve_prefix_ok && serve_tenancy_ok \
+        && serve_soak_ok && serve_prefix_ok && serve_paged_ok \
+        && serve_tenancy_ok \
         && train_soak_ok && train_soak_multihost_ok; then
       log "battery done"
       exit 0

@@ -56,6 +56,10 @@ TRACE_COUNTER_PROGRAMS = {
     "prefill_chunk": "serve.prefill_chunk",
     "sample_row": "serve.sample_row",
     "fused_decode": "serve.fused_decode",
+    "decode_paged": "serve.decode_paged",
+    "verify_paged": "serve.verify_paged",
+    "prefill_paged": "serve.prefill_paged",
+    "fused_decode_paged": "serve.fused_decode_paged",
     "prefix_block_in": "prefix.copy_block_in",
     "prefix_block_out": "prefix.copy_block_out",
     "draft_model": "serve.draft_model",
@@ -74,6 +78,14 @@ PROGRAM_DONATIONS = {
     "serve.prefill_chunk": (0,),
     "serve.fused_decode": (0, 11),
     "serve.fused_decode_stream": (0, 11),
+    # Paged twins (Engine(kv_pages=N)): the shared page POOL donates in
+    # place of the dense arena; the block table is host-authoritative
+    # and never donated.
+    "serve.decode_paged": (0, 9),
+    "serve.verify_paged": (0, 10),
+    "serve.prefill_paged": (0,),
+    "serve.fused_decode_paged": (0, 12),
+    "serve.fused_decode_paged_stream": (0, 12),
     "serve.sample_row": (),
     "serve.draft_model": (),
     "prefix.copy_block_in": (0,),
@@ -86,8 +98,16 @@ PROGRAM_DONATIONS = {
 
 # Serve smoke geometry: 2 slots x 32 arena positions, chunk 8, k=3,
 # fused window 4 — the same scale tests/test_serve.py exercises.
+# "pages" is the PAGED twin's pool budget: 6 real pages (48 tokens)
+# + 1 scratch page — deliberately BELOW the 2x32 = 64 tokens of one
+# dense arena, so the committed budget ledger states the capacity
+# claim at the smoke geometry: a paged engine serving the SAME slots
+# persists fewer KV bytes than one dense arena, and a 2-model paged
+# engine (one shared pool) persists far less than two (see
+# tests/test_paged.py's ledger assertion).
 SERVE = dict(vocab=64, seq=64, layers=2, heads=2, d_model=32,
-             slots=2, max_len=32, chunk=8, k=3, blocks=4, fuse=4)
+             slots=2, max_len=32, chunk=8, k=3, blocks=4, fuse=4,
+             pages=6)
 # Train smoke geometry: a tiny conv-free net over 8x8x3 inputs on the
 # 8-virtual-device CPU mesh the tier-1 suite runs on.
 TRAIN = dict(input=(8, 8, 3), classes=4, batch=8, devices=8)
@@ -149,7 +169,8 @@ def build_programs() -> dict:
     from tpudp.serve import engine as _engine
 
     cfg, params, cache, h = _serve_args()
-    decode, verify, prefill, fused = _engine._build_steps(cfg, params)
+    (decode, verify, prefill, fused, decode_paged, verify_paged,
+     prefill_paged, fused_paged) = _engine._build_steps(cfg, params)
     geo = f"s{SERVE['slots']}m{SERVE['max_len']}"
     programs[f"serve.decode_step@{geo}"] = (
         decode, (cache, h["last"], h["lens"], h["active"], h["temps"],
@@ -177,6 +198,43 @@ def build_programs() -> dict:
     programs[f"serve.fused_decode_stream@{geo}n{SERVE['fuse']}"] = (
         functools.partial(fused, n_steps=SERVE["fuse"], stream=True),
         fused_args)
+    # Paged twins (Engine(kv_pages=N)): same math read through per-slot
+    # block tables into ONE shared page pool (+1 trailing scratch page).
+    # Pinning them locks the page-gather/scatter indirection — a new
+    # host transfer or callback inside the paged hot loop fails the
+    # audit by name — and gives the budget pass the paged programs'
+    # peak_live_bytes for the capacity ledger.
+    n_pages = SERVE["pages"]
+    pool = KVCache.zeros(cfg, n_pages + 1, SERVE["chunk"])
+    table = np.zeros((SERVE["slots"], SERVE["max_len"] // SERVE["chunk"]),
+                     np.int32)
+    pgeo2 = f"{geo}p{n_pages}"
+    programs[f"serve.decode_paged@{pgeo2}"] = (
+        decode_paged, (pool, table, h["last"], h["lens"], h["active"],
+                       h["temps"], h["topk"], h["topp"], h["keys"],
+                       h["counts"]))
+    programs[f"serve.verify_paged@{pgeo2}k{SERVE['k']}"] = (
+        verify_paged, (pool, table, h["window"], h["lens"], h["active"],
+                       h["ndraft"], h["temps"], h["topk"], h["topp"],
+                       h["keys"], h["counts"]))
+    programs[f"serve.prefill_paged@{pgeo2}c{SERVE['chunk']}"] = (
+        prefill_paged, (pool, table[0], h["chunk"], np.int32(0),
+                        np.int32(SERVE["chunk"] - 1)))
+    # Both stream variants, like the dense fused window: the stream
+    # twin pins the ordered io_callback in its census, so a host
+    # round-trip change inside the PAGED loop fails the audit by name
+    # too (kv_pages + fuse_stream is a legal engine configuration).
+    fused_paged_args = (
+        pool, table, h["last"], h["lens"], h["active"], h["temps"],
+        h["topk"], h["topp"], h["keys"], h["budgets"], h["eos"],
+        np.int32(-1), h["counts"])
+    programs[f"serve.fused_decode_paged@{pgeo2}n{SERVE['fuse']}"] = (
+        functools.partial(fused_paged, n_steps=SERVE["fuse"], stream=False),
+        fused_paged_args)
+    programs[f"serve.fused_decode_paged_stream@{pgeo2}n{SERVE['fuse']}"] = (
+        functools.partial(fused_paged, n_steps=SERVE["fuse"], stream=True),
+        fused_paged_args)
+
     programs["serve.sample_row@v%d" % SERVE["vocab"]] = (
         _engine._sample_row,
         (np.zeros((1, SERVE["vocab"]), np.float32), np.float32(0.0),

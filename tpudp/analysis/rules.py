@@ -48,7 +48,8 @@ DEVICE_ROOTS = {
 #: fault-seam wrapper ``self._device(kind, fn, *args)``.
 DEVICE_CALL_ATTRS = {
     "_device", "train_step", "eval_step", "fwd_step", "decode_step",
-    "verify_step", "prefill_step", "fused_step", "copy_block_in",
+    "verify_step", "prefill_step", "fused_step", "decode_paged",
+    "verify_paged", "prefill_paged", "fused_paged", "copy_block_in",
     "copy_block_out", "_sample_row",
 }
 
@@ -63,6 +64,11 @@ DONATING = {
     "decode_step": (0, 8), "verify_step": (0, 9), "prefill_step": (0,),
     "fused_step": (0, 11), "train_step": (0,), "copy_block_in": (0,),
     "copy_block_out": (1,),
+    # Paged twins (Engine(kv_pages=N)): the shared page pool donates in
+    # the dense arena's place (the block table never does — it is
+    # host-authoritative and uploaded per call).
+    "decode_paged": (0, 9), "verify_paged": (0, 10),
+    "prefill_paged": (0,), "fused_paged": (0, 12),
 }
 
 #: Pass-through wrappers: ``self._device("kind", fn, *args)`` runs

@@ -55,6 +55,135 @@ class KVCache(NamedTuple):
         return cls(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 
+class Int8Pages(NamedTuple):
+    """Quantized page-pool buffers (``Engine(kv_dtype="int8")``): k/v
+    stored int8 with per-(layer, page, token, head) fp32 scales — half
+    the KV bytes per token of an fp32 pool behind the SAME block-table
+    indirection (block ids, allocation order, and the radix tree are
+    identical to the fp pool; only page payloads quantize).  Symmetric
+    absmax quantization over the head dim: ``scale = max|x| / 127``,
+    ``q = round(x / scale)`` — dequantized reads feed the exact same
+    attention math, so outputs track the fp engine within quantization
+    tolerance rather than bit-exactly (tests bound it)."""
+
+    k: jnp.ndarray        # (layers, pages, page_tokens, kv_heads, dh) int8
+    v: jnp.ndarray
+    k_scale: jnp.ndarray  # (layers, pages, page_tokens, kv_heads) fp32
+    v_scale: jnp.ndarray
+
+    @classmethod
+    def zeros(cls, cfg, num_pages: int, page_tokens: int) -> "Int8Pages":
+        kv_heads = getattr(cfg, "kv_heads", cfg.num_heads)
+        shape = (cfg.num_layers, num_pages, page_tokens, kv_heads,
+                 cfg.d_model // cfg.num_heads)
+        return cls(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                   jnp.ones(shape[:-1], jnp.float32),
+                   jnp.ones(shape[:-1], jnp.float32))
+
+
+def _quantize_kv(x: jnp.ndarray):
+    """(..., dh) fp -> (int8 payload, fp32 per-vector scale).  A zero
+    vector keeps scale 1 so dequantization stays exact-zero."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def gather_pages(cfg, pool, table: jnp.ndarray) -> KVCache:
+    """Materialize the logical dense view of a paged KV arena: per-slot
+    block table ``(num_slots, max_pages)`` int32 into a page pool
+    (``KVCache`` or :class:`Int8Pages` of shape ``(layers, num_pages+1,
+    page_tokens, kv_heads, dh)``; the LAST page is the write scratch) ->
+    ``(layers, num_slots, max_pages*page_tokens, kv_heads, dh)``
+    KVCache in ``cfg.dtype``.
+
+    Unmapped entries (``-1``) clamp to the scratch page: their garbage
+    lands only at positions beyond the owning slot's length, which the
+    attention visibility mask already excludes — exactly the standing
+    garbage-beyond-``pos`` contract of the dense arena, so the gathered
+    view's attention output is bit-identical to reading dense rows
+    holding the same values."""
+    scratch = pool.k.shape[1] - 1
+    tbl = jnp.where(table >= 0, table, scratch)
+
+    def grab(buf):
+        g = buf[:, tbl]  # (L, S, M, T, ...) advanced-index gather
+        return g.reshape(g.shape[0], g.shape[1],
+                         g.shape[2] * g.shape[3], *g.shape[4:])
+
+    if isinstance(pool, Int8Pages):
+        k = (grab(pool.k).astype(jnp.float32)
+             * grab(pool.k_scale)[..., None]).astype(cfg.dtype)
+        v = (grab(pool.v).astype(jnp.float32)
+             * grab(pool.v_scale)[..., None]).astype(cfg.dtype)
+        return KVCache(k, v)
+    return KVCache(grab(pool.k).astype(cfg.dtype),
+                   grab(pool.v).astype(cfg.dtype))
+
+
+def scatter_pages(pool, view: KVCache, table: jnp.ndarray,
+                  pos: jnp.ndarray, cur: int, active: jnp.ndarray):
+    """Write the view pages a forward just touched back into the pool.
+
+    ``view`` is the updated dense view (the forward wrote ``cur`` new
+    tokens at per-slot positions ``[pos, pos+cur)``); only the pages
+    covering those positions are written back — everything else in the
+    pool is untouched, which is what makes shared (copy-on-write)
+    pages safe to map into many tables: a slot only ever writes pages
+    it exclusively owns (the scheduler's allocation invariant).
+    Inactive slots' writes — and the statically-unrolled spare page of
+    a window that did not actually cross a page boundary — are routed
+    to the scratch page (last pool page), never to a real block.
+    ``cur`` is static (it bounds the unroll: a ``cur``-token window
+    touches at most ``(cur + T - 2) // T + 1`` pages)."""
+    T = pool.k.shape[2]
+    n_pages = table.shape[1]
+    scratch = pool.k.shape[1] - 1
+    first = pos // T
+    last = (pos + cur - 1) // T
+
+    def cut(buf, starts):  # (L, S, M*T, ...) -> (L, S, T, ...)
+        return jax.vmap(
+            lambda b, p: lax.dynamic_slice_in_dim(b, p, T, axis=1),
+            in_axes=(1, 0), out_axes=1)(buf, starts)
+
+    for j in range((cur + T - 2) // T + 1):
+        pidx = first + j  # (S,)
+        safe = jnp.clip(pidx, 0, n_pages - 1)
+        page = jnp.take_along_axis(table, safe[:, None], axis=1)[:, 0]
+        valid = active & (pidx <= last) & (pidx < n_pages) & (page >= 0)
+        page = jnp.where(valid, page, scratch)
+        ck = cut(view.k, safe * T)
+        cv = cut(view.v, safe * T)
+        if isinstance(pool, Int8Pages):
+            qk, sk = _quantize_kv(ck)
+            qv, sv = _quantize_kv(cv)
+            pool = Int8Pages(pool.k.at[:, page].set(qk),
+                             pool.v.at[:, page].set(qv),
+                             pool.k_scale.at[:, page].set(sk),
+                             pool.v_scale.at[:, page].set(sv))
+        else:
+            pool = KVCache(pool.k.at[:, page].set(ck.astype(pool.k.dtype)),
+                           pool.v.at[:, page].set(cv.astype(pool.v.dtype)))
+    return pool
+
+
+def _forward_paged(cfg, params: dict, tokens: jnp.ndarray, pool,
+                   table: jnp.ndarray, pos: jnp.ndarray,
+                   active: jnp.ndarray):
+    """Page-table-indirected twin of :func:`_forward_cached` for the
+    serve engine's paged arena: gather each slot's pages into the dense
+    logical view, run the EXACT per-row cached forward on it (identical
+    values -> bit-identical logits — the paged-parity contract), then
+    scatter only the written pages back.  Returns ``(logits, pool)``."""
+    view = gather_pages(cfg, pool, table)
+    logits, view = _forward_cached(cfg, params, tokens, view, pos)
+    return logits, scatter_pages(pool, view, table, pos,
+                                 tokens.shape[1], active)
+
+
 def _layer_norm(p: dict, x: jnp.ndarray, eps: float) -> jnp.ndarray:
     """Exactly the training model's LayerNorm (flax apply on the raw
     subtree, same epsilon), so decode can never drift numerically from

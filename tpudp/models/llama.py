@@ -205,7 +205,16 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     time.  ``pos`` is a scalar (whole batch at one depth) or a
     ``(batch,)`` vector of per-row depths (tpudp.serve's slot arena);
     the scalar path compiles to the program it always did.  Mirrors
-    LlamaBlock exactly (the greedy-parity test referees)."""
+    LlamaBlock exactly (the greedy-parity test referees).
+
+    The serve engine's PAGED mode (``Engine(kv_pages=N)``) reads KV
+    through per-slot block tables by gathering each slot's pool pages
+    into exactly this ``(batch, max_len, kv_heads, head_dim)`` view
+    (``generate.gather_pages`` — pages allocate at GQA width, so the
+    grouped-attention memory saving carries over to the pool) and
+    runs this same function on it: identical values in, bit-identical
+    attention out, which is what makes paged reads ≡ dense reads
+    (tests/test_paged.py::test_paged_llama_gqa_parity)."""
     b, cur, d = x.shape
     h, kv = cfg.num_heads, cfg.kv_heads
     dh = d // h

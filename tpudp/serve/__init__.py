@@ -8,11 +8,12 @@ live in ``tpudp.serve.faults``."""
 from tpudp.serve.engine import (TRACE_COUNTS, Engine, EngineClosed,
                                 FinishReason, QueueFull, Request,
                                 RequestFailed)
-from tpudp.serve.prefix_cache import PrefixCache
+from tpudp.serve.prefix_cache import PageIndex, PagePool, PrefixCache
 from tpudp.serve.speculate import Drafter, DraftModelDrafter, NgramDrafter
 from tpudp.serve.tenancy import TenantClass, TenantScheduler
 
 __all__ = ["Engine", "Request", "TRACE_COUNTS", "Drafter",
            "DraftModelDrafter", "NgramDrafter", "FinishReason",
-           "PrefixCache", "QueueFull", "EngineClosed", "RequestFailed",
-           "TenantClass", "TenantScheduler"]
+           "PageIndex", "PagePool", "PrefixCache", "QueueFull",
+           "EngineClosed", "RequestFailed", "TenantClass",
+           "TenantScheduler"]

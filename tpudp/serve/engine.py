@@ -62,6 +62,29 @@ Design (static shapes everywhere — the TPU rule that shapes are compile
     to ``generate()`` (``stats["prefix_hit_tokens"]`` /
     ``stats["prefix_lookups"]`` account the traffic; ``0`` blocks — the
     default — disables the subsystem byte-for-byte).
+  * **True paged attention** (``kv_pages > 0``) — the dense per-model
+    slot arenas are replaced by ONE shared page pool per KV geometry
+    plus per-slot block tables (``(num_slots, max_pages)`` int32): the
+    decode/verify/prefill/fused programs gather each slot's pages into
+    the logical dense view, run the EXACT dense step math on it (bit-
+    identical outputs — the paged-parity contract), and scatter back
+    only the pages they wrote.  A prefix-cache hit becomes a TABLE
+    WRITE (refcount bump on the radix tree's pages — zero
+    ``copy_block_in`` copies) with copy-on-write at the divergence
+    block: shared pages are never written, the first divergent chunk
+    re-prefills into a fresh private page.  Retirement publishes by
+    transferring page ownership to the tree (host metadata, no device
+    copy).  Pages are allocated lazily as slots deepen — the
+    overcommit that multiplies capacity under shared-prefix traffic —
+    and pool pressure first evicts cold cache leaves, then vacates the
+    most-recently-admitted slot through the bit-exact resume path.
+    Co-resident models of one KV geometry share one pool, so an idle
+    tenant reserves zero KV instead of a dense arena.
+    ``kv_dtype="int8"`` stores page payloads quantized (half the bytes
+    per token — a capacity doubler behind the same tables; outputs
+    then track the fp engine within quantization tolerance instead of
+    bit-exactly).  ``kv_pages=0`` — the default — is byte-for-byte the
+    dense engine.
   * **Speculative decoding** (``speculate_k > 0``) — a host-side drafter
     (``tpudp.serve.speculate``) proposes up to k tokens per decoding
     slot; ONE verify forward scores the ``k+1``-token window at per-row
@@ -198,7 +221,8 @@ import numpy as np
 from jax import lax
 
 from tpudp.models.generate import (KVCache, _forward_cached,
-                                   validate_decode_config)
+                                   _forward_paged, gather_pages,
+                                   scatter_pages, validate_decode_config)
 from tpudp.obs import FlightRecorder, Recorder
 from tpudp.ops.sampling import sample_tokens, split_keys, verify_tokens
 from tpudp.utils.compile_cache import ProgramCache
@@ -318,6 +342,101 @@ def _stream_tap(ring_id, toks, running) -> None:
         ring.append((int(s), int(toks[s])))
 
 
+def _decode_math(forward, state, last_tokens, lengths, active, temps,
+                 top_k, top_p, keys, counts):
+    """The ONE decode-step body shared by the dense and paged programs:
+    ``forward`` hides the KV indirection (dense arena row writes vs
+    page gather/scatter — it receives ``active`` so the paged scatter
+    can mask), everything else — sampling, the per-slot PRNG advance
+    discipline, the OBS counter stacking — exists exactly once, so the
+    two twins can never drift apart."""
+    logits, state = forward(state, last_tokens[:, None], lengths, active)
+    carry, sub = split_keys(keys)
+    toks = sample_tokens(logits[:, 0], temps, top_k, top_p, sub)
+    # Only rows that actually sampled advance their key chain — a
+    # request's draw stream must not depend on co-resident requests.
+    new_keys = jnp.where(active[:, None], carry, keys)
+    zero = jnp.zeros((), counts.dtype)
+    one = jnp.ones((), counts.dtype)
+    act = jnp.sum(active).astype(counts.dtype)
+    new_counts = counts + jnp.stack([one, act, act, zero, zero])
+    return state, toks, new_keys, new_counts
+
+
+def _verify_math(forward, state, tokens, lengths, active, n_draft,
+                 temps, top_k, top_p, keys, counts):
+    """The ONE speculative-verify body shared by the dense and paged
+    programs (window scoring, longest-agreeing-prefix acceptance, PRNG
+    and counter discipline — see :func:`_decode_math`)."""
+    logits, state = forward(state, tokens, lengths, active)
+    carry, sub = split_keys(keys)
+    out, n_emit = verify_tokens(logits, tokens[:, 1:], n_draft,
+                                temps, top_k, top_p, sub)
+    new_keys = jnp.where(active[:, None], carry, keys)
+    zero = jnp.zeros((), counts.dtype)
+    one = jnp.ones((), counts.dtype)
+    act = jnp.sum(active).astype(counts.dtype)
+    emitted = jnp.sum(jnp.where(active, n_emit, 0)).astype(counts.dtype)
+    accepted = jnp.sum(jnp.where(active & (n_draft > 0), n_emit - 1,
+                                 0)).astype(counts.dtype)
+    new_counts = counts + jnp.stack([one, emitted, act, accepted, zero])
+    return state, out, n_emit, new_keys, new_counts
+
+
+def _fused_decode_math(forward, state, last_tokens, lengths, active,
+                       temps, top_k, top_p, keys, budgets, eos_ids,
+                       ring_id, counts, *, n_steps, stream):
+    """The ONE fused-window ``lax.while_loop`` shared by the dense and
+    paged programs: loop carry, early-exit predicate, per-iteration
+    commit/PRNG/counter discipline, and the optional ordered
+    ``io_callback`` stream tap all exist exactly once — only the
+    per-iteration ``forward`` (arena vs page indirection) differs."""
+    n_slots = last_tokens.shape[0]
+    out0 = jnp.zeros((n_slots, n_steps), jnp.int32)
+    n_emit0 = jnp.zeros((n_slots,), jnp.int32)
+
+    def cond(carry):
+        (i, _state, _last, _lens, running, _keys, _out, _n_emit,
+         _counts) = carry
+        return (i < n_steps) & jnp.any(running)
+
+    def body(carry):
+        i, state, last, lens, running, keys, out, n_emit, counts = carry
+        logits, state = forward(state, last[:, None], lens, running)
+        carry_keys, sub = split_keys(keys)
+        toks = sample_tokens(logits[:, 0], temps, top_k, top_p, sub)
+        # Only rows still running advance their key chain / commit —
+        # a retired row's chain must read exactly as of its last
+        # committed token (the bit-exact resume contract shared with
+        # requeue/preemption carry-over).
+        keys = jnp.where(running[:, None], carry_keys, keys)
+        toks = jnp.where(running, toks, last)
+        if stream:
+            from jax.experimental import io_callback
+
+            io_callback(_stream_tap, None, ring_id, toks, running,
+                        ordered=True)
+        lens = jnp.where(running, lens + 1, lens)
+        col = jnp.arange(n_steps)[None, :] == n_emit[:, None]
+        out = jnp.where(col & running[:, None], toks[:, None], out)
+        n_emit = jnp.where(running, n_emit + 1, n_emit)
+        zero = jnp.zeros((), counts.dtype)
+        one = jnp.ones((), counts.dtype)
+        run = jnp.sum(running).astype(counts.dtype)
+        eos_now = jnp.sum(running & (toks == eos_ids)).astype(
+            counts.dtype)
+        counts = counts + jnp.stack([one, run, run, zero, eos_now])
+        running = running & (toks != eos_ids) & (n_emit < budgets)
+        return (i + 1, state, toks, lens, running, keys, out, n_emit,
+                counts)
+
+    iters, state, _last, _lens, _running, keys, out, n_emit, counts = (
+        lax.while_loop(cond, body,
+                       (jnp.int32(0), state, last_tokens, lengths,
+                        active, keys, out0, n_emit0, counts)))
+    return state, out, n_emit, keys, iters, counts
+
+
 def _build_steps(cfg, params):
     """Jitted step programs with the WEIGHTS CLOSED OVER as compile-time
     constants rather than traced arguments.
@@ -337,30 +456,27 @@ def _build_steps(cfg, params):
     identity) so engines sharing a weight tree share compiled programs.
     """
 
+    def _dense_fwd(cache, tokens, lengths, active):
+        """The dense indirection for the shared step bodies: plain
+        arena-row reads/writes (masked rows land in their own rows —
+        the overwrite-before-visible rule needs no ``active``)."""
+        del active
+        return _forward_cached(cfg, params, tokens, cache, lengths)
+
     @functools.partial(jax.jit, donate_argnums=(0, 8))
     def decode_step(cache, last_tokens, lengths, active, temps,
                     top_k, top_p, keys, counts):
         """One token for every slot: feed each row's last token at its
-        own depth, sample per-row.  All sampling params and positions
+        own depth, sample per-row (``_decode_math`` — the body shared
+        with the paged twin).  All sampling params and positions
         are traced arrays, so this compiles once per (num_slots,
         max_len).  The cache is donated: XLA updates the arena in place
         instead of copying it every step.  ``counts`` is the
         OBS_DEVICE_COUNTERS accumulator (donated too — a handful of
         float adds riding the step, fetched only by metrics())."""
         TRACE_COUNTS["decode_step"] += 1
-        logits, new_cache = _forward_cached(cfg, params,
-                                            last_tokens[:, None],
-                                            cache, lengths)
-        carry, sub = split_keys(keys)
-        toks = sample_tokens(logits[:, 0], temps, top_k, top_p, sub)
-        # Only rows that actually sampled advance their key chain — a
-        # request's draw stream must not depend on co-resident requests.
-        new_keys = jnp.where(active[:, None], carry, keys)
-        zero = jnp.zeros((), counts.dtype)
-        one = jnp.ones((), counts.dtype)
-        act = jnp.sum(active).astype(counts.dtype)
-        new_counts = counts + jnp.stack([one, act, act, zero, zero])
-        return new_cache, toks, new_keys, new_counts
+        return _decode_math(_dense_fwd, cache, last_tokens, lengths,
+                            active, temps, top_k, top_p, keys, counts)
 
     @functools.partial(jax.jit, donate_argnums=(0, 9))
     def verify_step(cache, tokens, lengths, active, n_draft, temps,
@@ -368,7 +484,8 @@ def _build_steps(cfg, params):
         """One speculative window for every slot: feed each row's
         ``[last, d_0 .. d_{k-1}]`` window at its own depth, accept the
         longest draft prefix the target model agrees with
-        (``ops.sampling.verify_tokens``), emit up to k+1 tokens per row.
+        (``_verify_math`` — the body shared with the paged twin), emit
+        up to k+1 tokens per row.
         The window width is the only addition to the decode step's
         shape set, so this compiles once per (num_slots, max_len, k)
         and admission/retirement/cancellation churn never recompiles.
@@ -376,21 +493,8 @@ def _build_steps(cfg, params):
         decode (the window's tail writes are overwritten before they
         become visible, like every other masked write in the arena)."""
         TRACE_COUNTS["verify_step"] += 1
-        logits, new_cache = _forward_cached(cfg, params, tokens, cache,
-                                            lengths)
-        carry, sub = split_keys(keys)
-        out, n_emit = verify_tokens(logits, tokens[:, 1:], n_draft,
-                                    temps, top_k, top_p, sub)
-        new_keys = jnp.where(active[:, None], carry, keys)
-        zero = jnp.zeros((), counts.dtype)
-        one = jnp.ones((), counts.dtype)
-        act = jnp.sum(active).astype(counts.dtype)
-        emitted = jnp.sum(jnp.where(active, n_emit, 0)).astype(counts.dtype)
-        accepted = jnp.sum(jnp.where(active & (n_draft > 0), n_emit - 1,
-                                     0)).astype(counts.dtype)
-        new_counts = counts + jnp.stack([one, emitted, act, accepted,
-                                         zero])
-        return new_cache, out, n_emit, new_keys, new_counts
+        return _verify_math(_dense_fwd, cache, tokens, lengths, active,
+                            n_draft, temps, top_k, top_p, keys, counts)
 
     @functools.partial(jax.jit, donate_argnums=(0, 11),
                        static_argnames=("n_steps", "stream"))
@@ -419,53 +523,14 @@ def _build_steps(cfg, params):
         the loop carry: steps/tokens per iteration plus the EOS exits
         only this program can see on device.  Returns ``(cache, out,
         n_emit, keys, iters, counts)``; the ONE host fetch per window
-        replaces the per-token fetch."""
+        replaces the per-token fetch.  Loop body/carry/predicate live
+        in ``_fused_decode_math`` — the one copy shared with the paged
+        twin."""
         TRACE_COUNTS["fused_decode"] += 1
-        n_slots = last_tokens.shape[0]
-        out0 = jnp.zeros((n_slots, n_steps), jnp.int32)
-        n_emit0 = jnp.zeros((n_slots,), jnp.int32)
-
-        def cond(carry):
-            (i, _cache, _last, _lens, running, _keys, _out, _n_emit,
-             _counts) = carry
-            return (i < n_steps) & jnp.any(running)
-
-        def body(carry):
-            i, cache, last, lens, running, keys, out, n_emit, counts = carry
-            logits, cache = _forward_cached(cfg, params, last[:, None],
-                                            cache, lens)
-            carry_keys, sub = split_keys(keys)
-            toks = sample_tokens(logits[:, 0], temps, top_k, top_p, sub)
-            # Only rows still running advance their key chain / commit —
-            # a retired row's chain must read exactly as of its last
-            # committed token (the bit-exact resume contract shared with
-            # requeue/preemption carry-over).
-            keys = jnp.where(running[:, None], carry_keys, keys)
-            toks = jnp.where(running, toks, last)
-            if stream:
-                from jax.experimental import io_callback
-
-                io_callback(_stream_tap, None, ring_id, toks, running,
-                            ordered=True)
-            lens = jnp.where(running, lens + 1, lens)
-            col = jnp.arange(n_steps)[None, :] == n_emit[:, None]
-            out = jnp.where(col & running[:, None], toks[:, None], out)
-            n_emit = jnp.where(running, n_emit + 1, n_emit)
-            zero = jnp.zeros((), counts.dtype)
-            one = jnp.ones((), counts.dtype)
-            run = jnp.sum(running).astype(counts.dtype)
-            eos_now = jnp.sum(running & (toks == eos_ids)).astype(
-                counts.dtype)
-            counts = counts + jnp.stack([one, run, run, zero, eos_now])
-            running = running & (toks != eos_ids) & (n_emit < budgets)
-            return (i + 1, cache, toks, lens, running, keys, out, n_emit,
-                    counts)
-
-        iters, cache, _last, _lens, _running, keys, out, n_emit, counts = (
-            lax.while_loop(cond, body,
-                           (jnp.int32(0), cache, last_tokens, lengths,
-                            active, keys, out0, n_emit0, counts)))
-        return cache, out, n_emit, keys, iters, counts
+        return _fused_decode_math(
+            _dense_fwd, cache, last_tokens, lengths, active, temps,
+            top_k, top_p, keys, budgets, eos_ids, ring_id, counts,
+            n_steps=n_steps, stream=stream)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def prefill_step(cache, slot, tokens, pos, last):
@@ -487,7 +552,89 @@ def _build_steps(cfg, params):
             lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1),
             lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1))
 
-    return decode_step, verify_step, prefill_step, fused_decode_step
+    # -- paged twins (Engine(kv_pages=N)): identical math read through
+    # per-slot block tables into one shared page pool.  Each gathers the
+    # slots' pages into the dense logical view, runs the EXACT dense
+    # step body on it (same values -> bit-identical logits/samples, the
+    # paged-parity contract), and scatters only the written pages back.
+    # The pool (KVCache or Int8Pages pytree) is donated like the dense
+    # arena; the TABLE is host-authoritative and read-only on device.
+
+    def _paged_fwd(table):
+        """The paged indirection for the shared step bodies: gather the
+        slots' pages into the logical dense view, run the exact dense
+        forward, scatter back only the written pages (``active`` masks
+        the scatter to the scratch page for idle rows)."""
+        def fwd(pool, tokens, lengths, active):
+            return _forward_paged(cfg, params, tokens, pool, table,
+                                  lengths, active)
+        return fwd
+
+    @functools.partial(jax.jit, donate_argnums=(0, 9))
+    def decode_step_paged(pool, table, last_tokens, lengths, active,
+                          temps, top_k, top_p, keys, counts):
+        """Paged decode: one token for every slot, KV read/written
+        through ``table`` into ``pool``.  Same sampling/PRNG contract
+        as ``decode_step`` — literally the same ``_decode_math`` body;
+        compiles once per (num_slots, max_len, num_pages)."""
+        TRACE_COUNTS["decode_paged"] += 1
+        return _decode_math(_paged_fwd(table), pool, last_tokens,
+                            lengths, active, temps, top_k, top_p, keys,
+                            counts)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 10))
+    def verify_step_paged(pool, table, tokens, lengths, active, n_draft,
+                          temps, top_k, top_p, keys, counts):
+        """Paged speculative verify (the shared ``_verify_math`` body):
+        the k+1 window's writes may cross one page boundary — the
+        scatter's statically-unrolled spare page covers it (host
+        preallocates the table entries)."""
+        TRACE_COUNTS["verify_paged"] += 1
+        return _verify_math(_paged_fwd(table), pool, tokens, lengths,
+                            active, n_draft, temps, top_k, top_p, keys,
+                            counts)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def prefill_step_paged(pool, row_table, tokens, pos, last):
+        """Paged prompt chunk for one slot: gather the slot's pages into
+        its (1, max_len) logical row, run the same scalar-pos cached
+        forward the dense prefill runs on its sliced arena row, write
+        the chunk's page back.  Chunk starts are page-aligned (pages
+        are sized to ``prefill_chunk``), so exactly one real page is
+        written per chunk."""
+        TRACE_COUNTS["prefill_paged"] += 1
+        view = gather_pages(cfg, pool, row_table[None])
+        logits, view = _forward_cached(cfg, params, tokens, view, pos)
+        last_logits = lax.dynamic_index_in_dim(
+            logits, last, axis=1, keepdims=False)  # (1, vocab)
+        new_pool = scatter_pages(pool, view, row_table[None],
+                                 jnp.asarray(pos)[None], tokens.shape[1],
+                                 jnp.ones((1,), bool))
+        return last_logits, new_pool
+
+    @functools.partial(jax.jit, donate_argnums=(0, 12),
+                       static_argnames=("n_steps", "stream"))
+    def fused_decode_step_paged(pool, table, last_tokens, lengths, active,
+                                temps, top_k, top_p, keys, budgets,
+                                eos_ids, ring_id, counts, *, n_steps,
+                                stream=False):
+        """Paged fused decode window: the dense fused loop —
+        ``_fused_decode_math``, the one shared copy of carry,
+        early-exit predicate, PRNG discipline, commits, and the
+        optional stream tap — with the gather/forward/scatter
+        indirection inside the ``lax.while_loop`` (the table is
+        loop-invariant; the host preallocates pages covering the
+        window before dispatch, so an in-window page-boundary crossing
+        is always backed)."""
+        TRACE_COUNTS["fused_decode_paged"] += 1
+        return _fused_decode_math(
+            _paged_fwd(table), pool, last_tokens, lengths, active,
+            temps, top_k, top_p, keys, budgets, eos_ids, ring_id,
+            counts, n_steps=n_steps, stream=stream)
+
+    return (decode_step, verify_step, prefill_step, fused_decode_step,
+            decode_step_paged, verify_step_paged, prefill_step_paged,
+            fused_decode_step_paged)
 
 
 # LRU of built step programs keyed by (cfg, id(params)): engines over
@@ -516,8 +663,10 @@ class _ModelState:
     inactive slot's row."""
 
     __slots__ = ("name", "model", "config", "params", "decode_step",
-                 "verify_step", "prefill_step", "fused_step", "cache",
-                 "prefix_cache", "obs_counts")
+                 "verify_step", "prefill_step", "fused_step",
+                 "decode_paged", "verify_paged", "prefill_paged",
+                 "fused_paged", "cache", "prefix_cache", "pool", "index",
+                 "table", "slot_nodes", "obs_counts")
 
     def __init__(self, name, model, params, steps):
         self.name = name
@@ -525,9 +674,23 @@ class _ModelState:
         self.config = model.config
         self.params = params
         (self.decode_step, self.verify_step, self.prefill_step,
-         self.fused_step) = steps
+         self.fused_step, self.decode_paged, self.verify_paged,
+         self.prefill_paged, self.fused_paged) = steps
         self.cache = None
         self.prefix_cache = None
+        # Paged mode (Engine(kv_pages=N)): no dense arena — ``pool`` is
+        # the shared PagePool of this model's KV-geometry group,
+        # ``index`` its radix PageIndex (cached KV is a function of
+        # MODEL and tokens, so trees never cross models even when the
+        # pool does), ``table`` the host-authoritative (num_slots,
+        # max_pages) int32 block table uploaded per step, and
+        # ``slot_nodes[s]`` maps each of slot s's SHARED pages to the
+        # pinned tree node behind it (private pages are the table
+        # entries absent here).
+        self.pool = None
+        self.index = None
+        self.table = None
+        self.slot_nodes = None
         # OBS_DEVICE_COUNTERS accumulator: rides this model's step
         # programs (donated in, rebound from each result), fetched only
         # by Engine.metrics().
@@ -678,6 +841,19 @@ class Engine:
     the subsystem byte-for-byte, stats keys included).  The public
     handle is :attr:`prefix_cache` (``None`` when off).
 
+    ``kv_pages > 0`` turns on TRUE PAGED ATTENTION (module docstring
+    bullet): no dense arenas — slots read KV through per-slot block
+    tables into one shared refcounted page pool (``kv_pages`` pages of
+    ``prefill_chunk`` tokens each, carved across co-resident models'
+    KV-geometry groups), prefix reuse is a table write with
+    copy-on-write at the divergence block, and publish is an ownership
+    transfer.  Outputs stay bit-identical to the dense engine and to
+    ``generate()``; ``kv_dtype="int8"`` additionally quantizes page
+    payloads (tolerance-bounded outputs, double capacity).  Public
+    handles: :attr:`page_pool` / :attr:`page_index`; mutually
+    exclusive with ``prefix_cache_blocks`` (the dense COPY cache,
+    which stays byte-for-byte unchanged when paging is off).
+
     ``decode_fuse > 1`` turns on fused decode windows: on pure-decode
     iterations (no queued work, nothing prefilling, no speculation this
     step) the scheduler runs ONE ``lax.while_loop`` program for up to
@@ -719,6 +895,7 @@ class Engine:
                  max_len: int | None = None, prefill_chunk: int = 16,
                  speculate_k: int = 0, drafter=None,
                  prefix_cache_blocks: int = 0,
+                 kv_pages: int = 0, kv_dtype: str | None = None,
                  decode_fuse: int = 1, fuse_stream: bool = False,
                  queue_limit: int | None = None,
                  drafter_timeout_s: float | None = None,
@@ -748,6 +925,23 @@ class Engine:
             raise ValueError(
                 f"prefix_cache_blocks must be >= 0 (0 disables prefix "
                 f"caching), got {prefix_cache_blocks}")
+        if kv_pages < 0:
+            raise ValueError(
+                f"kv_pages must be >= 0 (0 keeps the dense slot arena), "
+                f"got {kv_pages}")
+        if kv_pages and prefix_cache_blocks:
+            raise ValueError(
+                "kv_pages (paged attention: slots reference one shared "
+                "page pool in place, prefix reuse is a table write) and "
+                "prefix_cache_blocks (the dense COPY cache) are mutually "
+                "exclusive — paged mode subsumes the copy path")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        if kv_dtype is not None and not kv_pages:
+            raise ValueError(
+                "kv_dtype requires kv_pages > 0 — quantized KV lives in "
+                "page-pool payloads behind the table indirection")
         if drafter is not None and speculate_k == 0:
             raise ValueError("drafter requires speculate_k >= 1 "
                              "(speculation is off at k=0)")
@@ -799,6 +993,15 @@ class Engine:
         self.speculate_k = speculate_k
         self.drafter = drafter
         self._prefix_cache_blocks = prefix_cache_blocks
+        # True paged attention (kv_pages > 0): per-slot block tables
+        # into ONE shared page pool per KV geometry, copy-on-write
+        # prefix reuse, no dense arenas.  kv_pages=0 — the default — is
+        # byte-for-byte the dense engine (no paged program traced, no
+        # paged stats keys, no pool allocated).
+        self._paged = kv_pages > 0
+        self.kv_pages = kv_pages
+        self.kv_dtype = kv_dtype
+        self._max_pages = self.max_len // prefill_chunk  # table width
         # Fused decode windows (module docstring "Fused decode windows"):
         # decode_fuse=1 — the default — never touches the fused program
         # and is byte-for-byte the single-step engine.
@@ -855,6 +1058,8 @@ class Engine:
                         f"tenants[{tname!r}] routes to unregistered "
                         f"model {route!r} (registered: "
                         f"{sorted(k for k in self._mstates if k)})")
+        if self._paged:
+            self._build_page_pools()
         self._keys = jnp.zeros((num_slots, 2), jnp.uint32)
         # Host-authoritative per-slot state, uploaded each step (tiny
         # arrays; values are data, never shapes).
@@ -936,8 +1141,48 @@ class Engine:
 
             ms.prefix_cache = PrefixCache(cfg, self._prefix_cache_blocks,
                                           self.prefill_chunk)
-        ms.cache = KVCache.zeros(cfg, self.num_slots, self.max_len)
+        # Paged mode allocates NO dense arena — the shared page pools
+        # (and per-model tables/indexes) are carved once every model is
+        # registered (_build_page_pools); until then ms.cache stays
+        # None, which every dense-only path below is gated on.
+        if not self._paged:
+            ms.cache = KVCache.zeros(cfg, self.num_slots, self.max_len)
         self._mstates[name] = ms
+
+    def _build_page_pools(self) -> None:
+        """Carve ``kv_pages`` across the registered models' KV-geometry
+        groups: models sharing (layers, kv_heads, head_dim, dtype)
+        literally share ONE PagePool buffer — an idle tenant reserves
+        zero pages instead of a dense ``(num_slots, max_len)`` arena —
+        while distinct geometries split the page budget evenly (pages
+        of different shapes cannot share a buffer).  Every model gets
+        its own radix PageIndex over the group pool (cached KV is a
+        function of model and tokens) plus a host-side block table."""
+        from tpudp.serve.prefix_cache import PageIndex, PagePool
+
+        groups: dict[tuple, list[_ModelState]] = {}
+        for ms in self._mstates.values():
+            cfg = ms.config
+            key = (cfg.num_layers,
+                   getattr(cfg, "kv_heads", cfg.num_heads),
+                   cfg.d_model // cfg.num_heads, str(cfg.dtype))
+            groups.setdefault(key, []).append(ms)
+        per_group = self.kv_pages // len(groups)
+        if per_group < self._max_pages:
+            raise ValueError(
+                f"kv_pages ({self.kv_pages}) carves to {per_group} "
+                f"pages per KV-geometry group ({len(groups)} groups) — "
+                f"below the {self._max_pages} pages one max_len "
+                f"({self.max_len}) request needs; raise kv_pages")
+        for members in groups.values():
+            pool = PagePool(members[0].config, per_group,
+                            self.prefill_chunk, self.kv_dtype)
+            for ms in members:
+                ms.pool = pool
+                ms.index = PageIndex(pool)
+                ms.table = np.full((self.num_slots, self._max_pages),
+                                   -1, np.int32)
+                ms.slot_nodes = [dict() for _ in range(self.num_slots)]
 
     @property
     def prefix_cache(self):
@@ -945,6 +1190,19 @@ class Engine:
         off) — the public handle tests and tools inspect.  Co-resident
         models each hold their own cache internally."""
         return self._mstates[None].prefix_cache
+
+    @property
+    def page_pool(self):
+        """The DEFAULT model's shared :class:`PagePool` (``None`` with
+        paging off) — co-resident models of the same KV geometry share
+        this very object."""
+        return self._mstates[None].pool
+
+    @property
+    def page_index(self):
+        """The DEFAULT model's radix :class:`PageIndex` (``None`` with
+        paging off)."""
+        return self._mstates[None].index
 
     @property
     def tenant_stats(self) -> dict:
@@ -1156,6 +1414,18 @@ class Engine:
                      and r._ms is ms for r in self._slots])
                 if not active.any():
                     continue
+                if self._paged:
+                    # Back every table entry the step is about to
+                    # write BEFORE dispatch (plain decode: one token;
+                    # verify: the k+1 window; fused: the whole
+                    # window).  Page pressure resolves here on the
+                    # host — evict cold cache leaves, then vacate the
+                    # most recent co-resident slot through the
+                    # bit-exact resume path — so the device program
+                    # only ever sees fully-backed tables.
+                    active = self._ensure_decode_pages(ms, active, fuse)
+                    if not active.any():
+                        continue
                 if self.speculate_k and not self._drafter_quarantined:
                     self._run_verify(ms, active, emitted)
                 elif fuse:
@@ -1286,6 +1556,15 @@ class Engine:
         if self._sched is not None:
             out["tenants"] = {name: dict(c)
                               for name, c in self.tenant_stats.items()}
+        if self._paged:
+            pools: list = []
+            for ms in self._mstates.values():
+                if ms.pool not in pools:
+                    pools.append(ms.pool)
+            out["page_pools"] = [
+                {"num_pages": p.num_pages, "used_pages": p.used_pages,
+                 "free_pages": p.free_pages,
+                 "page_bytes": p.page_bytes()} for p in pools]
         if self.stats.get("draft_tokens"):
             out["acceptance_rate"] = self.acceptance_rate
         return out
@@ -1339,7 +1618,9 @@ class Engine:
                 self._sched.stats(r.tenant)[
                     "readmitted" if r._resume_key is not None
                     else "admitted"] += 1
-            if r._ms.prefix_cache is not None:
+            if self._paged:
+                self._admit_prefix_paged(r._ms, s, r)
+            elif r._ms.prefix_cache is not None:
                 self._admit_prefix(r._ms, s, r)
 
     def _admit_prefix(self, ms: _ModelState, s: int, r: Request) -> None:
@@ -1374,6 +1655,251 @@ class Engine:
         r._nfill = hit
         self._len[s] = hit
 
+    # -- paged attention internals (Engine(kv_pages=N)) ----------------
+
+    def _admit_prefix_paged(self, ms: _ModelState, s: int,
+                            r: Request) -> None:
+        """Paged cache-hit admission: MAP the longest cached
+        block-aligned prefix of the fill into the slot's table — a
+        refcount bump per page, zero KV copies (vs the dense path's
+        per-block ``copy_block_in`` calls).  The hit is capped one
+        chunk short of the fill exactly like the dense path, so the
+        final chunk always re-prefills: that re-prefill writes a FRESH
+        private page — the copy-on-write at the divergence block —
+        while the mapped shared pages are never written (the slot's
+        first write position is at or past the page after the hit)."""
+        self.stats["prefix_lookups"] += 1
+        nodes = ms.index.lookup(r._fill)
+        n_map = min(len(nodes), (r._fill.size - 1) // self.prefill_chunk)
+        hit = n_map * self.prefill_chunk
+        self.stats["prefix_hit_tokens"] += hit
+        if not n_map:
+            return
+        for i, node in enumerate(nodes[:n_map]):
+            ms.index.pin(node)
+            ms.pool.share(node.block)
+            ms.table[s, i] = node.block
+            ms.slot_nodes[s][node.block] = node
+        r._nfill = hit
+        self._len[s] = hit
+
+    def _publish_prefix_paged(self, ms: _ModelState, s: int,
+                              r: Request) -> None:
+        """Paged retirement/preemption publish: TRANSFER the slot's
+        full chunk-prefilled pages to the radix tree (insert-or-ref;
+        ``PageIndex.adopt`` takes a pool reference per newly adopted
+        page) — pure host-side metadata, no device call, so unlike the
+        dense copy-out there is nothing to fault or flush.  Only pages
+        the slot itself prefilled transfer as NEW nodes; pages mapped
+        from an earlier hit are already the tree's (adopt just touches
+        them), and a chunk another request published meanwhile keeps
+        the tree's page (the slot's identical private duplicate drops
+        at vacate)."""
+        n_blocks = min(r._nfill, r._fill.size) // self.prefill_chunk
+        if not n_blocks:
+            return
+        pages = [int(ms.table[s, i]) for i in range(n_blocks)]
+        if any(p < 0 for p in pages):  # never expected: prefill allocates
+            return
+        self.stats["prefix_published_blocks"] += ms.index.adopt(
+            r._fill, pages)
+
+    def _release_slot_pages(self, ms: _ModelState, s: int) -> None:
+        """Drop every page reference slot ``s`` holds (the vacate /
+        retire half of the refcount discipline): shared mappings unpin
+        their tree node, every table entry releases its pool
+        reference, and the table row clears.  Idempotent after a
+        containment flush (the table is already -1)."""
+        if not self._paged:
+            return
+        for pidx in range(self._max_pages):
+            page = int(ms.table[s, pidx])
+            if page < 0:
+                continue
+            node = ms.slot_nodes[s].pop(page, None)
+            if node is not None:
+                ms.index.unpin(node)
+            ms.pool.release(page)
+        ms.table[s] = -1
+        ms.slot_nodes[s] = {}
+
+    def _alloc_page(self, ms: _ModelState, protect: int) -> int | None:
+        """One exclusive page for slot ``protect``, evicting cold tree
+        leaves and — when the whole pool is live — VACATING the
+        most-recently-admitted co-resident slot (lowest priority first
+        under tenancy; the least sunk cost, so the oldest in-flight
+        request always progresses) through the bit-exact resume path.
+        Returns None only when slot ``protect`` alone cannot be
+        satisfied, which the admission-time max_len<->pool validation
+        rules out."""
+        while True:
+            page = ms.pool.alloc()
+            if page is not None:
+                return page
+            if self._evict_index_page(ms.pool):
+                continue
+            victim = self._page_pressure_victim(ms.pool, protect)
+            if victim is None:
+                return None
+            self._vacate_for_pages(victim)
+
+    def _evict_index_page(self, pool) -> bool:
+        """Evict the globally least-recently-touched unreferenced leaf
+        across every index sharing ``pool`` (deterministic: the shared
+        logical clock is per-index, ties broken by registration
+        order)."""
+        best = None
+        for ms in self._mstates.values():
+            if ms.pool is not pool or ms.index is None:
+                continue
+            for node in ms.index._by_block.values():
+                if node.refs:
+                    continue
+                if best is None or node.stamp < best[1].stamp:
+                    best = (ms.index, node)
+        if best is None:
+            return False
+        index, node = best
+        index.evict_node(node)
+        return True
+
+    def _page_pressure_victim(self, pool, protect: int) -> int | None:
+        """The slot to vacate under page pressure: among slots whose
+        model draws from ``pool`` (excluding ``protect``), the lowest
+        priority, then the most recently admitted — preemption's
+        least-sunk-cost rule, which guarantees the oldest request runs
+        to completion and the engine always makes progress."""
+        victims = [s for s, r in enumerate(self._slots)
+                   if r is not None and s != protect
+                   and r._ms.pool is pool]
+        if not victims:
+            return None
+        if self._sched is not None:
+            return max(victims,
+                       key=lambda s: (-self._priority_of(self._slots[s]),
+                                      self._slots[s]._order))
+        return max(victims, key=lambda s: self._slots[s]._order)
+
+    def _vacate_for_pages(self, s: int) -> None:
+        """Evict slot ``s`` to free its pages: publish its prefilled
+        prefix first (a host-side ownership transfer — the pages stay
+        resident as evictable cache, so the resume usually collapses
+        to table writes), then vacate through the shared carry-over
+        path and requeue at the FRONT of its class, exactly like
+        priority preemption — the request resumes bit-identically and
+        the vacate is never user-visible."""
+        r = self._slots[s]
+        if self._accepting:
+            self._publish_prefix(r._ms, s, r)
+        self._vacate_slot(s)
+        # Page pressure gets its OWN accounting at every level (it is
+        # not priority preemption — the handle's ``preemptions`` and
+        # stats["preempted"] keep meaning "lost the slot to
+        # higher-priority work" on paged engines too).
+        self.stats["page_pressure_vacates"] += 1
+        self.obs.event("page_vacate", rid=r.id, slot=s, tenant=r.tenant,
+                       tokens=len(r.tokens))
+        if r.tenant is not None:
+            self._sched.stats(r.tenant)["page_pressure_vacates"] += 1
+        if self._sched is not None:
+            self._sched.requeue_front(r)
+        else:
+            self._queue.appendleft(r)
+
+    def _ensure_pages(self, ms: _ModelState, s: int, upto: int) -> bool:
+        """Allocate slot ``s``'s table entries covering positions
+        ``[0, upto)`` (lazily — a paged slot holds pages only as deep
+        as it has actually written, the overcommit that multiplies
+        capacity).  Returns False iff the slot itself was lost, which
+        the pool-size validation precludes."""
+        need = min((upto + self.prefill_chunk - 1) // self.prefill_chunk,
+                   self._max_pages)
+        for pidx in range(need):
+            if ms.table[s, pidx] >= 0:
+                continue
+            page = self._alloc_page(ms, protect=s)
+            if page is None:
+                # Unreachable by construction (pool >= one max_len
+                # request per geometry group, and every other holder is
+                # evictable/vacatable) — but an unbacked table entry
+                # must fail LOUDLY, not silently route this slot's
+                # writes to the scratch page.
+                self._retire(s, FinishReason.ERROR,
+                             error=RuntimeError(
+                                 f"page pool exhausted backing slot {s} "
+                                 f"to position {upto} — kv_pages too "
+                                 f"small for the admitted workload"))
+                return False
+            ms.table[s, pidx] = page
+        return True
+
+    def _ensure_decode_pages(self, ms: _ModelState, active,
+                             fuse: bool):
+        """Preallocate every active slot's pages for the step about to
+        dispatch (one token for plain decode, the k+1 verify window,
+        or the whole fused window) — page-pressure vacates happen HERE,
+        on the host, before the device program runs, so the program
+        itself only ever sees fully-backed tables.  Returns the active
+        mask recomputed after any vacates."""
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            if r is None:
+                continue
+            # MIRROR THE DISPATCH ORDER below (speculation wins over
+            # fusing): a live drafter runs the k+1 verify window even
+            # on iterations where ``fuse`` is True, and backing only
+            # the fused window's positions would route the window
+            # tail's KV writes to the scratch page — silent corruption.
+            if self.speculate_k and not self._drafter_quarantined:
+                ahead = self.speculate_k + 1
+            elif fuse:
+                ahead = min(r.max_new_tokens - len(r.tokens),
+                            self.decode_fuse)
+            else:
+                ahead = 1
+            self._ensure_pages(ms, s, int(self._len[s]) + ahead)
+        return np.array(
+            [r is not None and r._nfill == r._fill.size
+             and r._ms is ms for r in self._slots])
+
+    def check_paged(self) -> None:
+        """Table<->pool<->tree consistency for the whole paged engine
+        (the paged extension of ``PrefixCache.check``; tests call it
+        after every mutation storm): every pool's internal invariants,
+        every index's tree shape, and the cross-check that each
+        allocated page's refcount equals its actual holders — one per
+        owning tree node plus one per table entry mapping it."""
+        if not self._paged:
+            return
+        pools = []
+        for ms in self._mstates.values():
+            if ms.pool not in pools:
+                pools.append(ms.pool)
+            ms.index.check()
+            for s in range(self.num_slots):
+                for page, node in ms.slot_nodes[s].items():
+                    if ms.index._by_block.get(node.block) is not node:
+                        raise RuntimeError(
+                            f"slot {s} pins a node the index no longer "
+                            f"holds (page {page})")
+                    if page not in ms.table[s]:
+                        raise RuntimeError(
+                            f"slot {s} pins page {page} absent from its "
+                            f"table row")
+        for pool in pools:
+            expected: dict[int, int] = {}
+            for ms in self._mstates.values():
+                if ms.pool is not pool:
+                    continue
+                for page in ms.index.tree_refs():
+                    expected[page] = expected.get(page, 0) + 1
+                for s in range(self.num_slots):
+                    for pidx in range(self._max_pages):
+                        page = int(ms.table[s, pidx])
+                        if page >= 0:
+                            expected[page] = expected.get(page, 0) + 1
+            pool.check(expected)
+
     def _publish_prefix(self, ms: _ModelState, s: int,
                         r: Request) -> None:
         """Retirement-time publish: insert the slot's block-aligned
@@ -1388,7 +1914,12 @@ class Engine:
         flushes the cache — with a fresh pool buffer, since the failed
         call had the pool donated — and the retirement proceeds.  The
         ARENA is read-only in the copy-out program, so a publish
-        failure never forces an arena rebuild."""
+        failure never forces an arena rebuild.  In paged mode the
+        publish is an ownership transfer instead
+        (:meth:`_publish_prefix_paged`) — no device call at all."""
+        if self._paged:
+            self._publish_prefix_paged(ms, s, r)
+            return
         from tpudp.serve import prefix_cache as _pc
 
         from tpudp.utils.watchdog import StepHangError
@@ -1523,9 +2054,27 @@ class Engine:
         })
         if self._watchdog is not None:
             self._watchdog.acknowledge()  # handled; next scope may proceed
+        rebuilt_pools: list = []
         for ms in self._mstates.values():
-            ms.cache = KVCache.zeros(ms.config, self.num_slots,
-                                     self.max_len)
+            if self._paged:
+                # Paged rebuild: the failed call may have had the
+                # (donated) shared pool in flight, so every page's
+                # validity is unknown — reallocate each pool ONCE
+                # (models share them), clear every table and radix
+                # index, and let the requeued survivors re-prefill
+                # into fresh pages (prefill is deterministic, so the
+                # retry is bit-identical — the same oracle as the
+                # dense arena rebuild).
+                if ms.pool not in rebuilt_pools:
+                    ms.pool.reallocate()
+                    rebuilt_pools.append(ms.pool)
+                ms.index.reset()
+                ms.table[:] = -1
+                ms.slot_nodes = [dict() for _ in range(self.num_slots)]
+                self.stats["prefix_flushes"] += 1
+            else:
+                ms.cache = KVCache.zeros(ms.config, self.num_slots,
+                                         self.max_len)
             # The failed call may have consumed the donated counters
             # buffer too — rebuild it.  The pre-fault values are LOST
             # (fetching a possibly-donated buffer here could raise and
@@ -1585,9 +2134,19 @@ class Engine:
         end = min(start + self.prefill_chunk, fill.size)
         buf = np.zeros((1, self.prefill_chunk), np.int32)
         buf[0, :end - start] = fill[start:end]
-        last_logits, ms.cache = self._device(
-            "prefill", ms.prefill_step, ms.cache, np.int32(s), buf,
-            np.int32(start), np.int32(end - start - 1))
+        if self._paged:
+            # Back the chunk's page first (page-pressure vacates can
+            # only hit OTHER slots — this one is protected), then run
+            # the paged prefill against the slot's table row.
+            if not self._ensure_pages(ms, s, end):
+                return  # slot retired (defensive: pool exhausted)
+            last_logits, ms.pool.pages = self._device(
+                "prefill", ms.prefill_paged, ms.pool.pages, ms.table[s],
+                buf, np.int32(start), np.int32(end - start - 1))
+        else:
+            last_logits, ms.cache = self._device(
+                "prefill", ms.prefill_step, ms.cache, np.int32(s), buf,
+                np.int32(start), np.int32(end - start - 1))
         r._nfill = end
         self._len[s] = end
         self.stats["prefill_chunks"] += 1
@@ -1623,10 +2182,17 @@ class Engine:
             self._commit(s, int(tok), emitted)
 
     def _run_decode(self, ms: _ModelState, active, emitted) -> None:
-        ms.cache, toks, self._keys, ms.obs_counts = self._device(
-            "decode", ms.decode_step,
-            ms.cache, self._last, self._len, active, self._temps,
-            self._topk, self._topp, self._keys, ms.obs_counts)
+        if self._paged:
+            ms.pool.pages, toks, self._keys, ms.obs_counts = self._device(
+                "decode", ms.decode_paged,
+                ms.pool.pages, ms.table, self._last, self._len, active,
+                self._temps, self._topk, self._topp, self._keys,
+                ms.obs_counts)
+        else:
+            ms.cache, toks, self._keys, ms.obs_counts = self._device(
+                "decode", ms.decode_step,
+                ms.cache, self._last, self._len, active, self._temps,
+                self._topk, self._topp, self._keys, ms.obs_counts)
         # tpudp: lint-ok(host-sync): the single-step path's per-token
         # fetch — Engine(decode_fuse=N) amortizes it to one fetch per
         # fused lax.while_loop window (_run_decode_fused); this path
@@ -1666,14 +2232,24 @@ class Engine:
         # not misdiagnose a healthy window as a wedged call.
         budget_s = (self._step_timeout_s * self.decode_fuse
                     if self._step_timeout_s is not None else None)
-        (ms.cache, out, n_emit, keys, iters,
-         ms.obs_counts) = self._device(
-            "fused_decode", ms.fused_step,
-            ms.cache, self._last, self._len, active, self._temps,
-            self._topk, self._topp, self._keys, budgets, eos,
-            np.int32(self._ring_id), ms.obs_counts,
-            guard_timeout_s=budget_s,
-            n_steps=self.decode_fuse, stream=self._fuse_stream)
+        if self._paged:
+            (ms.pool.pages, out, n_emit, keys, iters,
+             ms.obs_counts) = self._device(
+                "fused_decode", ms.fused_paged,
+                ms.pool.pages, ms.table, self._last, self._len, active,
+                self._temps, self._topk, self._topp, self._keys,
+                budgets, eos, np.int32(self._ring_id), ms.obs_counts,
+                guard_timeout_s=budget_s,
+                n_steps=self.decode_fuse, stream=self._fuse_stream)
+        else:
+            (ms.cache, out, n_emit, keys, iters,
+             ms.obs_counts) = self._device(
+                "fused_decode", ms.fused_step,
+                ms.cache, self._last, self._len, active, self._temps,
+                self._topk, self._topp, self._keys, budgets, eos,
+                np.int32(self._ring_id), ms.obs_counts,
+                guard_timeout_s=budget_s,
+                n_steps=self.decode_fuse, stream=self._fuse_stream)
         # tpudp: lint-ok(host-sync): the per-WINDOW fetch — one round
         # trip per up-to-decode_fuse-token window, the amortized
         # replacement for the single-step path's per-token fetch.
@@ -1815,10 +2391,19 @@ class Engine:
             tokens[s, 1:1 + draft.size] = draft  # validated in-vocab
             n_draft[s] = draft.size
             self._slots[s].draft_proposed += int(draft.size)
-        ms.cache, out, n_emit, self._keys, ms.obs_counts = self._device(
-            "verify", ms.verify_step,
-            ms.cache, tokens, self._len, active, n_draft, self._temps,
-            self._topk, self._topp, self._keys, ms.obs_counts)
+        if self._paged:
+            (ms.pool.pages, out, n_emit, self._keys,
+             ms.obs_counts) = self._device(
+                "verify", ms.verify_paged,
+                ms.pool.pages, ms.table, tokens, self._len, active,
+                n_draft, self._temps, self._topk, self._topp, self._keys,
+                ms.obs_counts)
+        else:
+            (ms.cache, out, n_emit, self._keys,
+             ms.obs_counts) = self._device(
+                "verify", ms.verify_step,
+                ms.cache, tokens, self._len, active, n_draft, self._temps,
+                self._topk, self._topp, self._keys, ms.obs_counts)
         # tpudp: lint-ok(host-sync): the per-window verify fetch (one
         # round trip per k+1-token window, amortized over accepts) —
         # fusing the drafter into the device program removes it.
@@ -1903,7 +2488,8 @@ class Engine:
         published first when caching is on, so the resume's re-prefill
         collapses to block copies plus the final chunk."""
         r = self._slots[s]
-        if r._ms.prefix_cache is not None and self._accepting:
+        if ((self._paged or r._ms.prefix_cache is not None)
+                and self._accepting):
             self._publish_prefix(r._ms, s, r)
         self._vacate_slot(s)
         r.preemptions += 1
@@ -1923,6 +2509,7 @@ class Engine:
         contract, so a new per-slot array added to one must by
         construction be cleared for the other."""
         r = self._slots[s]
+        self._release_slot_pages(r._ms, s)
         key = np.asarray(self._keys[s])
         self._slots[s] = None
         self._len[s] = 0
@@ -1946,8 +2533,10 @@ class Engine:
         # prefix is exactly as good as a completed one's).  Skipped
         # once drain()/close() has begun — device copies to warm a pool
         # no future request can ever read would only slow shutdown.
-        if r._ms.prefix_cache is not None and self._accepting:
+        if ((self._paged or r._ms.prefix_cache is not None)
+                and self._accepting):
             self._publish_prefix(r._ms, s, r)
+        self._release_slot_pages(r._ms, s)
         r._slot = None
         self._slots[s] = None
         self._len[s] = 0  # slot recycled; the next prefill overwrites from 0

@@ -55,7 +55,7 @@ import functools
 import jax
 from jax import lax
 
-from tpudp.models.generate import KVCache
+from tpudp.models.generate import Int8Pages, KVCache
 from tpudp.serve.engine import TRACE_COUNTS
 
 
@@ -293,3 +293,280 @@ class PrefixCache:
             raise RuntimeError(
                 f"{len(seen)} owned + {len(self._free)} free != "
                 f"{self.num_blocks} total")
+
+
+# ---------------------------------------------------------------------------
+# True paged attention (Engine(kv_pages=N)): the block pool + radix tree
+# promoted from a COPY cache into the engine's one KV store.  The pool
+# below is the only KV buffer a paged engine owns (no per-slot dense
+# arena); slots reference pages through per-slot block tables, a cache
+# hit is a table write + refcount bump (copy-on-write: the divergence
+# page is re-prefilled into a fresh private page, shared pages are never
+# written), and retirement publishes by TRANSFERRING page ownership to
+# the radix tree — neither admission nor publish moves KV bytes.
+# ---------------------------------------------------------------------------
+
+
+class PagePool:
+    """Refcounted KV page pool shared across every co-resident model of
+    one KV geometry (``Engine(models=...)``) — the paged engine's
+    allocator.  ``num_pages`` real pages plus ONE trailing SCRATCH page
+    (index ``num_pages``) that absorbs the step programs' masked writes
+    (inactive slots, the statically-unrolled spare page of a window
+    that stayed inside one page) so no real block is ever clobbered.
+
+    Refcount discipline (``check()`` verifies it): a page is free
+    (rc absent, on the free list) or allocated (rc >= 1).  ``alloc()``
+    hands out an exclusive page at rc=1; every additional holder — a
+    slot's table mapping a cached page, the radix tree adopting a
+    published page — takes ``share()``; every holder symmetrically
+    ``release()``s, and rc hitting 0 returns the page to the free
+    list.  All metadata is host-side and deterministic.
+    """
+
+    def __init__(self, cfg, num_pages: int, page_tokens: int,
+                 kv_dtype: str | None = None):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        if page_tokens < 1:
+            raise ValueError(
+                f"page_tokens must be >= 1, got {page_tokens}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        self.config = cfg
+        self.num_pages = num_pages
+        self.page_tokens = page_tokens
+        self.kv_dtype = kv_dtype
+        self.scratch = num_pages  # the +1 guard page (never allocated)
+        self.pages = self._buffer()
+        self._rc: dict[int, int] = {}
+        self._free = list(range(num_pages - 1, -1, -1))
+
+    def _buffer(self):
+        cls = Int8Pages if self.kv_dtype == "int8" else KVCache
+        return cls.zeros(self.config, self.num_pages + 1,
+                         self.page_tokens)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def page_bytes(self) -> int:
+        """HBM bytes of one page across k/v (and scales in int8 mode) —
+        the unit of the serve bench's fixed-byte capacity comparison."""
+        total = sum(int(buf.size) * buf.dtype.itemsize
+                    for buf in self.pages)
+        return total // (self.num_pages + 1)
+
+    def alloc(self) -> int | None:
+        """One exclusive page (rc=1), or None when the pool is empty —
+        the engine then evicts cold tree leaves / vacates a slot."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self._rc[page] = 1
+        return page
+
+    def share(self, page: int) -> None:
+        self._rc[page] += 1
+
+    def release(self, page: int) -> None:
+        rc = self._rc[page] - 1
+        if rc:
+            self._rc[page] = rc
+        else:
+            del self._rc[page]
+            self._free.append(page)
+
+    def reallocate(self) -> None:
+        """Fresh device buffer + all pages freed: the engine's
+        step-failure containment, where the failed call may have had
+        the (donated) pool in flight and every page's validity is
+        unknown."""
+        self.pages = self._buffer()
+        self._rc = {}
+        self._free = list(range(self.num_pages - 1, -1, -1))
+
+    def check(self, expected_refs: dict[int, int] | None = None) -> None:
+        """Pool consistency; with ``expected_refs`` (page -> reference
+        count derived from the live tables and radix trees) also the
+        table<->pool cross-check — no table maps a freed page, every
+        allocated page's rc equals its holders."""
+        if set(self._rc) & set(self._free):
+            raise RuntimeError("pages both allocated and free")
+        if len(self._rc) + len(self._free) != self.num_pages:
+            raise RuntimeError(
+                f"{len(self._rc)} allocated + {len(self._free)} free != "
+                f"{self.num_pages} total")
+        for page, rc in self._rc.items():
+            if not 0 <= page < self.num_pages:
+                raise RuntimeError(f"out-of-range page {page} allocated")
+            if rc < 1:
+                raise RuntimeError(f"page {page} held at rc {rc}")
+        if expected_refs is not None and dict(self._rc) != expected_refs:
+            raise RuntimeError(
+                f"pool refcounts {dict(sorted(self._rc.items()))} "
+                f"disagree with table/tree holders "
+                f"{dict(sorted(expected_refs.items()))}")
+
+
+class PageIndex:
+    """Radix tree over token prefixes whose nodes OWN pool pages — the
+    paged twin of :class:`PrefixCache`'s tree, with the pool external
+    and shared.  A node holds one :class:`PagePool` reference on its
+    page; slots mapping a cached page pin the node (so eviction can
+    never take a mapped page) and take their own pool reference.
+    Publishing ADOPTS the retiring slot's already-written pages
+    (``pool.share``) instead of copying KV; eviction walks cold
+    unreferenced leaves and ``pool.release``s their pages — on demand,
+    under allocation pressure, rather than under a fixed block budget
+    (the pool IS the budget)."""
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self.block_tokens = pool.page_tokens
+        self.evictions = 0
+        self._root = _Node(None, -1, None)
+        self._by_block: dict[int, _Node] = {}
+        self._clock = 0
+
+    # -- shared tree mechanics (same shapes as PrefixCache) ------------
+
+    @property
+    def node_count(self) -> int:
+        return len(self._by_block)
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    def _chunk_key(self, tokens, i: int) -> tuple:
+        c = self.block_tokens
+        return tuple(int(t) for t in tokens[i * c:(i + 1) * c])
+
+    def lookup(self, tokens) -> list[_Node]:
+        """Nodes covering the longest cached block-aligned prefix of
+        ``tokens`` (touching each, so reused prefixes stay warm).
+        Returns NODES, not block ids — the paged admission pins the
+        node and shares its page."""
+        out: list[_Node] = []
+        cur = self._root
+        for i in range(len(tokens) // self.block_tokens):
+            nxt = cur.children.get(self._chunk_key(tokens, i))
+            if nxt is None:
+                break
+            self._touch(nxt)
+            out.append(nxt)
+            cur = nxt
+        return out
+
+    def pin(self, node: _Node) -> None:
+        node.refs += 1
+
+    def unpin(self, node: _Node) -> None:
+        node.refs -= 1
+
+    def adopt(self, tokens, pages: list[int]) -> int:
+        """Insert-or-ref the first ``len(pages)`` chunks of ``tokens``,
+        ADOPTING the caller's pages for chunks the tree lacks: a new
+        node takes its own pool reference on ``pages[i]`` (the retiring
+        slot's reference is released separately at vacate — ownership
+        transfers, no KV moves).  Chunks already cached keep the
+        tree's existing page (prefill is deterministic, so the two
+        pages hold identical KV; the caller's duplicate simply drops to
+        rc 0 at vacate).  Returns the number of newly adopted pages."""
+        new = 0
+        cur = self._root
+        for i, page in enumerate(pages):
+            key = self._chunk_key(tokens, i)
+            nxt = cur.children.get(key)
+            if nxt is None:
+                nxt = _Node(key, page, cur)
+                cur.children[key] = nxt
+                cur.refs += 1
+                self._by_block[page] = nxt
+                self.pool.share(page)
+                new += 1
+            self._touch(nxt)
+            cur = nxt
+        return new
+
+    def evict_node(self, node: _Node) -> None:
+        """Unlink one unreferenced leaf and release its page — the ONE
+        eviction bookkeeping sequence, shared by :meth:`evict_one` and
+        the engine's cross-index victim scan (two copies of this
+        five-step invariant would desynchronize the moment one grew a
+        field)."""
+        del node.parent.children[node.key]
+        node.parent.refs -= 1
+        del self._by_block[node.block]
+        self.pool.release(node.block)
+        self.evictions += 1
+
+    def evict_one(self) -> bool:
+        """Release the least-recently-touched unreferenced leaf's page
+        back to the pool (False when every node is referenced — pinned
+        by a live table or an interior parent).  The engine calls this
+        under allocation pressure until ``alloc`` succeeds."""
+        victim = None
+        for node in self._by_block.values():
+            if node.refs:
+                continue
+            if victim is None or node.stamp < victim.stamp:
+                victim = node
+        if victim is None:
+            return False
+        self.evict_node(victim)
+        return True
+
+    def flush(self) -> None:
+        """Drop every cached node, releasing its page reference.  For
+        containment — where the POOL was reallocated wholesale — use
+        :meth:`reset` instead (the references died with the pool)."""
+        for node in list(self._by_block.values()):
+            self.pool.release(node.block)
+        self.reset()
+
+    def reset(self) -> None:
+        """Metadata-only clear (the pool already dropped every
+        reference, e.g. ``PagePool.reallocate`` after containment)."""
+        self._root = _Node(None, -1, None)
+        self._by_block = {}
+
+    def tree_refs(self) -> dict[int, int]:
+        """page -> pool references held by this tree (1 per node) —
+        the engine's table<->pool cross-check input."""
+        return {page: 1 for page in self._by_block}
+
+    def check(self) -> None:
+        """Tree-shape invariants (same contract as PrefixCache.check,
+        minus pool-block accounting — the PagePool owns that side; the
+        engine's ``check_paged`` composes both)."""
+        seen: dict[int, _Node] = {}
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if node.refs < len(node.children):
+                raise RuntimeError(
+                    f"node {node.key!r} refs {node.refs} below child "
+                    f"count {len(node.children)}")
+            for key, child in node.children.items():
+                if child.parent is not node or child.key != key:
+                    raise RuntimeError(
+                        f"child {key!r} has inconsistent parent/key links")
+                if not 0 <= child.block < self.pool.num_pages:
+                    raise RuntimeError(
+                        f"node {key!r} owns out-of-range page "
+                        f"{child.block}")
+                if child.block in seen:
+                    raise RuntimeError(
+                        f"page {child.block} owned by two nodes")
+                seen[child.block] = child
+                stack.append(child)
+        if set(seen) != set(self._by_block):
+            raise RuntimeError("page index disagrees with the tree")
