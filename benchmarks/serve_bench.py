@@ -85,7 +85,19 @@ The row reports the peak co-resident contexts each engine sustained
 (headline ``value`` = their ratio, gated >= 1.5x with zero
 page-pressure vacates — ``capacity_ok``), TTFT p50/p99 for both, the
 paged engine's table-hit accounting, and the in-bench greedy
-``parity_ok``.
+``parity_ok``.  The same invocation ALSO emits one
+``serve_paged_kernel`` row per workload: decode tokens/sec through the
+dense engine, the gather-based paged engine
+(``Engine(paged_attn='gather')`` — PR 13's gather→dense→scatter
+baseline), and the gather-free default (K/V read through the block
+table inside the attention contraction, single-token page commits) at
+the SAME pool bytes, gated on ``gather_free_ok`` — gather-free
+tokens/sec >= gather-paged AND all three engines' greedy outputs
+bit-identical (``parity_ok``).  ``SERVE_PAGED_KERNEL_TPS=1``
+additionally times the Pallas-kernel engine
+(``Engine(paged_attn='kernel')``; off by default — interpret mode on a
+CPU host is not a meaningful number, and the gate never depends on
+it).
 
 With ``--soak SEED1,SEED2`` (or SERVE_SOAK) the bench instead runs the
 fault-injection SOAK harness (one ``serve_soak`` row per seed): a
@@ -151,6 +163,7 @@ SPEC_METRIC = "serve_spec_tokens_per_sec"
 SOAK_METRIC = "serve_soak"
 PREFIX_METRIC = "serve_prefix"
 PAGED_METRIC = "serve_paged"
+PAGED_KERNEL_METRIC = "serve_paged_kernel"
 TENANCY_METRIC = "serve_tenancy"
 FUSED_METRIC = "serve_fused"
 
@@ -1314,6 +1327,135 @@ def main() -> None:
         })
         bank_metrics("serve_paged", workload, paged.metrics())
 
+    def run_paged_kernel(workload: str) -> None:
+        """One gather-free-vs-gather throughput row
+        (``serve_paged_kernel``): decode tokens/sec through THREE
+        engines over the identical shared-prefix burst at the same KV
+        byte budget — dense (no paging), gather-paged
+        (``paged_attn='gather'``: PR 13's per-step full-view
+        gather→dense-math→scatter), and the gather-free default
+        (attention reads K/V through the block table inside the
+        contraction, each committed token writes one row of one page).
+        Gate ``gather_free_ok`` = gather-free tokens/sec >=
+        gather-paged AND ``parity_ok`` (all three engines' greedy
+        outputs bit-identical — the perf rework moved bytes, never
+        values).  ``SERVE_PAGED_KERNEL_TPS=1`` adds the Pallas-kernel
+        engine's tokens/sec as an extra column (opt-in: interpret mode
+        on a CPU host measures the interpreter, not the kernel; the
+        gate never reads it)."""
+        prng = np.random.default_rng(seed + 6)
+        shared = prng.integers(0, cfg.vocab_size,
+                               size=prefix_len).astype(np.int32)
+        # The gather-free advantage is PROPORTIONAL to live context (it
+        # removes the stream-every-live-page tax), so this row wants
+        # enough co-resident depth to measure it; the override lets the
+        # tier-1 smoke run the capacity row small and this row at
+        # measurement scale.
+        slots = int(os.environ.get("SERVE_PAGED_KERNEL_SLOTS",
+                                   prefix_conc))
+        kv_pages = slots * (cfg.max_seq_len // chunk)  # = one dense arena
+        reqs = [np.concatenate([shared, prng.integers(
+            0, cfg.vocab_size, size=prefix_tail).astype(np.int32)])
+            for _ in range(2 * slots + 1)]
+
+        # Best-of-N per engine, with the engines' reps INTERLEAVED
+        # (rep 0 of all three, then rep 1 of all three, ...): the smoke
+        # host has documented double-digit scheduler variance, and
+        # back-to-back per-engine blocks would let one load spike sink
+        # every rep of whichever engine it landed on — interleaving
+        # gives each engine a shot at each quiet window, and best-of-N
+        # then measures the engines, not the noise (same rationale as
+        # the obs-check row's best-of-N).  The first rep is a DISCARDED
+        # warmup (allocator/frequency ramp lands on it, not on either
+        # engine's best).  Outputs are asserted identical across reps —
+        # reruns through a warm tree are the same math.
+        reps = max(1, int(os.environ.get("SERVE_PAGED_REPS", "4")))
+
+        def warm_up(e):
+            warm = e.submit(reqs[0], max_new, seed=seed)
+            e.run_until_complete()  # compiles + publishes off the clock
+            return warm
+
+        def measure_once(e):
+            t0 = time.perf_counter()
+            handles = [e.submit(p, max_new, seed=seed + 1 + i)
+                       for i, p in enumerate(reqs[1:])]
+            e.run_until_complete()
+            elapsed = time.perf_counter() - t0
+            tokens = sum(len(h.tokens) for h in handles)
+            tps = tokens / elapsed if elapsed > 0 else None
+            return [h.tokens for h in handles], tps
+
+        def engine(**kw):
+            return Engine(model, params, num_slots=slots,
+                          max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                          **kw)
+
+        engines = [engine(),                   # dense baseline
+                   engine(kv_pages=kv_pages, paged_attn="gather"),
+                   engine(kv_pages=kv_pages)]  # the gather-free default
+        warms = [warm_up(e) for e in engines]
+        best = [None] * len(engines)
+        outs = [None] * len(engines)
+        for rep in range(reps + 1):
+            for i, e in enumerate(engines):
+                rep_outs, tps = measure_once(e)
+                rep_outs = [warms[i].tokens] + rep_outs
+                assert outs[i] is None or outs[i] == rep_outs
+                outs[i] = rep_outs
+                if rep == 0:
+                    continue  # warmup rep: run, verify outputs, discard
+                if tps is not None and (best[i] is None or tps > best[i]):
+                    best[i] = tps
+        (dense_out, gather_out, free_out) = outs
+        (tps_dense, tps_gather, tps_free) = best
+        free_eng = engines[2]
+        free_eng.check_paged()
+        tps_kernel = None
+        if os.environ.get("SERVE_PAGED_KERNEL_TPS") == "1":
+            k_eng = engine(kv_pages=kv_pages, paged_attn="kernel")
+            warm_up(k_eng)  # compile off the clock, like the others
+            for rep in range(reps + 1):
+                _, tps = measure_once(k_eng)
+                if (rep and tps is not None
+                        and (tps_kernel is None or tps > tps_kernel)):
+                    tps_kernel = tps
+        parity_ok = dense_out == gather_out == free_out
+        gather_free_ok = (parity_ok and tps_free is not None
+                          and tps_gather is not None
+                          and tps_free >= tps_gather)
+        emit({
+            "metric": PAGED_KERNEL_METRIC,
+            "workload": workload,
+            "value": (round(tps_free / tps_gather, 3)
+                      if tps_free and tps_gather else None),
+            "unit": "gather_free_tokens_per_sec_vs_gather_paged",
+            "gather_free_ok": gather_free_ok,
+            "parity_ok": parity_ok,
+            "tokens_per_sec_dense": (round(tps_dense, 1)
+                                     if tps_dense else None),
+            "tokens_per_sec_gather": (round(tps_gather, 1)
+                                      if tps_gather else None),
+            "tokens_per_sec_gather_free": (round(tps_free, 1)
+                                           if tps_free else None),
+            "tokens_per_sec_kernel": (round(tps_kernel, 1)
+                                      if tps_kernel else None),
+            "kv_pages": kv_pages,
+            "pool_bytes": kv_pages * free_eng.page_pool.page_bytes(),
+            "prefix_hit_tokens": int(
+                free_eng.stats["prefix_hit_tokens"]),
+            "num_slots": slots,
+            "requests": len(reqs),
+            "prefix_len": prefix_len,
+            "max_new_tokens": max_new,
+            "prefill_chunk": chunk,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        })
+        bank_metrics("serve_paged_kernel", workload, free_eng.metrics())
+
     # One level crashing (OOM, transient backend fault) must not cost
     # the remaining rows — same isolation contract as matrix_bench.
     if tenancy_seeds:
@@ -1352,6 +1494,11 @@ def main() -> None:
                 run_paged(w)
             except Exception as exc:  # noqa: BLE001
                 emit({"metric": PAGED_METRIC, "workload": w,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+            try:
+                run_paged_kernel(w)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": PAGED_KERNEL_METRIC, "workload": w,
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
         write_sidecar()
         print(json.dumps({"serve_paged": results}))

@@ -267,14 +267,24 @@ def test_serve_paged_bench_rows_parse():
     >= 1.5x the dense copy engine's co-resident contexts at the same
     KV byte budget (capacity_ok, zero page-pressure vacates), with
     real table-indirected cache traffic and bit-exact parity."""
+    # The geometry is larger than the other serve smokes on purpose:
+    # the serve_paged_kernel row's gather-free >= gather gate measures
+    # a CONTEXT-proportional saving (the gather streamed every live
+    # page per step), so the smoke needs enough layers x width x depth
+    # for the margin to clear the smoke host's timing noise — at
+    # L4/d128 with ~160-token contexts the gather-free engine measures
+    # a stable ~1.03-1.11x over the gather baseline (best-of-reps,
+    # interleaved, warmup rep discarded); at the tiny L1/d64 geometry
+    # the two are within noise of each other and the gate would be a
+    # coin flip.
     proc = _run("benchmarks/serve_bench.py", {
         "SERVE_PLATFORM": "cpu",
         "SERVE_PAGED": "shared_prefix",
-        "SERVE_LAYERS": "1", "SERVE_DMODEL": "64", "SERVE_VOCAB": "128",
-        "SERVE_REQUESTS": "8", "SERVE_MAX_NEW": "8", "SERVE_CHUNK": "8",
-        "SERVE_PREFIX_LEN": "24", "SERVE_PREFIX_TURNS": "2",
+        "SERVE_LAYERS": "4", "SERVE_DMODEL": "128", "SERVE_VOCAB": "128",
+        "SERVE_REQUESTS": "8", "SERVE_MAX_NEW": "48", "SERVE_CHUNK": "16",
+        "SERVE_PREFIX_LEN": "48", "SERVE_PREFIX_TURNS": "2",
         "SERVE_PREFIX_USERS": "2", "SERVE_PREFIX_CONCURRENCY": "2",
-        "SERVE_PREFIX_BLOCKS": "16",
+        "SERVE_PREFIX_BLOCKS": "16", "SERVE_PAGED_KERNEL_SLOTS": "4",
     })
     rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
             if l.startswith("{")]
@@ -291,6 +301,22 @@ def test_serve_paged_bench_rows_parse():
     assert r["parity_ok"] is True           # bit-exact vs the copy engine
     assert r["ttft_p50_ms"] > 0 and r["ttft_p50_copy_ms"] > 0
     assert r["pool_bytes"] > 0 and r["kv_pages"] > 0
+    # ... and the SAME invocation emits the gather-free-vs-gather
+    # throughput row (serve_paged_kernel), passing its CPU-smoke gate:
+    # gather-free decode at least as fast as the PR 13 gather baseline
+    # with all three engines bit-identical.
+    byk = {r["workload"]: r for r in rows
+           if r.get("metric") == "serve_paged_kernel"
+           and "workload" in r}
+    assert set(byk) == {"shared_prefix"}, proc.stderr[-800:]
+    k = byk["shared_prefix"]
+    assert "error" not in k, k
+    assert k["gather_free_ok"] is True
+    assert k["parity_ok"] is True
+    assert k["value"] >= 1.0               # gather-free >= gather-paged
+    assert k["tokens_per_sec_gather_free"] >= k["tokens_per_sec_gather"]
+    assert k["tokens_per_sec_dense"] > 0
+    assert k["tokens_per_sec_kernel"] is None  # opt-in column, off here
     # unregistered workload names fail fast, like the prefix stage
     bad = _run("benchmarks/serve_bench.py", {
         "SERVE_PLATFORM": "cpu", "SERVE_PAGED": "shared_prefx"},
@@ -337,6 +363,45 @@ def test_serve_paged_gap_gate(tmp_path):
              "parity_ok": True,
              "device_kind": "TPU v5 lite"}) + "\n")
     assert serve_paged_missing(d) == []  # banked history row counts
+
+
+def test_serve_paged_kernel_gap_gate(tmp_path):
+    """tools/bench_gaps serve_paged_kernel stage: CPU smoke rows,
+    error rows, and gate-failing rows never close the workload; a TPU
+    row with gather_free_ok does.  serve_paged rows in the same file
+    never leak into this stage (and vice versa — two metrics, one
+    file, one SERVE_PAGED resume list)."""
+    from tools.bench_gaps import (SERVE_PAGED_WORKLOADS,
+                                  serve_paged_kernel_missing,
+                                  serve_paged_missing)
+
+    d = str(tmp_path)
+    assert serve_paged_kernel_missing(d) == list(SERVE_PAGED_WORKLOADS)
+    rows = [
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "value": 1.1, "gather_free_ok": True, "parity_ok": True,
+         "device_kind": "cpu"},                        # smoke: no
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "error": "relay wedged"},                     # error: no
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "value": 0.8, "gather_free_ok": False, "parity_ok": True,
+         "device_kind": "TPU v5 lite"},                # slower: no
+        # a passing capacity row must NOT close the kernel stage
+        {"metric": "serve_paged", "workload": "shared_prefix",
+         "value": 2.0, "capacity_ok": True, "prefix_hit_tokens": 320,
+         "parity_ok": True, "device_kind": "TPU v5 lite"},
+    ]
+    with open(os.path.join(d, "serve_paged.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_paged_kernel_missing(d) == ["shared_prefix"]
+    assert serve_paged_missing(d) == []  # the capacity row still counts
+    with open(os.path.join(d, "serve_paged.history.jsonl"), "w") as f:
+        f.write(json.dumps(
+            {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+             "value": 1.2, "gather_free_ok": True, "parity_ok": True,
+             "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_paged_kernel_missing(d) == []  # banked history counts
 
 
 def test_serve_fused_bench_rows_parse():

@@ -1,0 +1,341 @@
+"""Gather-free paged attention: the backends behind the one op.
+
+Four contracts on top of test_paged.py's traffic matrix (which now runs
+entirely through the gather-free einsum default):
+
+  1. BACKEND EQUIVALENCE — the gather-free einsum engine is bit-
+     identical to the kept ``paged_attn='gather'`` baseline (PR 13's
+     gather→dense→scatter path) for greedy and sampled traffic: the
+     perf rework changed WHERE bytes move, never a value.
+  2. SINGLE-PAGE COMMITTED WRITE — a single-token decode step writes
+     exactly ONE token row of exactly ONE real page
+     (``write_token_pages``), never a page unroll, never the view
+     scatter; inactive/unmapped writes route to the scratch page.
+  3. KERNEL ORACLE — the Pallas paged-decode kernel (interpret mode on
+     the CPU host) matches the gather-based oracle within fp tolerance
+     across FRAGMENTED tables: shared prefix pages mapped by several
+     slots, a copy-on-write divergence page, unmapped ``-1`` tail
+     entries clamping to scratch — and dequantizes int8 pages
+     in-kernel within the quantization bound.
+  4. LEDGER DELTA — the committed trace-lock budgets sit STRICTLY below
+     the PR 13 gather-based peak-live values (the committed proof the
+     gather is gone), pinned against the historical numbers.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudp.models.generate import (_quantize_kv, generate,
+                                   write_token_pages)
+from tpudp.models.gpt2 import gpt2_small
+from tpudp.ops.paged_attention import paged_attention
+from tpudp.serve import TRACE_COUNTS, Engine
+from tpudp.train import init_state, make_optimizer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(vocab_size=61, max_seq_len=96, num_layers=2, num_heads=2,
+            d_model=32)
+
+#: PR 13's committed gather-based peak_live_bytes at the audit smoke
+#: geometry (s2m32p6) — the baseline the gather-free rework must beat.
+PR13_GATHER_PEAK_LIVE = {
+    "serve.decode_paged": 205_446,
+    "serve.verify_paged": 209_550,
+    "serve.prefill_paged": 184_888,
+    "serve.fused_decode_paged": 205_510,
+    "serve.fused_decode_paged_stream": 205_510,
+}
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = gpt2_small(**TINY)
+    state = init_state(model, make_optimizer(), input_shape=(1, 8))
+    return model, state.params
+
+
+def _reference(model, params, prompt, n):
+    return np.asarray(generate(model, params, jnp.asarray(prompt[None]),
+                               n))[0, prompt.size:]
+
+
+# ---------------------------------------------------------------------------
+# 1. gather vs gather-free backend equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_gather_and_einsum_engines_bit_identical(model_and_params):
+    """The gather-free default ≡ the kept gather baseline ≡ generate()
+    for greedy AND seeded-sampled traffic with a warm (table-write hit)
+    admission in the mix — the rework moved bytes, not values."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, 61, size=16).astype(np.int32)
+    prompts = [np.concatenate([shared, rng.integers(0, 61, size=3 + i)
+                               .astype(np.int32)]) for i in range(3)]
+
+    def run(paged_attn):
+        eng = Engine(model, params, num_slots=2, max_len=48,
+                     prefill_chunk=8, kv_pages=12, paged_attn=paged_attn)
+        greedy = [eng.submit(p, 5) for p in prompts]
+        eng.run_until_complete()
+        sampled = eng.submit(prompts[0], 6, temperature=0.9, top_k=12,
+                             seed=7)
+        eng.run_until_complete()
+        return [h.tokens for h in greedy] + [sampled.tokens]
+
+    free = run("einsum")
+    assert run("gather") == free
+    for p, toks in zip(prompts, free[:3]):
+        np.testing.assert_array_equal(_reference(model, params, p, 5),
+                                      np.asarray(toks))
+
+
+def test_paged_attn_validation(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="paged_attn"):
+        Engine(model, params, kv_pages=12, paged_attn="flash")
+    with pytest.raises(ValueError, match="requires kv_pages"):
+        Engine(model, params, paged_attn="gather")
+    with pytest.raises(ValueError, match="single-step decode"):
+        Engine(model, params, kv_pages=12, paged_attn="kernel",
+               decode_fuse=4)
+    with pytest.raises(ValueError, match="single-step decode"):
+        Engine(model, params, kv_pages=12, paged_attn="kernel",
+               speculate_k=2)
+
+
+# ---------------------------------------------------------------------------
+# 2. the single-page committed write
+# ---------------------------------------------------------------------------
+
+
+def test_write_token_pages_touches_one_token_row_only():
+    """Unit pin of the write path: one committed token writes exactly
+    one token row of exactly the page containing ``pos`` — every other
+    byte of the pool (other pages AND the rest of that page) is
+    untouched.  The old ``scatter_pages`` unroll rewrote the whole
+    page from the gathered view; sentinel values prove the gather-free
+    write never even reads those rows."""
+    T, kv, dh = 8, 2, 4
+    pages = (jnp.full((5, T, kv, dh), 7.0, jnp.float32),
+             jnp.full((5, T, kv, dh), 7.0, jnp.float32))
+    table = jnp.asarray([[2, 3, -1]], jnp.int32)
+    k_new = jnp.ones((1, 1, kv, dh), jnp.float32) * 1.5
+    v_new = jnp.ones((1, 1, kv, dh), jnp.float32) * 2.5
+    # pos 13 -> page index 1 (table: page id 3), offset 5
+    out_k, out_v = write_token_pages(
+        pages, k_new, v_new, table, jnp.asarray([13], jnp.int32),
+        jnp.ones((1,), bool))
+    ok, ov = np.asarray(out_k), np.asarray(out_v)
+    np.testing.assert_array_equal(ok[3, 5], 1.5 * np.ones((kv, dh)))
+    np.testing.assert_array_equal(ov[3, 5], 2.5 * np.ones((kv, dh)))
+    untouched_k = ok.copy()
+    untouched_k[3, 5] = 7.0
+    np.testing.assert_array_equal(untouched_k, 7.0 * np.ones_like(ok))
+    # inactive rows and unmapped pages route to the trailing scratch
+    sk, _ = write_token_pages(pages, k_new, v_new, table,
+                              jnp.asarray([13], jnp.int32),
+                              jnp.zeros((1,), bool))
+    sk = np.asarray(sk)
+    assert (sk[:4] == 7.0).all() and (sk[4, 5] == 1.5).all()
+    uk, _ = write_token_pages(pages, k_new, v_new, table,
+                              jnp.asarray([18], jnp.int32),  # page 2: -1
+                              jnp.ones((1,), bool))
+    uk = np.asarray(uk)
+    assert (uk[:4] == 7.0).all() and (uk[4, 2] == 1.5).all()
+
+
+def test_engine_decode_step_writes_exactly_one_page(model_and_params):
+    """Engine-level pin of the same contract: across one pure-decode
+    step, the only real pages whose bytes changed are the pages
+    containing each active slot's committed position — one per slot —
+    and within each only the one token row at ``pos % page_tokens``."""
+    model, params = model_and_params
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 61, size=9 + 2 * i).astype(np.int32)
+               for i in range(2)]
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+                 kv_pages=12)
+    handles = [eng.submit(p, 6) for p in prompts]
+    while not all(h.tokens for h in handles):  # prefills + first tokens
+        eng.step()
+    ms = eng._mstates[None]
+    lens = eng._len.copy()
+    before = np.asarray(ms.pool.pages.k).copy()
+    eng.step()  # one pure decode step (queue empty, nothing prefilling)
+    assert eng.stats["decode_steps"] >= 1
+    after = np.asarray(ms.pool.pages.k)
+    n_pages = ms.pool.num_pages
+    changed = {p for p in range(n_pages + 1)
+               if not np.array_equal(before[:, p], after[:, p])}
+    expected = {int(ms.table[s, lens[s] // 8])
+                for s in range(2) if lens[s] > 0}
+    assert changed - {n_pages} == expected, (changed, expected)
+    for s in range(2):
+        if lens[s] == 0:
+            continue
+        page, off = int(ms.table[s, lens[s] // 8]), int(lens[s] % 8)
+        rows = {t for t in range(8)
+                if not np.array_equal(before[:, page, t],
+                                      after[:, page, t])}
+        assert rows == {off}, (s, rows, off)
+    eng.run_until_complete()
+    for p, h in zip(prompts, handles):
+        np.testing.assert_array_equal(_reference(model, params, p, 6),
+                                      np.asarray(h.tokens))
+
+
+# ---------------------------------------------------------------------------
+# 3. the Pallas kernel vs the gather-based oracle
+# ---------------------------------------------------------------------------
+
+
+def _fragmented_fixture(kv_dtype=None, seed=2):
+    """A pool + tables shaped like real COW traffic: slots 0 and 1 MAP
+    THE SAME prefix pages (shared system prompt), diverge into private
+    pages, and leave ``-1`` tail entries (clamping to scratch); slot 2
+    is shallower.  Returns (pages tuple, table, pos, q, cfg-ish dims)."""
+    rng = np.random.default_rng(seed)
+    S, M, T, H, KV, DH = 3, 4, 8, 4, 2, 16
+    P = 8
+    kf = jnp.asarray(rng.standard_normal((P + 1, T, KV, DH)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((P + 1, T, KV, DH)), jnp.float32)
+    if kv_dtype == "int8":
+        k8, ks = _quantize_kv(kf)
+        v8, vs = _quantize_kv(vf)
+        pages = (k8, v8, ks, vs)
+    else:
+        pages = (kf, vf)
+    table = jnp.asarray(np.array([
+        [0, 1, 2, -1],   # shared pages 0,1 + private divergence page 2
+        [0, 1, 3, 4],    # same prefix, different COW page, one deeper
+        [5, -1, -1, -1],  # shallow slot
+    ], np.int32))
+    pos = jnp.asarray([17, 26, 4], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((S, 1, H, DH)), jnp.float32)
+    return pages, table, pos, q, (S, M, T, H, KV, DH, P)
+
+
+def _gather_oracle(pages, table, pos, q, dims):
+    """gather_pages' math (one layer) + the dense grouped einsums —
+    PR 13's exact gather→dense path, spelled as the oracle."""
+    import jax
+
+    S, M, T, H, KV, DH, P = dims
+    # exactly gather_pages' per-layer semantics: -1 clamps to scratch,
+    # int8 dequantizes after the gather
+    tbl = jnp.where(table >= 0, table, P)
+
+    def grab(i):
+        g = pages[i][tbl]  # (S, M, T, KV, DH)
+        if len(pages) == 4:
+            g = (g.astype(jnp.float32)
+                 * pages[i + 2][tbl][..., None]).astype(jnp.float32)
+        return g.reshape(S, M * T, KV, DH)
+
+    kc, vc = grab(0), grab(1)  # (S, M*T, KV, DH)
+    G = H // KV
+    qg = q.reshape(S, 1, KV, G, DH)
+    scale = DH ** -0.5
+
+    def _attend(qj, pj):
+        lg = jnp.einsum("bkgd,bmkd->bkgm", qj, kc) * scale
+        vis = jnp.arange(M * T)[None, None, None, :] \
+            <= pj[:, None, None, None]
+        lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
+        pr = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bkgm,bmkd->bkgd", pr, vc)
+
+    q_pos = pos[:, None] + jnp.arange(1)
+    out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(qg, q_pos)
+    return out.reshape(S, 1, H, DH)
+
+
+def test_kernel_matches_gather_oracle_on_fragmented_tables():
+    """Interpret-mode Pallas kernel vs the gather-based oracle across a
+    fragmented table set (shared prefix pages, COW divergence pages,
+    -1 scratch tails): online softmax vs the XLA chain agree within fp
+    tolerance, and the exact einsum backend agrees BITWISE."""
+    pages, table, pos, q, dims = _fragmented_fixture()
+    oracle = np.asarray(_gather_oracle(pages, table, pos, q, dims))
+    einsum = np.asarray(paged_attention(
+        q, pages, table, pos, dtype=jnp.float32, grouped=True))
+    np.testing.assert_array_equal(oracle, einsum)  # bit-exact backend
+    kernel = np.asarray(paged_attention(
+        q, pages, table, pos, dtype=jnp.float32, grouped=True,
+        impl="kernel", interpret=True))
+    np.testing.assert_allclose(oracle, kernel, rtol=2e-6, atol=2e-6)
+
+
+def test_kernel_int8_in_kernel_dequant_tolerance():
+    """int8 pages dequantize IN-KERNEL to the same values the einsum
+    path dequantizes on gather: kernel ≈ int8 einsum within fp
+    tolerance, and both track the fp oracle within the quantization
+    bound."""
+    pages8, table, pos, q, dims = _fragmented_fixture(kv_dtype="int8")
+    pages_fp, *_ = _fragmented_fixture()
+    fp_oracle = np.asarray(_gather_oracle(pages_fp, table, pos, q, dims))
+    einsum8 = np.asarray(paged_attention(
+        q, pages8, table, pos, dtype=jnp.float32, grouped=True))
+    kernel8 = np.asarray(paged_attention(
+        q, pages8, table, pos, dtype=jnp.float32, grouped=True,
+        impl="kernel", interpret=True))
+    np.testing.assert_allclose(einsum8, kernel8, rtol=2e-6, atol=2e-6)
+    # quantization-level agreement with the fp math (loose by design)
+    np.testing.assert_allclose(fp_oracle, kernel8, atol=0.05)
+    assert np.max(np.abs(fp_oracle - kernel8)) > 0  # really quantized
+
+
+def test_kernel_engine_decode_end_to_end(model_and_params):
+    """Engine(paged_attn='kernel'): the single-token decode program
+    dispatches the Pallas kernel (its OWN trace-count key — the pinned
+    ``decode_paged_kernel`` program), prefill stays on the exact
+    einsum path, and greedy outputs match generate() on this geometry
+    (the tiny model's argmax gaps dwarf the kernel's fp tolerance;
+    the contract is tolerance-bounded, not bit-exact — exactly
+    flash's)."""
+    model, params = model_and_params
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 61, size=9 + 3 * i).astype(np.int32)
+               for i in range(2)]
+    before_kernel = TRACE_COUNTS["decode_paged_kernel"]
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+                 kv_pages=12, paged_attn="kernel")
+    handles = [eng.submit(p, 5) for p in prompts]
+    eng.run_until_complete()
+    assert TRACE_COUNTS["decode_paged_kernel"] > before_kernel
+    for p, h in zip(prompts, handles):
+        np.testing.assert_array_equal(_reference(model, params, p, 5),
+                                      np.asarray(h.tokens))
+    eng.check_paged()
+
+
+# ---------------------------------------------------------------------------
+# 4. the committed ledger delta: the proof the gather is gone
+# ---------------------------------------------------------------------------
+
+
+def test_budget_ledger_strictly_below_pr13_gather_values():
+    """The committed trace-lock budgets must sit STRICTLY below the
+    PR 13 gather-based peak-live values for every paged program — the
+    committed, reviewable proof that the per-step dense-view
+    gather/scatter no longer exists in the traced hot paths."""
+    with open(os.path.join(ROOT, "tools", "trace_lock.json")) as f:
+        progs = json.load(f)["programs"]
+    for prefix, pr13_peak in PR13_GATHER_PEAK_LIVE.items():
+        names = [n for n in progs if n.startswith(prefix + "@")]
+        assert names, f"{prefix} missing from the lock"
+        now = progs[names[0]]["budget"]["peak_live_bytes"]
+        assert 0 < now < pr13_peak, (
+            f"{prefix}: peak_live_bytes {now} not strictly below the "
+            f"PR 13 gather-based {pr13_peak}")
+    # the kernel twin is pinned with a ledger of its own
+    names = [n for n in progs
+             if n.startswith("serve.decode_paged_kernel@")]
+    assert names and progs[names[0]]["budget"]["peak_live_bytes"] > 0

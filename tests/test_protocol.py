@@ -329,6 +329,9 @@ def test_program_donations_mirror_rules_tables():
         "serve.fused_decode": "fused_step",
         "serve.fused_decode_stream": "fused_step",
         "serve.decode_paged": "decode_paged",
+        # the Pallas kernel twin dispatches through the same
+        # _ModelState.decode_paged attribute (same signature/donations)
+        "serve.decode_paged_kernel": "decode_paged",
         "serve.verify_paged": "verify_paged",
         "serve.prefill_paged": "prefill_paged",
         "serve.fused_decode_paged": "fused_paged",

@@ -300,6 +300,26 @@ def serve_paged_missing(d: str) -> list[str]:
     return [w for w in SERVE_PAGED_WORKLOADS if w not in done]
 
 
+def serve_paged_kernel_missing(d: str) -> list[str]:
+    """Gather-free-vs-gather throughput rows still owed (the
+    ``serve_paged_kernel`` rows the same ``--paged`` invocation emits
+    alongside ``serve_paged``).  A row closes its workload only when it
+    measured a real speedup ratio (``value`` > 0), the gather-free
+    engine at least matched the gather baseline's tokens/sec with all
+    three engines bit-identical (``gather_free_ok``, which folds in
+    ``parity_ok``), and the measurement is from the TPU.  Same file,
+    same SERVE_PAGED resume contract — one rerun refills both rows."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_paged.jsonl")):
+        if (r.get("metric") == "serve_paged_kernel"
+                and r.get("workload") in SERVE_PAGED_WORKLOADS
+                and measured(r)
+                and r.get("gather_free_ok") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["workload"])
+    return [w for w in SERVE_PAGED_WORKLOADS if w not in done]
+
+
 def serve_fused_missing(d: str) -> list[int]:
     """Fused-decode window sizes still lacking a real TPU measurement.
     A row closes its N only when it measured something (tokens/sec >
@@ -602,7 +622,8 @@ def main() -> None:
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_fused",
                                      "serve_soak", "serve_prefix",
-                                     "serve_paged", "serve_tenancy",
+                                     "serve_paged", "serve_paged_kernel",
+                                     "serve_tenancy",
                                      "train_soak",
                                      "train_soak_multihost", "analysis",
                                      "obs"])
@@ -639,6 +660,8 @@ def main() -> None:
         print(",".join(serve_prefix_missing(args.dir)), end="")
     elif args.stage == "serve_paged":
         print(",".join(serve_paged_missing(args.dir)), end="")
+    elif args.stage == "serve_paged_kernel":
+        print(",".join(serve_paged_kernel_missing(args.dir)), end="")
     elif args.stage == "analysis":
         print(",".join(analysis_missing()), end="")
     elif args.stage == "obs":

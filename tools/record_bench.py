@@ -266,9 +266,16 @@ def main() -> None:
     # and bit-exact parity — the same criteria as
     # bench_gaps.serve_paged_missing, so recorder and gate can't
     # disagree.
-    paged = _dedupe(
-        (r for r in _rows(os.path.join(args.dir, "serve_paged.jsonl"))
-         if "workload" in r and "serve_paged" not in r), "workload")
+    paged_rows = [r for r in _rows(os.path.join(args.dir,
+                                                "serve_paged.jsonl"))
+                  if "workload" in r and "serve_paged" not in r]
+    # serve_paged.jsonl carries TWO metrics since the gather-free
+    # rework (capacity rows + the serve_paged_kernel throughput rows
+    # the same invocation emits) — split by metric before deduping, or
+    # the newest kernel row would shadow its workload's capacity row.
+    paged = _dedupe((r for r in paged_rows
+                     if r.get("metric") != "serve_paged_kernel"),
+                    "workload")
     for r in sorted(paged.values(), key=lambda r: str(r.get("workload"))):
         if (not measured(r) or r.get("capacity_ok") is not True
                 or r.get("parity_ok") is not True):
@@ -290,6 +297,34 @@ def main() -> None:
                   f"{r.get('ttft_p50_copy_ms')} ms copy-based, "
                   f"{r.get('prefix_hit_tokens')} hit tokens via table "
                   f"writes, parity intact | "
+                  f"`serve_bench.py --paged` | |")
+
+    # Gather-free throughput rows (serve_paged_kernel): pass/fail on
+    # the gather_free_ok gate — gather-free decode tokens/sec at least
+    # the gather baseline's, with all three engines bit-identical —
+    # the same criteria as bench_gaps.serve_paged_kernel_missing.
+    paged_k = _dedupe((r for r in paged_rows
+                       if r.get("metric") == "serve_paged_kernel"),
+                      "workload")
+    for r in sorted(paged_k.values(),
+                    key=lambda r: str(r.get("workload"))):
+        if not measured(r) or r.get("gather_free_ok") is not True:
+            why = r.get("error") or (
+                "parity broken" if r.get("parity_ok") is False
+                else "gather-free slower than the gather baseline"
+                if r.get("gather_free_ok") is False
+                else "no real measurement")
+            print(f"| serve_paged_kernel {r.get('workload')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --paged` | |")
+        else:
+            kern = r.get("tokens_per_sec_kernel")
+            kern_s = f", kernel {kern}" if kern else ""
+            print(f"| gather-free paged decode, {r['workload']} | "
+                  f"**{r['value']}x vs gather-paged** "
+                  f"({r.get('tokens_per_sec_gather_free')} vs "
+                  f"{r.get('tokens_per_sec_gather')} tok/s; dense "
+                  f"{r.get('tokens_per_sec_dense')}{kern_s}) at "
+                  f"{r.get('pool_bytes')} pool bytes, parity intact | "
                   f"`serve_bench.py --paged` | |")
 
     # Multi-tenant rows render pass/fail on the tenancy gates: the high

@@ -104,8 +104,13 @@ serve_prefix_ok() {
   [ -z "$out" ]
 }
 serve_paged_ok() {
-  local out; out=$(python tools/bench_gaps.py serve_paged) || return 1
-  [ -z "$out" ]
+  # One --paged invocation fills BOTH row kinds (capacity + the
+  # gather-free-vs-gather serve_paged_kernel throughput rows), so the
+  # stage is good only when neither gap list has entries.
+  local out kout
+  out=$(python tools/bench_gaps.py serve_paged) || return 1
+  kout=$(python tools/bench_gaps.py serve_paged_kernel) || return 1
+  [ -z "$out" ] && [ -z "$kout" ]
 }
 serve_tenancy_ok() {
   local out; out=$(python tools/bench_gaps.py serve_tenancy) || return 1
@@ -405,10 +410,19 @@ while true; do
       # shared-prefix workload; a row closes only with >= 1.5x
       # capacity, zero page-pressure vacates, real table-indirected
       # hits, and bit-exact parity — resumes at workload granularity
-      # via bench_gaps, like the serve_prefix stage.
+      # via bench_gaps, like the serve_prefix stage.  The same run
+      # emits the serve_paged_kernel rows (gather-free vs gather-paged
+      # vs dense decode tokens/sec at fixed pool bytes, gated
+      # gather_free_ok), so the resume list is the union of both gaps.
       bank bench_results/serve_paged.jsonl
       ensure_window
-      SERVE_PAGED="$(python tools/bench_gaps.py serve_paged)" \
+      SERVE_PAGED="$(python - <<'PYEOF'
+from tools.bench_gaps import serve_paged_kernel_missing, serve_paged_missing
+missing = dict.fromkeys(serve_paged_missing("bench_results"))
+missing.update(dict.fromkeys(serve_paged_kernel_missing("bench_results")))
+print(",".join(missing), end="")
+PYEOF
+)" \
         timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
         > bench_results/serve_paged.jsonl 2> bench_results/serve_paged.err
       log "serve_paged_bench rc=$? -> bench_results/serve_paged.jsonl"

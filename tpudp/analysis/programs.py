@@ -38,6 +38,7 @@ AUDIT_SOURCES = (
     "tpudp/models/llama.py",
     "tpudp/ops/sampling.py",
     "tpudp/ops/attention.py",
+    "tpudp/ops/paged_attention.py",
     "tpudp/ops/losses.py",
     "tpudp/train.py",
     "tpudp/parallel/sync.py",
@@ -57,6 +58,7 @@ TRACE_COUNTER_PROGRAMS = {
     "sample_row": "serve.sample_row",
     "fused_decode": "serve.fused_decode",
     "decode_paged": "serve.decode_paged",
+    "decode_paged_kernel": "serve.decode_paged_kernel",
     "verify_paged": "serve.verify_paged",
     "prefill_paged": "serve.prefill_paged",
     "fused_decode_paged": "serve.fused_decode_paged",
@@ -80,8 +82,10 @@ PROGRAM_DONATIONS = {
     "serve.fused_decode_stream": (0, 11),
     # Paged twins (Engine(kv_pages=N)): the shared page POOL donates in
     # place of the dense arena; the block table is host-authoritative
-    # and never donated.
+    # and never donated.  The kernel twin (Engine(paged_attn='kernel'))
+    # shares the einsum twin's signature and donation facts.
     "serve.decode_paged": (0, 9),
+    "serve.decode_paged_kernel": (0, 9),
     "serve.verify_paged": (0, 10),
     "serve.prefill_paged": (0,),
     "serve.fused_decode_paged": (0, 12),
@@ -199,11 +203,15 @@ def build_programs() -> dict:
         functools.partial(fused, n_steps=SERVE["fuse"], stream=True),
         fused_args)
     # Paged twins (Engine(kv_pages=N)): same math read through per-slot
-    # block tables into ONE shared page pool (+1 trailing scratch page).
-    # Pinning them locks the page-gather/scatter indirection — a new
-    # host transfer or callback inside the paged hot loop fails the
-    # audit by name — and gives the budget pass the paged programs'
-    # peak_live_bytes for the capacity ledger.
+    # block tables into ONE shared page pool (+1 trailing scratch page)
+    # — since the gather-free rework, THROUGH the table inside the
+    # attention contraction (tpudp.ops.paged_attention), with the new
+    # token's K/V committed straight into its page.  Pinning them locks
+    # the indirection — a new host transfer or callback inside the
+    # paged hot loop fails the audit by name — and gives the budget
+    # pass the paged programs' peak_live_bytes for the capacity ledger
+    # (tests pin the gather-free values strictly below the PR 13
+    # gather-based ones).
     n_pages = SERVE["pages"]
     pool = KVCache.zeros(cfg, n_pages + 1, SERVE["chunk"])
     table = np.zeros((SERVE["slots"], SERVE["max_len"] // SERVE["chunk"]),
@@ -234,6 +242,20 @@ def build_programs() -> dict:
     programs[f"serve.fused_decode_paged_stream@{pgeo2}n{SERVE['fuse']}"] = (
         functools.partial(fused_paged, n_steps=SERVE["fuse"], stream=True),
         fused_paged_args)
+    # The Pallas paged-decode kernel twin (Engine(paged_attn='kernel')):
+    # same signature/donations as serve.decode_paged, but the attention
+    # contraction is the online-softmax kernel with the table as scalar
+    # prefetch — pinned so a kernel-body change (or a new callback/
+    # transfer around it) is a named, reviewed event like every other
+    # hot-path trace.  The audit captures on forced CPU, so the kernel
+    # traces in interpret mode — host-independent like the rest of the
+    # lock.
+    decode_paged_kernel = _engine._build_steps(cfg, params,
+                                               paged_attn="kernel")[4]
+    programs[f"serve.decode_paged_kernel@{pgeo2}"] = (
+        decode_paged_kernel,
+        (pool, table, h["last"], h["lens"], h["active"], h["temps"],
+         h["topk"], h["topp"], h["keys"], h["counts"]))
 
     programs["serve.sample_row@v%d" % SERVE["vocab"]] = (
         _engine._sample_row,

@@ -170,18 +170,191 @@ def scatter_pages(pool, view: KVCache, table: jnp.ndarray,
     return pool
 
 
+def write_token_pages(pages, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                      table: jnp.ndarray, pos: jnp.ndarray,
+                      active: jnp.ndarray):
+    """Commit a ``cur``-token window's K/V directly into the pages
+    holding positions ``[pos, pos+cur)`` — the single-page committed
+    write that replaces :func:`scatter_pages`'s page-level unroll on
+    the gather-free paths: each (slot, window position) writes exactly
+    ONE token row of exactly the page containing that position
+    (``dynamic_update_slice``-style ``.at[page, off].set``), so a
+    decode step's write traffic is one token's worth of KV, not a
+    whole-page (let alone whole-view) rewrite.
+
+    ``pages`` is one LAYER's page buffers — ``(k, v)`` fp or
+    ``(k, v, k_scale, v_scale)`` int8 (new vectors quantize with the
+    same symmetric-absmax math as :func:`scatter_pages`; since that
+    quantization is idempotent on already-quantized vectors, the pool
+    bytes match the old whole-page rewrite exactly).  Writes of
+    inactive slots, and of positions past the table (never expected —
+    the engine preallocates), route to the trailing scratch page."""
+    T = pages[0].shape[1]
+    n_pages = table.shape[1]
+    scratch = pages[0].shape[0] - 1
+    b, cur = k_new.shape[0], k_new.shape[1]
+    pos = jnp.asarray(pos)
+    scalar_pos = not pos.ndim
+    if scalar_pos:
+        pos = jnp.broadcast_to(pos, (b,))
+    if scalar_pos and cur == T:
+        # The page-aligned prefill chunk (the ONLY scalar-pos caller;
+        # chunk starts are page multiples by the engine contract, which
+        # the alignment term below enforces by routing any violation to
+        # scratch): the window IS one whole page, so commit it with ONE
+        # page-row write per buffer instead of T chained single-token
+        # scatters — trace size and the dependent-write chain stay O(1)
+        # in chunk width (a production-sized chunk x deep model would
+        # otherwise mint tens of thousands of scatter eqns).
+        pidx = pos // T
+        safe = jnp.clip(pidx, 0, n_pages - 1)
+        page = jnp.take_along_axis(table, safe[:, None], axis=1)[:, 0]
+        valid = (active & (pidx < n_pages) & (page >= 0)
+                 & (pos % T == 0))
+        page = jnp.where(valid, page, scratch)
+        if len(pages) == 4:
+            qk, sk = _quantize_kv(k_new)
+            qv, sv = _quantize_kv(v_new)
+            return (pages[0].at[page].set(qk),
+                    pages[1].at[page].set(qv),
+                    pages[2].at[page].set(sk),
+                    pages[3].at[page].set(sv))
+        return (pages[0].at[page].set(k_new.astype(pages[0].dtype)),
+                pages[1].at[page].set(v_new.astype(pages[1].dtype)))
+    for j in range(cur):
+        p = pos + j
+        pidx = p // T
+        off = p % T
+        safe = jnp.clip(pidx, 0, n_pages - 1)
+        page = jnp.take_along_axis(table, safe[:, None], axis=1)[:, 0]
+        valid = active & (pidx < n_pages) & (page >= 0)
+        page = jnp.where(valid, page, scratch)
+        kj, vj = k_new[:, j], v_new[:, j]
+        if len(pages) == 4:
+            qk, sk = _quantize_kv(kj)
+            qv, sv = _quantize_kv(vj)
+            pages = (pages[0].at[page, off].set(qk),
+                     pages[1].at[page, off].set(qv),
+                     pages[2].at[page, off].set(sk),
+                     pages[3].at[page, off].set(sv))
+        else:
+            pages = (pages[0].at[page, off].set(kj.astype(pages[0].dtype)),
+                     pages[1].at[page, off].set(vj.astype(pages[1].dtype)))
+    return pages
+
+
+def _layer_pages(pool, i: int):
+    """One layer's page-buffer slice of the pool: ``(k, v)`` or the
+    int8 quadruple — the unit :class:`_PagedKV` reads/writes, so only
+    one layer's tiles are ever transient at a time."""
+    if isinstance(pool, Int8Pages):
+        return (pool.k[i], pool.v[i], pool.k_scale[i], pool.v_scale[i])
+    return (pool.k[i], pool.v[i])
+
+
+def _stack_pages(pool, layers: list):
+    """Reassemble the pool pytree from per-layer page buffers (the
+    paged mirror of ``_forward_cached``'s ``jnp.stack`` over layer
+    caches; the donated pool aliases in place under XLA)."""
+    if isinstance(pool, Int8Pages):
+        return Int8Pages(jnp.stack([p[0] for p in layers]),
+                         jnp.stack([p[1] for p in layers]),
+                         jnp.stack([p[2] for p in layers]),
+                         jnp.stack([p[3] for p in layers]))
+    return KVCache(jnp.stack([p[0] for p in layers]),
+                   jnp.stack([p[1] for p in layers]))
+
+
+class _PagedKV:
+    """One layer's gather-free paged KV store, threaded through the
+    family block twins (``_block_decode(..., paged=store)`` /
+    ``llama.block_decode``): ``write`` lands the window's new K/V as
+    single-token page writes (:func:`write_token_pages`), ``attend``
+    reads K/V THROUGH the block table inside the attention contraction
+    (``tpudp.ops.paged_attention`` — bit-exact blockwise einsums by
+    default, the Pallas decode kernel on the opt-in path).  The slot's
+    dense logical view is never materialized.  Trace-time mutable:
+    ``write`` rebinds ``pages``; the paged forward collects them per
+    layer."""
+
+    __slots__ = ("cfg", "pages", "table", "pos", "active", "grouped",
+                 "impl")
+
+    def __init__(self, cfg, pages, table, pos, active, *, grouped, impl):
+        self.cfg = cfg
+        self.pages = pages
+        self.table = table
+        self.pos = pos
+        self.active = active
+        self.grouped = grouped
+        self.impl = impl
+
+    def write(self, k: jnp.ndarray, v: jnp.ndarray) -> None:
+        self.pages = write_token_pages(self.pages, k, v, self.table,
+                                       self.pos, self.active)
+
+    def attend(self, q: jnp.ndarray) -> jnp.ndarray:
+        from tpudp.ops.paged_attention import paged_attention
+
+        return paged_attention(q, self.pages, self.table, self.pos,
+                               dtype=self.cfg.dtype, grouped=self.grouped,
+                               impl=self.impl)
+
+
 def _forward_paged(cfg, params: dict, tokens: jnp.ndarray, pool,
                    table: jnp.ndarray, pos: jnp.ndarray,
-                   active: jnp.ndarray):
+                   active: jnp.ndarray, impl: str = "einsum"):
     """Page-table-indirected twin of :func:`_forward_cached` for the
-    serve engine's paged arena: gather each slot's pages into the dense
-    logical view, run the EXACT per-row cached forward on it (identical
-    values -> bit-identical logits — the paged-parity contract), then
-    scatter only the written pages back.  Returns ``(logits, pool)``."""
-    view = gather_pages(cfg, pool, table)
-    logits, view = _forward_cached(cfg, params, tokens, view, pos)
-    return logits, scatter_pages(pool, view, table, pos,
-                                 tokens.shape[1], active)
+    serve engine's paged arena.  Returns ``(logits, pool)``.
+
+    ``impl='einsum'`` (the engine default) and ``'kernel'`` are
+    GATHER-FREE: each layer's block twin writes the window's new K/V
+    straight into the pages containing ``[pos, pos+cur)``
+    (:func:`write_token_pages` — one token row per position, never a
+    page unroll) and reads K/V through the table inside the attention
+    contraction (:class:`_PagedKV` → ``tpudp.ops.paged_attention``).
+    The einsum path's fp outputs are BITWISE identical to the dense
+    math on the gathered view (the paged-parity contract), while the
+    full ``(layers, slots, max_len, ...)`` view — and its whole-pool
+    scatter — no longer exist, which the committed budget ledger's
+    peak-live drop proves.  ``'kernel'`` additionally routes
+    single-token decode through the Pallas paged-decode kernel
+    (tolerance-bounded like flash).
+
+    ``impl='gather'`` is PR 13's original path — gather the dense view,
+    run the exact dense forward, scatter written pages back — kept as
+    the bench comparison baseline and the kernel tests' oracle."""
+    if impl == "gather":
+        view = gather_pages(cfg, pool, table)
+        logits, view = _forward_cached(cfg, params, tokens, view, pos)
+        spos = jnp.asarray(pos)
+        if not spos.ndim:
+            spos = jnp.broadcast_to(spos, (tokens.shape[0],))
+        return logits, scatter_pages(pool, view, table, spos,
+                                     tokens.shape[1], active)
+    from tpudp.models import llama as _llama
+
+    pos = jnp.asarray(pos)
+    is_llama = isinstance(cfg, _llama.LlamaConfig)
+    if is_llama:
+        x = _llama.embed_tokens(cfg, params, tokens)
+    else:
+        offsets = jnp.arange(tokens.shape[1])
+        positions = (pos[:, None] + offsets) if pos.ndim else pos + offsets
+        x = embed_tokens(cfg, params, tokens, positions)
+    layers = []
+    for i in range(cfg.num_layers):
+        store = _PagedKV(cfg, _layer_pages(pool, i), table, pos, active,
+                         grouped=is_llama, impl=impl)
+        if is_llama:
+            x, _, _ = _llama.block_decode(cfg, params[f"h_{i}"], x, None,
+                                          None, pos, paged=store)
+        else:
+            x, _, _ = _block_decode(cfg, params[f"h_{i}"], x, None, None,
+                                    pos, paged=store)
+        layers.append(store.pages)
+    head = _llama.lm_head if is_llama else lm_head
+    return head(cfg, params, x), _stack_pages(pool, layers)
 
 
 def _layer_norm(p: dict, x: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -213,9 +386,17 @@ def update_cache_rows(cache: jnp.ndarray, new: jnp.ndarray,
 
 def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
                   k_cache: jnp.ndarray, v_cache: jnp.ndarray,
-                  pos: jnp.ndarray):
+                  pos: jnp.ndarray, paged=None):
     """One pre-LN block on ``(batch, cur, d)`` new tokens at absolute
     positions ``pos .. pos+cur-1``, reading/writing the KV cache.
+
+    With ``paged`` (a :class:`_PagedKV` store — the serve engine's
+    gather-free paged mode) the KV write/read goes through the block
+    table instead of the dense cache: single-token page writes, then
+    attention THROUGH the table — bit-identical outputs to the dense
+    einsums below on the same stored values (the op's contract), with
+    everything outside the KV indirection shared line-for-line so the
+    two paths can never drift.
 
     ``pos`` is either a scalar shared by the whole batch (generate /
     beam_search, where every row is at the same depth) or a ``(batch,)``
@@ -236,7 +417,6 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     b, cur, d = x.shape
     h = cfg.num_heads
     dh = d // h
-    max_len = k_cache.shape[1]
 
     hN = _layer_norm(p["ln_1"], x, cfg.ln_eps)
     qkv = _dense(p["attn"]["qkv"], hN, cfg.dtype)
@@ -245,42 +425,52 @@ def _block_decode(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     k = k.reshape(b, cur, h, dh)
     v = v.reshape(b, cur, h, dh)
     pos = jnp.asarray(pos)
-    if pos.ndim:  # per-row slot positions (serve engine)
-        k_cache = update_cache_rows(k_cache, k, pos)
-        v_cache = update_cache_rows(v_cache, v, pos)
+    if paged is not None:
+        # Gather-free paged KV: write-before-attend order preserved
+        # (the dense branch's cache update precedes its read too).
+        paged.write(k, v)
+        out = paged.attend(q)
     else:
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        if pos.ndim:  # per-row slot positions (serve engine)
+            k_cache = update_cache_rows(k_cache, k, pos)
+            v_cache = update_cache_rows(v_cache, v, pos)
+        else:
+            k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
-    # Same op/dtype sequence as ops.attention.multihead_attention's dense
-    # path (einsum in cfg.dtype, fp32 softmax) — in bf16, rounding QK^T
-    # differently would break exact argmax parity with the training model.
-    scale = dh ** -0.5
-    if pos.ndim:
-        # Key j visible to new-token query i iff j <= pos + i, per row.
-        # One attention per window position (see docstring): each slice
-        # is exactly the 1-token step's contraction, so a k+1 verify
-        # window is bit-identical to k+1 single-token decodes.
-        q_pos = pos[:, None] + jnp.arange(cur)  # (b, cur)
+        # Same op/dtype sequence as ops.attention.multihead_attention's
+        # dense path (einsum in cfg.dtype, fp32 softmax) — in bf16,
+        # rounding QK^T differently would break exact argmax parity
+        # with the training model.
+        max_len = k_cache.shape[1]
+        scale = dh ** -0.5
+        if pos.ndim:
+            # Key j visible to new-token query i iff j <= pos + i, per
+            # row.  One attention per window position (see docstring):
+            # each slice is exactly the 1-token step's contraction, so
+            # a k+1 verify window is bit-identical to k+1 single-token
+            # decodes.
+            q_pos = pos[:, None] + jnp.arange(cur)  # (b, cur)
 
-        def _attend(qj, pj):  # qj (b, h, dh), pj (b,)
-            lg = jnp.einsum("bhd,bkhd->bhk", qj, k_cache) * scale
-            vis = jnp.arange(max_len)[None, None, :] <= pj[:, None, None]
-            lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
-            pr = jax.nn.softmax(lg.astype(jnp.float32),
-                                axis=-1).astype(cfg.dtype)
-            return jnp.einsum("bhk,bkhd->bhd", pr, v_cache)
+            def _attend(qj, pj):  # qj (b, h, dh), pj (b,)
+                lg = jnp.einsum("bhd,bkhd->bhk", qj, k_cache) * scale
+                vis = jnp.arange(max_len)[None, None, :] \
+                    <= pj[:, None, None]
+                lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
+                pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                    axis=-1).astype(cfg.dtype)
+                return jnp.einsum("bhk,bkhd->bhd", pr, v_cache)
 
-        out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(q, q_pos)
-    else:
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
-        q_pos = pos + jnp.arange(cur)[:, None]
-        visible = jnp.arange(max_len)[None, :] <= q_pos  # (cur, max_len)
-        logits = jnp.where(visible[None, None], logits,
-                           jnp.finfo(logits.dtype).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32),
-                               axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+            out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(q, q_pos)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale
+            q_pos = pos + jnp.arange(cur)[:, None]
+            visible = jnp.arange(max_len)[None, :] <= q_pos
+            logits = jnp.where(visible[None, None], logits,
+                               jnp.finfo(logits.dtype).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
     x = x + _dense(p["attn"]["proj"], out.reshape(b, cur, d), cfg.dtype)
 
     hN = _layer_norm(p["ln_2"], x, cfg.ln_eps)

@@ -197,7 +197,7 @@ def lm_head(cfg: LlamaConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
 
 def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
                  k_cache: jnp.ndarray, v_cache: jnp.ndarray,
-                 pos: jnp.ndarray):
+                 pos: jnp.ndarray, paged=None):
     """One LLaMA block on ``(batch, cur, d)`` new tokens at absolute
     positions ``pos .. pos+cur-1``, reading/writing a GQA-width KV cache
     ``(batch, max_len, kv_heads, head_dim)`` — the cache is ``kv_heads /
@@ -207,18 +207,21 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     the scalar path compiles to the program it always did.  Mirrors
     LlamaBlock exactly (the greedy-parity test referees).
 
-    The serve engine's PAGED mode (``Engine(kv_pages=N)``) reads KV
-    through per-slot block tables by gathering each slot's pool pages
-    into exactly this ``(batch, max_len, kv_heads, head_dim)`` view
-    (``generate.gather_pages`` — pages allocate at GQA width, so the
-    grouped-attention memory saving carries over to the pool) and
-    runs this same function on it: identical values in, bit-identical
-    attention out, which is what makes paged reads ≡ dense reads
+    The serve engine's PAGED mode (``Engine(kv_pages=N)``) runs this
+    same function with ``paged`` set (a ``generate._PagedKV`` store —
+    pages allocate at GQA width, so the grouped-attention memory
+    saving carries over to the pool): K/V write as single-token page
+    commits and attention reads THROUGH the block table inside the
+    contraction (``tpudp.ops.paged_attention``'s grouped einsum family
+    — the blockwise twins of the einsums below), never materializing
+    the ``(batch, max_len, kv_heads, head_dim)`` view.  Identical
+    stored values ⇒ bit-identical attention out, which is what keeps
+    paged reads ≡ dense reads
     (tests/test_paged.py::test_paged_llama_gqa_parity)."""
     b, cur, d = x.shape
     h, kv = cfg.num_heads, cfg.kv_heads
     dh = d // h
-    max_len = k_cache.shape[1]
+    max_len = k_cache.shape[1] if paged is None else None
     pos = jnp.asarray(pos)
     per_row = bool(pos.ndim)
     # (cur,) shared positions, or (b, cur) per-row — apply_rope and the
@@ -237,50 +240,59 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     v = _dense_nb(attn["wv"], hN, cfg.dtype).reshape(b, cur, kv, dh)
     from jax import lax
 
-    if per_row:
-        from tpudp.models.generate import update_cache_rows
-
-        k_cache = update_cache_rows(k_cache, k, pos)
-        v_cache = update_cache_rows(v_cache, v, pos)
+    if paged is not None:
+        # Gather-free paged KV (write-before-attend order preserved —
+        # the dense branch updates its cache before reading it too).
+        paged.write(k, v)
+        out = paged.attend(q)
     else:
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        if per_row:
+            from tpudp.models.generate import update_cache_rows
 
-    # Grouped attention over the KV-width cache: query head j attends KV
-    # head j // group (exactly the training path's jnp.repeat semantics —
-    # q's head axis reshaped (kv, group) keeps that mapping) WITHOUT
-    # materializing an MHA-width copy of the cache, so the GQA memory
-    # saving holds during attention too, not just in the cache buffer.
-    # Same op/dtype sequence as ops.attention's dense path (einsum in
-    # cfg.dtype, fp32 softmax) so bf16 rounding matches training exactly;
-    # the per-pair dot products are identical to the repeat formulation.
-    g = h // kv
-    qg = q.reshape(b, cur, kv, g, dh)
-    scale = dh ** -0.5
-    if per_row:
-        # One attention per window position (same rationale as the GPT-2
-        # twin): XLA's width-1 and width-W contractions reduce in
-        # different blockings, so only the vmapped per-position form
-        # keeps a speculative k+1-token verify window bit-identical to
-        # k+1 single-token decodes (tpudp.serve's exact-parity contract).
-        def _attend(qj, pj):  # qj (b, kv, g, dh), pj (b,)
-            lg = jnp.einsum("bkgd,bmkd->bkgm", qj, k_cache) * scale
-            vis = jnp.arange(max_len)[None, None, None, :] \
-                <= pj[:, None, None, None]
-            lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
-            pr = jax.nn.softmax(lg.astype(jnp.float32),
-                                axis=-1).astype(cfg.dtype)
-            return jnp.einsum("bkgm,bmkd->bkgd", pr, v_cache)
+            k_cache = update_cache_rows(k_cache, k, pos)
+            v_cache = update_cache_rows(v_cache, v, pos)
+        else:
+            k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
-        out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(qg, positions)
-    else:
-        logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg, k_cache) * scale
-        visible = jnp.arange(max_len) <= positions[..., None]
-        logits = jnp.where(visible[None, None, None], logits,
-                           jnp.finfo(logits.dtype).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32),
-                               axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
+        # Grouped attention over the KV-width cache: query head j
+        # attends KV head j // group (exactly the training path's
+        # jnp.repeat semantics — q's head axis reshaped (kv, group)
+        # keeps that mapping) WITHOUT materializing an MHA-width copy
+        # of the cache, so the GQA memory saving holds during attention
+        # too, not just in the cache buffer.  Same op/dtype sequence as
+        # ops.attention's dense path (einsum in cfg.dtype, fp32
+        # softmax) so bf16 rounding matches training exactly; the
+        # per-pair dot products are identical to the repeat formulation.
+        g = h // kv
+        qg = q.reshape(b, cur, kv, g, dh)
+        scale = dh ** -0.5
+        if per_row:
+            # One attention per window position (same rationale as the
+            # GPT-2 twin): XLA's width-1 and width-W contractions
+            # reduce in different blockings, so only the vmapped
+            # per-position form keeps a speculative k+1-token verify
+            # window bit-identical to k+1 single-token decodes
+            # (tpudp.serve's exact-parity contract).
+            def _attend(qj, pj):  # qj (b, kv, g, dh), pj (b,)
+                lg = jnp.einsum("bkgd,bmkd->bkgm", qj, k_cache) * scale
+                vis = jnp.arange(max_len)[None, None, None, :] \
+                    <= pj[:, None, None, None]
+                lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
+                pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                    axis=-1).astype(cfg.dtype)
+                return jnp.einsum("bkgm,bmkd->bkgd", pr, v_cache)
+
+            out = jax.vmap(_attend, in_axes=(1, 1),
+                           out_axes=1)(qg, positions)
+        else:
+            logits = jnp.einsum("bqkgd,bmkd->bkgqm", qg, k_cache) * scale
+            visible = jnp.arange(max_len) <= positions[..., None]
+            logits = jnp.where(visible[None, None, None], logits,
+                               jnp.finfo(logits.dtype).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache)
     x = x + _dense_nb(attn["wo"], out.reshape(b, cur, d), cfg.dtype)
 
     hN = _rms(p["rms_mlp"], x, cfg.rms_eps)

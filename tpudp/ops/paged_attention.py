@@ -1,0 +1,331 @@
+"""Gather-free paged attention — index KV pages inside the attention
+contraction, never materializing a slot's dense logical view.
+
+PR 13's paged engine bought its capacity win (one shared refcounted page
+pool, per-slot block tables, copy-on-write prefix reuse) by paying HBM
+bandwidth every step: each paged program ran ``gather_pages`` (table →
+full ``(layers, slots, max_len, kv_heads, dh)`` dense view), the exact
+dense math, then ``scatter_pages`` — so a decode step that adds ONE
+token's worth of state still streamed every live page through HBM
+twice and held the whole view live across the forward.  Decode on TPU
+is HBM-bandwidth-bound, not FLOP-bound (PAPERS.md arXiv:2204.06514), so
+that traffic was the paged engine's perf ceiling.  This module removes
+it: attention reads K/V **through the block table**, block-wise over
+``(pages, page_size)`` tiles, one layer at a time.
+
+Two backends behind one op:
+
+  * ``impl='einsum'`` (the engine default) — **bit-exact**: per-page
+    tiles ``pool[table]`` feed the contraction directly
+    (``...d,bptkd->...pt``) and the flattened ``(pages·page_size)``
+    logit axis gets exactly the dense path's visibility mask, fp32
+    softmax, and P·V einsum.  XLA canonicalizes the ``(p, t)``
+    contraction to the same gemm as the dense ``max_len`` axis, so fp
+    outputs are **bitwise identical** to the dense math — which is what
+    preserves the PR 13 parity oracle (paged ≡ dense ≡ ``generate()``)
+    while the dense view and its scatter are gone (the committed budget
+    ledger pins the peak-live drop).
+  * ``impl='kernel'`` — a Pallas paged-decode kernel: grid over
+    ``(slot, kv_pages)``, online-softmax carry (running max /
+    denominator / output accumulator) in VMEM scratch exactly like the
+    flash kernel, the block table and per-slot positions ride as
+    SCALAR PREFETCH so each grid step's page is DMA'd straight from the
+    pool by table value, ``-1`` (unmapped) entries skip their compute
+    via ``pl.when``, and int8 pages dequantize in-kernel.  Tolerance-
+    bounded like flash (online softmax rounds differently from the XLA
+    chain), so the engine treats it as an explicit opt-in
+    (``Engine(paged_attn='kernel')``).  Runs in interpret mode off-TPU
+    so the same code is unit-testable on the CPU host.
+
+The op covers both attention families the decode twins use: the GPT-2
+MHA einsum forms and LLaMA's grouped (GQA) forms — selected by
+``grouped`` so each family's paged math mirrors ITS dense twin
+op-for-op (the bitwise contract is per-family).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+_LANES = 128  # per-row online-softmax scratch, broadcast over one lane tile
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def page_tiles(pages, table, dtype):
+    """Per-slot ``(b, M, T, kv, dh)`` K/V tiles indexed by the block
+    table — the read half of the gather-free contract.  ``pages`` is
+    the per-layer page buffer pair ``(k, v)`` (fp) or quadruple
+    ``(k, v, k_scale, v_scale)`` (int8; dequantized here with exactly
+    ``generate.gather_pages``'s math, so int8 tile values match the
+    gather path's bit-for-bit).  Unmapped table entries (``-1``) clamp
+    to the trailing scratch page; its garbage only ever lands at
+    positions the visibility mask excludes — the same standing contract
+    as the dense arena's garbage-beyond-``pos`` rows."""
+    scratch = pages[0].shape[0] - 1
+    tbl = jnp.where(table >= 0, table, scratch)
+    if len(pages) == 4:
+        k8, v8, ks, vs = pages
+        k = (k8[tbl].astype(jnp.float32) * ks[tbl][..., None]).astype(dtype)
+        v = (v8[tbl].astype(jnp.float32) * vs[tbl][..., None]).astype(dtype)
+        return k, v
+    k, v = pages
+    return k[tbl].astype(dtype), v[tbl].astype(dtype)
+
+
+def _einsum_paged(q, pages, table, pos, *, dtype, grouped):
+    """The bit-exact blockwise path.  ``q``: ``(b, cur, h, dh)``;
+    ``pos``: ``(b,)`` per-row depths (window position ``j`` attends
+    keys ``<= pos + j`` — one contraction per position, the vmapped
+    form that keeps a k+1 verify window bitwise equal to k+1 single
+    steps) or a scalar (the prefill window: ONE batched contraction
+    over the whole window, mirroring the scalar-``pos`` dense path)."""
+    b, cur, h, dh = q.shape
+    kt, vt = page_tiles(pages, table, dtype)  # (b, M, T, kv, dh)
+    kv = kt.shape[3]
+    max_len = kt.shape[1] * kt.shape[2]
+    scale = dh ** -0.5
+    pos = jnp.asarray(pos)
+
+    if grouped:
+        g = h // kv
+        qg = q.reshape(b, cur, kv, g, dh)
+        if pos.ndim:
+            q_pos = pos[:, None] + jnp.arange(cur)  # (b, cur)
+
+            def _attend(qj, pj):  # qj (b, kv, g, dh), pj (b,)
+                lg = (jnp.einsum("bkgd,bptkd->bkgpt", qj, kt)
+                      * scale).reshape(b, kv, g, max_len)
+                vis = jnp.arange(max_len)[None, None, None, :] \
+                    <= pj[:, None, None, None]
+                lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
+                pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                    axis=-1).astype(dtype)
+                return jnp.einsum("bkgpt,bptkd->bkgd",
+                                  pr.reshape(b, kv, g, *kt.shape[1:3]), vt)
+
+            out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(qg, q_pos)
+        else:
+            lg = (jnp.einsum("bqkgd,bptkd->bkgqpt", qg, kt)
+                  * scale).reshape(b, kv, g, cur, max_len)
+            q_pos = pos + jnp.arange(cur)[:, None]
+            visible = jnp.arange(max_len)[None, :] <= q_pos
+            lg = jnp.where(visible[None, None, None], lg,
+                           jnp.finfo(lg.dtype).min)
+            pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                axis=-1).astype(dtype)
+            out = jnp.einsum("bkgqpt,bptkd->bqkgd",
+                             pr.reshape(b, kv, g, cur, *kt.shape[1:3]), vt)
+        return out.reshape(b, cur, h, dh)
+
+    if pos.ndim:
+        q_pos = pos[:, None] + jnp.arange(cur)  # (b, cur)
+
+        def _attend(qj, pj):  # qj (b, h, dh), pj (b,)
+            lg = (jnp.einsum("bhd,bpthd->bhpt", qj, kt)
+                  * scale).reshape(b, h, max_len)
+            vis = jnp.arange(max_len)[None, None, :] <= pj[:, None, None]
+            lg = jnp.where(vis, lg, jnp.finfo(lg.dtype).min)
+            pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                axis=-1).astype(dtype)
+            return jnp.einsum("bhpt,bpthd->bhd",
+                              pr.reshape(b, h, *kt.shape[1:3]), vt)
+
+        return jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(q, q_pos)
+
+    lg = (jnp.einsum("bqhd,bpthd->bhqpt", q, kt)
+          * scale).reshape(b, h, cur, max_len)
+    q_pos = pos + jnp.arange(cur)[:, None]
+    visible = jnp.arange(max_len)[None, :] <= q_pos
+    lg = jnp.where(visible[None, None], lg, jnp.finfo(lg.dtype).min)
+    pr = jax.nn.softmax(lg.astype(jnp.float32), axis=-1).astype(dtype)
+    return jnp.einsum("bhqpt,bpthd->bqhd",
+                      pr.reshape(b, h, cur, *kt.shape[1:3]), vt)
+
+
+# ------------------------------------------------------- Pallas kernel
+
+
+def _decode_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                   kv: int, groups: int, page_tokens: int, n_pages: int,
+                   scale: float, int8: bool):
+    """One ``(slot, page)`` grid step of the paged-decode kernel.
+
+    The block specs already fetched THIS slot's page ``m`` by table
+    value (the index maps read the scalar-prefetched table), so the
+    kernel body only runs the online-softmax recurrence over the page's
+    ``page_tokens`` keys — running max / denominator / accumulator
+    carried in VMEM scratch across the page axis, exactly the flash
+    kernel's recurrence with the K-block stream replaced by a
+    table-indirected page stream."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    if int8:  # int8 payloads ride two extra per-vector scale blocks
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    s = pl.program_id(0)
+    m = pl.program_id(1)
+    h = kv * groups
+
+    @pl.when(m == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    mapped = tbl_ref[s * n_pages + m] >= 0
+
+    @pl.when(mapped)  # -1 (unmapped) pages: skip — nothing to attend
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (h, dh)
+        k_blk = k_ref[0].astype(jnp.float32)      # (T, kv, dh)
+        v_blk = v_ref[0].astype(jnp.float32)
+        if int8:
+            k_blk = k_blk * ks_ref[0].astype(jnp.float32)[..., None]
+            v_blk = v_blk * vs_ref[0].astype(jnp.float32)[..., None]
+        # Query head j attends KV head j // groups (the GQA mapping;
+        # groups == 1 is MHA).  Static per-KV-head 2D dots keep the MXU
+        # happy — kv is a small compile-time constant.
+        rows = []
+        for ki in range(kv):
+            qk = q[ki * groups:(ki + 1) * groups]  # (g, dh)
+            rows.append(jnp.dot(qk, k_blk[:, ki, :].T,
+                                preferred_element_type=jnp.float32))
+        s_blk = jnp.concatenate(rows, axis=0)  # (h, T)
+        k_pos = m * page_tokens + lax.broadcasted_iota(
+            jnp.int32, (h, page_tokens), 1)
+        s_blk = jnp.where(k_pos <= pos_ref[s], s_blk, _NEG_INF)
+        m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)  # (h, 1)
+        l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_blk - m_new)  # (h, T)
+        l_ref[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        pv = []
+        for ki in range(kv):
+            pv.append(jnp.dot(p[ki * groups:(ki + 1) * groups],
+                              v_blk[:, ki, :],
+                              preferred_element_type=jnp.float32))
+        acc_ref[...] = acc_ref[...] * alpha + jnp.concatenate(pv, axis=0)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(m == n_pages - 1)
+    def _finalize():
+        l_safe = jnp.maximum(jnp.max(l_ref[...], axis=-1, keepdims=True),
+                             1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _kernel_paged(q, pages, table, pos, *, dtype, interpret):
+    """Dispatch one decode step (``cur == 1``) through the Pallas
+    paged-decode kernel.  ``q``: ``(b, 1, h, dh)``; the grid is
+    ``(b, M)`` with the online-softmax carry persisting across the
+    inner (page) axis; the table row and per-slot positions are scalar
+    prefetch, so each page block is DMA'd by TABLE VALUE — the gather
+    never exists even as a transient."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, cur, h, dh = q.shape
+    assert cur == 1, "the paged-decode kernel is a 1-token decode kernel"
+    int8 = len(pages) == 4
+    k_pages, v_pages = pages[0], pages[1]
+    n_real = k_pages.shape[0] - 1  # trailing page is the write scratch
+    page_tokens, kv = k_pages.shape[1], k_pages.shape[2]
+    n_pages = table.shape[1]
+    groups = h // kv
+    scale = dh ** -0.5
+    scratch_page = n_real
+
+    tbl = jnp.asarray(table, jnp.int32).reshape(-1)
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+
+    def page_map(s, m, tbl_ref, pos_ref):
+        t = tbl_ref[s * n_pages + m]
+        return (jnp.where(t >= 0, t, scratch_page), 0, 0, 0)
+
+    def scale_map(s, m, tbl_ref, pos_ref):
+        t = tbl_ref[s * n_pages + m]
+        return (jnp.where(t >= 0, t, scratch_page), 0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, kv=kv, groups=groups, page_tokens=page_tokens,
+        n_pages=n_pages, scale=scale, int8=int8)
+    ins = (pages[0], pages[1]) + ((pages[2], pages[3]) if int8 else ())
+    in_specs = [
+        pl.BlockSpec((1, h, dh), lambda s, m, t, p: (s, 0, 0)),
+        pl.BlockSpec((1, page_tokens, kv, dh), page_map),
+        pl.BlockSpec((1, page_tokens, kv, dh), page_map),
+    ]
+    if int8:
+        in_specs += [pl.BlockSpec((1, page_tokens, kv), scale_map),
+                     pl.BlockSpec((1, page_tokens, kv), scale_map)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, dh), lambda s, m, t, p: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, dh), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+            pltpu.VMEM((h, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), dtype),
+        interpret=interpret,
+    )(tbl, pos, q[:, 0], *ins)
+    return out[:, None]
+
+
+# ----------------------------------------------------------- public op
+
+
+def paged_attention(q, pages, table, pos, *, dtype, grouped: bool = False,
+                    impl: str = "einsum",
+                    interpret: bool | None = None) -> jnp.ndarray:
+    """Attention for already-projected queries over table-indirected
+    K/V pages — the ONE paged-attention op behind the serve engine's
+    gather-free step programs.
+
+    ``q``: ``(b, cur, heads, dh)`` queries (RoPE already applied for
+    LLaMA).  ``pages``: one LAYER's page buffers — ``(k, v)`` each
+    ``(num_pages + 1, page_tokens, kv_heads, dh)`` (the last page is
+    the write scratch), or ``(k, v, k_scale, v_scale)`` for int8
+    payloads.  ``table``: ``(b, max_pages)`` int32 block table, ``-1``
+    unmapped.  ``pos``: ``(b,)`` per-row depths (window position ``j``
+    attends keys ``<= pos[b] + j``; the serve engine's vector-position
+    contract) or a scalar (the prefill window's shared depth).
+    ``grouped`` selects the GQA einsum family (LLaMA's dense-twin
+    forms) over the MHA family (GPT-2's) so the fp path stays bitwise
+    identical to whichever dense twin the caller mirrors.
+
+    ``impl='einsum'`` is bit-exact vs the dense math on the gathered
+    view; ``impl='kernel'`` routes single-token vector-position calls
+    through the Pallas paged-decode kernel (tolerance-bounded like
+    flash; wider windows and prefill fall back to the exact einsum
+    path, which writes the same KV a dense prefill would)."""
+    if impl not in ("einsum", "kernel"):
+        raise ValueError(
+            f"unknown paged-attention impl {impl!r}; choose from "
+            f"'einsum' (bit-exact blockwise) or 'kernel' (Pallas decode)")
+    pos = jnp.asarray(pos)
+    if impl == "kernel" and pos.ndim and q.shape[1] == 1:
+        if interpret is None:
+            interpret = _interpret_default()
+        return _kernel_paged(q, pages, table, pos, dtype=dtype,
+                             interpret=interpret)
+    return _einsum_paged(q, pages, table, pos, dtype=dtype,
+                         grouped=grouped)

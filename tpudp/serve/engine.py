@@ -65,10 +65,16 @@ Design (static shapes everywhere — the TPU rule that shapes are compile
   * **True paged attention** (``kv_pages > 0``) — the dense per-model
     slot arenas are replaced by ONE shared page pool per KV geometry
     plus per-slot block tables (``(num_slots, max_pages)`` int32): the
-    decode/verify/prefill/fused programs gather each slot's pages into
-    the logical dense view, run the EXACT dense step math on it (bit-
-    identical outputs — the paged-parity contract), and scatter back
-    only the pages they wrote.  A prefix-cache hit becomes a TABLE
+    decode/verify/prefill/fused programs read K/V THROUGH the table
+    inside the attention contraction (``tpudp.ops.paged_attention`` —
+    blockwise over ``(pages, page_size)`` tiles, fp outputs bitwise
+    identical to the dense math: the paged-parity contract) and commit
+    each new token's K/V directly into the one page containing its
+    position — the per-step full-view gather/scatter of the original
+    paged engine is gone (``paged_attn='gather'`` keeps that baseline
+    for comparison; ``paged_attn='kernel'`` opts single-token decode
+    into a Pallas paged-decode kernel, tolerance-bounded like flash).
+    A prefix-cache hit becomes a TABLE
     WRITE (refcount bump on the radix tree's pages — zero
     ``copy_block_in`` copies) with copy-on-write at the divergence
     block: shared pages are never written, the first divergent chunk
@@ -221,8 +227,7 @@ import numpy as np
 from jax import lax
 
 from tpudp.models.generate import (KVCache, _forward_cached,
-                                   _forward_paged, gather_pages,
-                                   scatter_pages, validate_decode_config)
+                                   _forward_paged, validate_decode_config)
 from tpudp.obs import FlightRecorder, Recorder
 from tpudp.ops.sampling import sample_tokens, split_keys, verify_tokens
 from tpudp.utils.compile_cache import ProgramCache
@@ -437,9 +442,22 @@ def _fused_decode_math(forward, state, last_tokens, lengths, active,
     return state, out, n_emit, keys, iters, counts
 
 
-def _build_steps(cfg, params):
+def _build_steps(cfg, params, paged_attn: str = "einsum"):
     """Jitted step programs with the WEIGHTS CLOSED OVER as compile-time
     constants rather than traced arguments.
+
+    ``paged_attn`` selects the PAGED programs' KV indirection (the
+    dense programs never change): ``'einsum'`` — the default — is the
+    GATHER-FREE bit-exact path (K/V read through the block table inside
+    the attention contraction, single-token page writes; see
+    ``tpudp.ops.paged_attention``); ``'gather'`` is PR 13's
+    gather→dense-math→scatter baseline, kept for the bench comparison
+    and as the kernel tests' oracle; ``'kernel'`` routes the
+    single-token decode program through the Pallas paged-decode kernel
+    (tolerance-bounded — its own TRACE_COUNTS key and pinned trace),
+    while the wider windows (verify/fused/prefill) stay on the exact
+    einsum path so their KV writes remain bit-identical to a dense
+    prefill's.
 
     An engine's params are immutable for its lifetime, and freezing them
     lets XLA pre-pack the weight matrices for the step gemms at compile
@@ -553,63 +571,82 @@ def _build_steps(cfg, params):
             lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1))
 
     # -- paged twins (Engine(kv_pages=N)): identical math read through
-    # per-slot block tables into one shared page pool.  Each gathers the
-    # slots' pages into the dense logical view, runs the EXACT dense
-    # step body on it (same values -> bit-identical logits/samples, the
-    # paged-parity contract), and scatters only the written pages back.
-    # The pool (KVCache or Int8Pages pytree) is donated like the dense
-    # arena; the TABLE is host-authoritative and read-only on device.
+    # per-slot block tables into one shared page pool.  The DEFAULT
+    # ("einsum") indirection is GATHER-FREE: each layer writes the
+    # window's new tokens straight into the pages containing them and
+    # reads K/V through the table inside the attention contraction
+    # (bit-identical outputs — tpudp.ops.paged_attention's contract —
+    # with the dense logical view never materialized); "gather" keeps
+    # PR 13's gather→dense→scatter baseline.  The pool (KVCache or
+    # Int8Pages pytree) is donated like the dense arena; the TABLE is
+    # host-authoritative and read-only on device.
+    win_impl = "gather" if paged_attn == "gather" else "einsum"
 
-    def _paged_fwd(table):
-        """The paged indirection for the shared step bodies: gather the
-        slots' pages into the logical dense view, run the exact dense
-        forward, scatter back only the written pages (``active`` masks
-        the scatter to the scratch page for idle rows)."""
+    def _paged_fwd(table, impl):
+        """The paged indirection for the shared step bodies —
+        ``generate._forward_paged`` with the build's impl baked in
+        (``active`` masks the write path to the scratch page for idle
+        rows)."""
         def fwd(pool, tokens, lengths, active):
             return _forward_paged(cfg, params, tokens, pool, table,
-                                  lengths, active)
+                                  lengths, active, impl=impl)
         return fwd
 
-    @functools.partial(jax.jit, donate_argnums=(0, 9))
-    def decode_step_paged(pool, table, last_tokens, lengths, active,
-                          temps, top_k, top_p, keys, counts):
-        """Paged decode: one token for every slot, KV read/written
-        through ``table`` into ``pool``.  Same sampling/PRNG contract
-        as ``decode_step`` — literally the same ``_decode_math`` body;
-        compiles once per (num_slots, max_len, num_pages)."""
-        TRACE_COUNTS["decode_paged"] += 1
-        return _decode_math(_paged_fwd(table), pool, last_tokens,
-                            lengths, active, temps, top_k, top_p, keys,
-                            counts)
+    if paged_attn == "kernel":
+        @functools.partial(jax.jit, donate_argnums=(0, 9))
+        def decode_step_paged(pool, table, last_tokens, lengths, active,
+                              temps, top_k, top_p, keys, counts):
+            """Paged decode through the PALLAS paged-decode kernel
+            (``Engine(paged_attn='kernel')`` opt-in): same sampling/
+            PRNG contract and shared ``_decode_math`` body as the
+            einsum twin, but the attention contraction runs the
+            online-softmax kernel with the block table as scalar
+            prefetch — tolerance-bounded like flash, hence its own
+            TRACE_COUNTS key and pinned trace."""
+            TRACE_COUNTS["decode_paged_kernel"] += 1
+            return _decode_math(_paged_fwd(table, "kernel"), pool,
+                                last_tokens, lengths, active, temps,
+                                top_k, top_p, keys, counts)
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 9))
+        def decode_step_paged(pool, table, last_tokens, lengths, active,
+                              temps, top_k, top_p, keys, counts):
+            """Paged decode: one token for every slot, KV read/written
+            through ``table`` into ``pool``.  Same sampling/PRNG
+            contract as ``decode_step`` — literally the same
+            ``_decode_math`` body; compiles once per (num_slots,
+            max_len, num_pages)."""
+            TRACE_COUNTS["decode_paged"] += 1
+            return _decode_math(_paged_fwd(table, paged_attn), pool,
+                                last_tokens, lengths, active, temps,
+                                top_k, top_p, keys, counts)
 
     @functools.partial(jax.jit, donate_argnums=(0, 10))
     def verify_step_paged(pool, table, tokens, lengths, active, n_draft,
                           temps, top_k, top_p, keys, counts):
         """Paged speculative verify (the shared ``_verify_math`` body):
-        the k+1 window's writes may cross one page boundary — the
-        scatter's statically-unrolled spare page covers it (host
-        preallocates the table entries)."""
+        the k+1 window's writes may cross one page boundary — each
+        window position commits into its own page-containing row (the
+        host preallocates the table entries)."""
         TRACE_COUNTS["verify_paged"] += 1
-        return _verify_math(_paged_fwd(table), pool, tokens, lengths,
-                            active, n_draft, temps, top_k, top_p, keys,
-                            counts)
+        return _verify_math(_paged_fwd(table, win_impl), pool, tokens,
+                            lengths, active, n_draft, temps, top_k,
+                            top_p, keys, counts)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def prefill_step_paged(pool, row_table, tokens, pos, last):
-        """Paged prompt chunk for one slot: gather the slot's pages into
-        its (1, max_len) logical row, run the same scalar-pos cached
-        forward the dense prefill runs on its sliced arena row, write
-        the chunk's page back.  Chunk starts are page-aligned (pages
-        are sized to ``prefill_chunk``), so exactly one real page is
-        written per chunk."""
+        """Paged prompt chunk for one slot: the same scalar-pos cached
+        forward the dense prefill runs, read/written through the
+        slot's table row.  Chunk starts are page-aligned (pages are
+        sized to ``prefill_chunk``), so exactly one real page is
+        written per chunk — on the gather-free path as per-token
+        commits into that page, never a view scatter."""
         TRACE_COUNTS["prefill_paged"] += 1
-        view = gather_pages(cfg, pool, row_table[None])
-        logits, view = _forward_cached(cfg, params, tokens, view, pos)
+        logits, new_pool = _forward_paged(
+            cfg, params, tokens, pool, row_table[None], pos,
+            jnp.ones((1,), bool), impl=win_impl)
         last_logits = lax.dynamic_index_in_dim(
             logits, last, axis=1, keepdims=False)  # (1, vocab)
-        new_pool = scatter_pages(pool, view, row_table[None],
-                                 jnp.asarray(pos)[None], tokens.shape[1],
-                                 jnp.ones((1,), bool))
         return last_logits, new_pool
 
     @functools.partial(jax.jit, donate_argnums=(0, 12),
@@ -621,15 +658,17 @@ def _build_steps(cfg, params):
         """Paged fused decode window: the dense fused loop —
         ``_fused_decode_math``, the one shared copy of carry,
         early-exit predicate, PRNG discipline, commits, and the
-        optional stream tap — with the gather/forward/scatter
-        indirection inside the ``lax.while_loop`` (the table is
-        loop-invariant; the host preallocates pages covering the
-        window before dispatch, so an in-window page-boundary crossing
-        is always backed)."""
+        optional stream tap — with the paged indirection inside the
+        ``lax.while_loop`` (the table is loop-invariant; the host
+        preallocates pages covering the window before dispatch, so an
+        in-window page-boundary crossing is always backed).  On the
+        gather-free default each loop iteration writes ONE token row
+        per running slot and reads through the table — the per-step
+        full-view gather/scatter stream is gone."""
         TRACE_COUNTS["fused_decode_paged"] += 1
         return _fused_decode_math(
-            _paged_fwd(table), pool, last_tokens, lengths, active,
-            temps, top_k, top_p, keys, budgets, eos_ids, ring_id,
+            _paged_fwd(table, win_impl), pool, last_tokens, lengths,
+            active, temps, top_k, top_p, keys, budgets, eos_ids, ring_id,
             counts, n_steps=n_steps, stream=stream)
 
     return (decode_step, verify_step, prefill_step, fused_decode_step,
@@ -637,17 +676,25 @@ def _build_steps(cfg, params):
             fused_decode_step_paged)
 
 
-# LRU of built step programs keyed by (cfg, id(params)): engines over
-# the same weights (the test/bench pattern — and any multi-engine
-# deployment of one model) share one set of compiled programs instead of
-# re-freezing the weights per Engine.  The cache itself lives in
-# tpudp.utils.compile_cache (ProgramCache documents the id()-key safety
-# argument); the trace-stability audit pins its reuse semantics.
-_STEP_CACHE = ProgramCache(_build_steps, max_entries=8)
+# LRU of built step programs keyed by ((cfg, paged_attn), id(params)):
+# engines over the same weights (the test/bench pattern — and any
+# multi-engine deployment of one model) share one set of compiled
+# programs instead of re-freezing the weights per Engine; the paged
+# KV-indirection choice rides the hashable key half because it is a
+# build-time static that changes the paged program bodies.  The cache
+# itself lives in tpudp.utils.compile_cache (ProgramCache documents the
+# id()-key safety argument); the trace-stability audit pins its reuse
+# semantics.
+def _build_steps_keyed(key, params):
+    cfg, paged_attn = key
+    return _build_steps(cfg, params, paged_attn)
 
 
-def _engine_steps(cfg, params):
-    return _STEP_CACHE.get(cfg, params)
+_STEP_CACHE = ProgramCache(_build_steps_keyed, max_entries=8)
+
+
+def _engine_steps(cfg, params, paged_attn: str = "einsum"):
+    return _STEP_CACHE.get((cfg, paged_attn), params)
 
 
 class _ModelState:
@@ -849,10 +896,17 @@ class Engine:
     copy-on-write at the divergence block, and publish is an ownership
     transfer.  Outputs stay bit-identical to the dense engine and to
     ``generate()``; ``kv_dtype="int8"`` additionally quantizes page
-    payloads (tolerance-bounded outputs, double capacity).  Public
-    handles: :attr:`page_pool` / :attr:`page_index`; mutually
-    exclusive with ``prefix_cache_blocks`` (the dense COPY cache,
-    which stays byte-for-byte unchanged when paging is off).
+    payloads (tolerance-bounded outputs, double capacity).
+    ``paged_attn`` picks the attention backend: ``'einsum'`` (default)
+    reads K/V through the table inside the contraction — gather-free,
+    bit-exact; ``'gather'`` is the PR 13 gather→dense→scatter
+    baseline; ``'kernel'`` runs single-token decode through the
+    Pallas paged-decode kernel (tolerance-bounded like flash, so it
+    requires ``speculate_k=0`` and ``decode_fuse=1`` — those paths
+    lean on bit-exact single-step fall-back).  Public handles:
+    :attr:`page_pool` / :attr:`page_index`; mutually exclusive with
+    ``prefix_cache_blocks`` (the dense COPY cache, which stays
+    byte-for-byte unchanged when paging is off).
 
     ``decode_fuse > 1`` turns on fused decode windows: on pure-decode
     iterations (no queued work, nothing prefilling, no speculation this
@@ -896,6 +950,7 @@ class Engine:
                  speculate_k: int = 0, drafter=None,
                  prefix_cache_blocks: int = 0,
                  kv_pages: int = 0, kv_dtype: str | None = None,
+                 paged_attn: str = "einsum",
                  decode_fuse: int = 1, fuse_stream: bool = False,
                  queue_limit: int | None = None,
                  drafter_timeout_s: float | None = None,
@@ -942,6 +997,25 @@ class Engine:
             raise ValueError(
                 "kv_dtype requires kv_pages > 0 — quantized KV lives in "
                 "page-pool payloads behind the table indirection")
+        if paged_attn not in ("einsum", "gather", "kernel"):
+            raise ValueError(
+                f"paged_attn must be 'einsum' (gather-free bit-exact "
+                f"blockwise attention — the default), 'gather' (PR 13's "
+                f"gather→dense→scatter baseline), or 'kernel' (Pallas "
+                f"paged-decode kernel, tolerance-bounded); got "
+                f"{paged_attn!r}")
+        if paged_attn != "einsum" and not kv_pages:
+            raise ValueError(
+                f"paged_attn={paged_attn!r} requires kv_pages > 0 — the "
+                f"paged-attention backend choice only exists behind the "
+                f"block-table indirection")
+        if paged_attn == "kernel" and (speculate_k or decode_fuse > 1):
+            raise ValueError(
+                "paged_attn='kernel' supports plain single-step decode "
+                "only (speculate_k=0, decode_fuse=1): the kernel is "
+                "tolerance-bounded like flash, and the speculative/"
+                "fused paths rely on bit-exact fall-back to the "
+                "single-step program")
         if drafter is not None and speculate_k == 0:
             raise ValueError("drafter requires speculate_k >= 1 "
                              "(speculation is off at k=0)")
@@ -1001,6 +1075,12 @@ class Engine:
         self._paged = kv_pages > 0
         self.kv_pages = kv_pages
         self.kv_dtype = kv_dtype
+        # Paged-attention backend (only meaningful with kv_pages > 0):
+        # "einsum" — gather-free blockwise attention through the table,
+        # bit-exact vs dense (the default); "gather" — the PR 13
+        # gather/scatter baseline; "kernel" — Pallas paged-decode
+        # kernel (tolerance-bounded opt-in).
+        self.paged_attn = paged_attn
         self._max_pages = self.max_len // prefill_chunk  # table width
         # Fused decode windows (module docstring "Fused decode windows"):
         # decode_fuse=1 — the default — never touches the fused program
@@ -1129,7 +1209,10 @@ class Engine:
                     f"drafter vocab_size ({dcfg.vocab_size}) must match "
                     f"co-resident model {name!r}'s ({cfg.vocab_size}) — "
                     f"speculation requires a shared tokenizer")
-        ms = _ModelState(name, model, params, _engine_steps(cfg, params))
+        ms = _ModelState(name, model, params,
+                         _engine_steps(cfg, params,
+                                       self.paged_attn if self._paged
+                                       else "einsum"))
         # Prefix cache: blocks sized to prefill_chunk so a cached block
         # boundary is always a chunk boundary (imported lazily — the
         # module imports TRACE_COUNTS from here, and the cache is
