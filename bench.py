@@ -476,6 +476,11 @@ def _emit_banked(banked: dict, why: str) -> None:
     out["fresh"] = False
     out.setdefault("git_rev", None)
     out["reemitted_by_git_rev"] = _git_rev()
+    # Explicit staleness horizon (never silently re-dated): the banked
+    # row's own capture timestamp, pinned once at first re-emission and
+    # carried through any chain of re-emissions — tools/bench_gaps.py's
+    # `stale` stage reports a named stale-tpu-row gap off this marker.
+    out.setdefault("stale_since", out.get("measured_at_utc"))
     # The baseline denominator can be re-measured between capture and
     # re-emission (it was: 66.17 -> 92.42 img/s on 2026-07-31).  Re-state
     # the ratio against the CURRENT denominator so the artifact matches

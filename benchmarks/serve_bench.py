@@ -148,6 +148,7 @@ SERVE_STRICT_LEVELS=1 (reject unregistered levels/seeds).
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -156,7 +157,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
                               SERVE_FUSED_NS, SERVE_PAGED_WORKLOADS,
                               SERVE_PREFIX_WORKLOADS, SERVE_SOAK_SEEDS,
-                              SERVE_SPEC_KS, SERVE_TENANCY_SEEDS)
+                              SERVE_SPEC_FUSED_CONFIGS, SERVE_SPEC_KS,
+                              SERVE_TENANCY_SEEDS)
 
 METRIC = "serve_tokens_per_sec"
 SPEC_METRIC = "serve_spec_tokens_per_sec"
@@ -166,6 +168,7 @@ PAGED_METRIC = "serve_paged"
 PAGED_KERNEL_METRIC = "serve_paged_kernel"
 TENANCY_METRIC = "serve_tenancy"
 FUSED_METRIC = "serve_fused"
+SPEC_FUSED_METRIC = "serve_spec_fused"
 
 #: The serve_paged capacity gate: the paged engine must sustain at
 #: least this many times the dense engine's co-resident contexts at
@@ -203,6 +206,14 @@ def main() -> None:
                          "rows for the on-device lax.while_loop decode "
                          "program vs the single-step engine "
                          "(env: SERVE_DECODE_FUSE)")
+    ap.add_argument("--spec-fused", default=None,
+                    help="comma-separated on-device fused-speculation "
+                         "configs (k{K}n{N}, e.g. k2n4); emits rows "
+                         "comparing Engine(speculate_k=K, decode_fuse=N, "
+                         "drafter=DraftModelDrafter) against the "
+                         "host-drafted speculative engine AND the plain "
+                         "fused engine at identical geometry "
+                         "(env: SERVE_SPEC_FUSED)")
     ap.add_argument("--soak", default=None,
                     help="comma-separated soak seeds; runs the "
                          "fault-injection soak harness instead of the "
@@ -251,12 +262,25 @@ def main() -> None:
 
     from tpudp.models.generate import generate
     from tpudp.models.gpt2 import GPT2, GPT2Config
-    from tpudp.serve import Engine, NgramDrafter, QueueFull, TenantClass
+    from tpudp.serve import (DraftModelDrafter, Engine, NgramDrafter,
+                             QueueFull, TenantClass)
 
     spec_env = args.speculate_k or os.environ.get("SERVE_SPECULATE_K")
     spec_ks = _parse_levels(spec_env) if spec_env else []
     fused_env = args.decode_fuse or os.environ.get("SERVE_DECODE_FUSE")
     fused_ns = _parse_levels(fused_env) if fused_env else []
+    sf_env = args.spec_fused or os.environ.get("SERVE_SPEC_FUSED")
+    sf_names = [c for c in sf_env.split(",") if c] if sf_env else []
+    # Config names validate like workload names (always strict — an
+    # unknown "k{K}n{N}" is a typo, not an unregistered sweep point).
+    sf_pairs = []  # (name, k, n)
+    for name in sf_names:
+        m = re.fullmatch(r"k(\d+)n(\d+)", name)
+        if not m or name not in SERVE_SPEC_FUSED_CONFIGS:
+            raise SystemExit(
+                f"error: unknown spec-fused config {name!r} "
+                f"(registry: {list(SERVE_SPEC_FUSED_CONFIGS)})")
+        sf_pairs.append((name, int(m.group(1)), int(m.group(2))))
     soak_env = args.soak or os.environ.get("SERVE_SOAK")
     soak_seeds = _parse_levels(soak_env) if soak_env else []
     tenancy_env = args.tenants or os.environ.get("SERVE_TENANCY")
@@ -285,7 +309,7 @@ def main() -> None:
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
         if (not spec_ks and not soak_seeds and not prefix_workloads
                 and not paged_workloads and not tenancy_seeds
-                and not fused_ns and bad):
+                and not fused_ns and not sf_pairs and bad):
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
         bad_k = [k for k in spec_ks if k not in SERVE_SPEC_KS]
@@ -348,7 +372,9 @@ def main() -> None:
     prefix_users = int(os.environ.get("SERVE_PREFIX_USERS", 4))
     prefix_turns = int(os.environ.get("SERVE_PREFIX_TURNS", 3))
     prefix_tail = max(chunk // 2, 1)
-    slack = max(spec_ks, default=0)  # speculative windows need k scratch
+    # Speculative windows need k scratch beyond the generation budget —
+    # both the host-drafted sweep's and the fused-spec sweep's.
+    slack = max([*spec_ks, *(k for _, k, _n in sf_pairs)], default=0)
     if prefix_workloads or paged_workloads:
         # The deepest multiturn prompt is the whole prior conversation:
         # shared prefix + `turns` user tails + (turns-1) responses, plus
@@ -358,7 +384,7 @@ def main() -> None:
                 + prefix_turns * max_new)
     else:
         need = prompt_len + (max(max_new, spec_max_new) + slack
-                             if spec_ks else max_new)
+                             if spec_ks or sf_pairs else max_new)
     cfg = GPT2Config(
         vocab_size=int(os.environ.get("SERVE_VOCAB", 8192)),
         max_seq_len=((need + chunk - 1) // chunk) * chunk,
@@ -440,6 +466,12 @@ def main() -> None:
     results = []
 
     def emit(row):
+        # Unified serve-row schema: EVERY row (including error rows)
+        # carries accept_rate — null when speculation is off or the row
+        # never measured one — so downstream consumers read acceptance
+        # accounting from one key across all stages instead of probing
+        # per-stage column names (test_bench_smoke pins this).
+        row.setdefault("accept_rate", None)
         results.append(row)
         print(json.dumps(row), flush=True)
 
@@ -531,7 +563,7 @@ def main() -> None:
     seq_latencies = []
     if (not spec_ks and not soak_seeds and not prefix_workloads
             and not paged_workloads and not tenancy_seeds
-            and not fused_ns):
+            and not fused_ns and not sf_pairs):
         np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
                             max_new))
         t0 = time.perf_counter()
@@ -655,9 +687,14 @@ def main() -> None:
             "value": round(tps, 1),
             "unit": "tokens/sec",
             "drafter": "ngram(max=3,min=2)",
+            # acceptance_rate is this row's historical column name;
+            # accept_rate is the unified cross-stage schema key.
             "acceptance_rate": (round(engine.acceptance_rate, 3)
                                 if engine.acceptance_rate is not None
                                 else None),
+            "accept_rate": (round(engine.acceptance_rate, 3)
+                            if engine.acceptance_rate is not None
+                            else None),
             "verify_steps": engine.stats["verify_steps"],
             "draft_tokens": engine.stats["draft_tokens"],
             "baseline_tokens_per_sec": round(base_tps, 1),
@@ -781,6 +818,139 @@ def main() -> None:
             "device_kind": kind,
         })
         bank_metrics("serve_fused", n, fused["metrics"])
+
+    def run_spec_fused(config: str, k: int, n: int, draft_model,
+                       zero_params, zero_draft_params) -> None:
+        """On-device fused speculation vs BOTH of its ancestors, same
+        repetitive-ceiling greedy workload (run_spec's zero-scaled
+        weight tree — every forward streams real-sized weights, greedy
+        output is provably constant, so acceptance ~1 and the row is
+        the mechanical best case, not prompt luck):
+
+        * the host-drafted speculative engine (speculate_k=k, draft
+          model bucketed to the same max_len-wide context) — isolates
+          what moving draft->verify->accept on device buys;
+        * the plain fused engine (decode_fuse=n, no speculation) —
+          isolates what the draft model buys on top of dispatch
+          amortization.
+
+        The gate (``spec_fused_ok``) is the ISSUE acceptance bar: the
+        fused-spec window actually engaged (fused_spec_windows > 0),
+        greedy outputs bit-identical across all three engines, sampled
+        outputs bit-identical vs the host-drafted engine under the same
+        per-slot PRNG chains (both advance one key per verify window),
+        and tokens/sec >= max(both baselines).  Interleaved best-of-
+        ``tries`` per engine, like run_paged_kernel — the smoke host
+        has documented double-digit timing variance and a one-shot
+        >=max(...) gate would sit on scheduler luck."""
+        sf_rng = np.random.default_rng(seed + 5)
+        sf_prompts = [
+            np.tile(sf_rng.integers(0, cfg.vocab_size, size=4),
+                    (prompt_len + 3) // 4)[:prompt_len].astype(np.int32)
+            for _ in range(n_requests)]
+        offsets = np.zeros(n_requests)
+        warm = np.tile(sf_rng.integers(0, cfg.vocab_size, size=2),
+                       chunk // 2 + 1)[:chunk].astype(np.int32)
+        tries = int(os.environ.get("SERVE_SPEC_FUSED_TRIES", 2))
+
+        engines = {
+            "fused_spec": Engine(
+                model, zero_params, num_slots=spec_conc,
+                max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                speculate_k=k, decode_fuse=n,
+                drafter=DraftModelDrafter(draft_model, zero_draft_params)),
+            "host_spec": Engine(
+                model, zero_params, num_slots=spec_conc,
+                max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                speculate_k=k,
+                drafter=DraftModelDrafter(draft_model, zero_draft_params,
+                                          bucket=cfg.max_seq_len)),
+            "plain_fused": Engine(
+                model, zero_params, num_slots=spec_conc,
+                max_len=cfg.max_seq_len, prefill_chunk=chunk,
+                decode_fuse=n),
+        }
+        for eng in engines.values():
+            eng.generate_many([warm], 8)  # all programs off the clock
+
+        best = dict.fromkeys(engines, 0.0)
+        outs: dict = {}
+        lat_best: dict = {}
+        for _ in range(tries):
+            for name, eng in engines.items():
+                elapsed, lats, ttfts, handles, _s = drive(
+                    eng, offsets, sf_prompts, spec_max_new)
+                tps_i = (n_requests * spec_max_new / elapsed
+                         if elapsed > 0 else 0.0)
+                if tps_i >= best[name]:
+                    best[name] = tps_i
+                    lat_best[name] = (lats, ttfts)
+                outs[name] = [list(h.tokens) for h in handles]
+        sf_eng = engines["fused_spec"]
+        stats = dict(sf_eng.stats)
+        accept = sf_eng.acceptance_rate
+        host_accept = engines["host_spec"].acceptance_rate
+        engaged = stats.get("fused_spec_windows", 0) > 0
+
+        # Sampled parity vs the host-drafted referee (identical PRNG
+        # chains: both speculative engines advance the per-slot key once
+        # per verify window) — short, off the throughput clock.
+        sampled = {}
+        for name in ("fused_spec", "host_spec"):
+            hs = [engines[name].submit(p, 12, temperature=0.9, top_k=12,
+                                       seed=seed + 77 + i)
+                  for i, p in enumerate(sf_prompts[:2])]
+            engines[name].run_until_complete()
+            sampled[name] = [list(h.tokens) for h in hs]
+        sampled_parity = sampled["fused_spec"] == sampled["host_spec"]
+
+        tps = best["fused_spec"]
+        host_tps = best["host_spec"]
+        fused_tps = best["plain_fused"]
+        parity_ok = (outs["fused_spec"] == outs["host_spec"]
+                     == outs["plain_fused"] and sampled_parity)
+        spec_fused_ok = (tps > 0 and parity_ok and engaged
+                         and tps >= host_tps and tps >= fused_tps)
+        lats, ttfts = lat_best["fused_spec"]
+        emit({
+            "metric": SPEC_FUSED_METRIC,
+            "config": config,
+            "speculate_k": k,
+            "decode_fuse": n,
+            "concurrency": spec_conc,
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            "drafter": (f"draft_model(L{draft_model.config.num_layers},"
+                        f"d{draft_model.config.d_model})"),
+            "accept_rate": round(accept, 3) if accept is not None else None,
+            "draft_tokens": stats.get("draft_tokens", 0),
+            "draft_accepted": stats.get("draft_accepted", 0),
+            "fused_spec_windows": stats.get("fused_spec_windows", 0),
+            "fused_spec_steps": stats.get("fused_spec_steps", 0),
+            "host_spec_tokens_per_sec": round(host_tps, 1),
+            "host_spec_accept_rate": (round(host_accept, 3)
+                                      if host_accept is not None else None),
+            "plain_fused_tokens_per_sec": round(fused_tps, 1),
+            "speedup_vs_host_spec": (round(tps / host_tps, 3)
+                                     if host_tps else None),
+            "speedup_vs_plain_fused": (round(tps / fused_tps, 3)
+                                       if fused_tps else None),
+            "sampled_parity_ok": sampled_parity,
+            "parity_ok": parity_ok,
+            "spec_fused_ok": spec_fused_ok,
+            "tries": tries,
+            "workload": "repetitive-ceiling",
+            **latency_fields(lats, ttfts),
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "max_new_tokens": spec_max_new,
+            "prefill_chunk": chunk,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        })
+        bank_metrics("serve_spec_fused", config, sf_eng.metrics())
 
     def run_soak(soak_seed: int) -> None:
         """Fault-injection soak against the robustness layer, fully
@@ -1502,6 +1672,36 @@ def main() -> None:
                       "error": f"{type(exc).__name__}: {exc}"[:500]})
         write_sidecar()
         print(json.dumps({"serve_paged": results}))
+        return
+    if sf_pairs:
+        # One zero target tree + one zero draft tree for the whole
+        # sweep (same program-cache rationale as the spec branch).  The
+        # draft is a genuinely smaller model — fewer layers, narrower —
+        # sharing the target's vocab, with enough position budget for
+        # the fused program's max_len + k scratch eligibility floor.
+        zero_params = jax.tree_util.tree_map(lambda x: x * 0, params)
+        d_dm = max(dm // 4, 32)
+        draft_cfg = GPT2Config(
+            vocab_size=cfg.vocab_size,
+            max_seq_len=cfg.max_seq_len
+            + max(k for _, k, _n in sf_pairs),
+            num_layers=max(cfg.num_layers // 3, 1),
+            num_heads=max(d_dm // 64, 1),
+            d_model=d_dm)
+        draft_model_sf = GPT2(draft_cfg)
+        zero_draft_params = jax.tree_util.tree_map(
+            lambda x: x * 0,
+            draft_model_sf.init(jax.random.PRNGKey(seed + 1),
+                                jnp.zeros((1, 8), jnp.int32))["params"])
+        for name, k, n in sf_pairs:
+            try:
+                run_spec_fused(name, k, n, draft_model_sf,
+                               zero_params, zero_draft_params)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": SPEC_FUSED_METRIC, "config": name,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
+        print(json.dumps({"serve_spec_fused": results}))
         return
     if fused_ns:
         for n in fused_ns:

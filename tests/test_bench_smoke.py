@@ -142,11 +142,80 @@ def test_emit_banked_marks_replay_machine_distinguishable(capsys):
     assert out["git_rev"] is None  # pre-field row: producing rev unknown
     assert out["stale_reason"] == "relay wedged"
     assert "reemitted_by_git_rev" in out
+    # Explicit staleness horizon, never silently re-dated: stale_since
+    # is the banked row's own capture timestamp.
+    assert out["stale_since"] == "2026-07-30T04:36:00Z"
     # a banked row that DOES carry its producing rev keeps it
     with pytest.raises(SystemExit):
         bench._emit_banked({**banked, "git_rev": "abc1234"}, "wedged")
     out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out2["git_rev"] == "abc1234"
+
+
+def test_registry_configs_all_gated():
+    """Tier-1 guard on the committed smoke-geometry registry
+    (tools/bench_gaps.py): every UPPERCASE tuple registry must be
+    consumed by a gate function, and every gate must be reachable from
+    the CLI the watcher drives.  A registry that grows a config no gate
+    reads — or a gate no stage can invoke — burns TPU-window time
+    measuring rows nothing ever closes on, silently."""
+    import ast
+    import inspect
+
+    import tools.bench_gaps as bg
+
+    tree = ast.parse(inspect.getsource(bg))
+    registries, gates, main_src = {}, {}, ""
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.isupper()
+                and isinstance(node.value, ast.Tuple)):
+            registries[node.targets[0].id] = node
+        if isinstance(node, ast.FunctionDef):
+            if node.name.endswith("_missing") or node.name.endswith("_rows"):
+                gates[node.name] = ast.unparse(node)
+            if node.name == "main":
+                main_src = ast.unparse(node)
+    assert registries and gates and main_src
+    gate_blob = "\n".join(gates.values())
+    ungated = [n for n in registries if n not in gate_blob]
+    assert not ungated, (
+        f"smoke-geometry registries with no gate reading them: {ungated}")
+    # every gate is dispatchable from the CLI (main() must name it) —
+    # the watcher resumes sweeps through `python tools/bench_gaps.py
+    # <stage>`, so an undispatchable gate is dead coverage
+    undispatched = [g for g in gates if g not in main_src]
+    assert not undispatched, (
+        f"gates unreachable from bench_gaps main(): {undispatched}")
+    # spec-fused configs must parse as k{K}n{N} — serve_bench's strict
+    # name validation would reject anything else and wedge the watcher
+    import re as _re
+    for c in bg.SERVE_SPEC_FUSED_CONFIGS:
+        assert _re.fullmatch(r"k\d+n\d+", c), c
+
+
+def test_stale_tpu_row_gap(tmp_path):
+    """tools/bench_gaps `stale` stage: a result file whose current
+    artifact is a last-known-good re-emission reports a NAMED
+    stale-tpu-row gap — honest staleness instead of a silently re-dated
+    number — while fresh rows and absent files report nothing."""
+    from tools.bench_gaps import stale_tpu_rows
+
+    d = str(tmp_path)
+    assert stale_tpu_rows(d) == []  # no files, no gap
+    fresh = {"metric": "vgg11_cifar10_images_per_sec_per_chip",
+             "value": 92469.2, "device_kind": "TPU v5 lite",
+             "measured_at_utc": "2026-08-01T00:00:00Z"}
+    with open(os.path.join(d, "bench.json"), "w") as f:
+        f.write(json.dumps(fresh) + "\n")
+    assert stale_tpu_rows(d) == []  # fresh measurement, no gap
+    stale = {**fresh, "source": "last_known_good", "fresh": False,
+             "stale_since": "2026-07-30T04:36:00Z",
+             "stale_reason": "relay wedged"}
+    with open(os.path.join(d, "bench.json"), "w") as f:
+        f.write(json.dumps(stale) + "\n")
+    assert stale_tpu_rows(d) == ["stale-tpu-row:bench.json"]
 
 
 def test_error_row_skeleton():
@@ -429,6 +498,10 @@ def test_serve_fused_bench_rows_parse():
         assert r["parity_ok"] is True   # bit-exact vs the single-step run
         assert r["dispatch_ok"] is True
         assert r["host_dispatches_per_token"] <= (1 / n) * 1.25
+    # Unified serve-row schema: every serve row carries accept_rate,
+    # null when speculation is off (the spec_fused rows pin the
+    # non-null side of the contract).
+    assert all(r["accept_rate"] is None for r in byn.values())
     assert byn[1]["fused_windows"] == 0   # N=1 never builds the program
     for n in (4, 8):
         assert byn[n]["fused_windows"] > 0   # the loop actually engaged
@@ -504,6 +577,90 @@ def test_serve_fused_gap_gate(tmp_path):
             {**ok, "decode_fuse": 8,
              "device_kind": "TPU v5 lite"}) + "\n")
     assert serve_fused_missing(d) == [4]  # banked history row counts
+
+
+def test_serve_spec_fused_bench_rows_parse():
+    """The serve_spec_fused stage's CPU smoke (tier-1's guard on the
+    on-device fused-speculation bench the TPU watcher resumes): every
+    registered k{K}n{N} config emits a parseable row that beat BOTH
+    referees at identical geometry — the host-drafted speculative
+    engine and the plain fused engine — with greedy outputs bit-exact
+    across all three, sampled outputs bit-exact vs the host-drafted
+    engine under the same per-slot PRNG chains, and real acceptance
+    accounting (the zero-tree ceiling workload drafts at ~1.0).  The
+    4-layer target gives the 1-layer draft model a real cost edge; at
+    SERVE_LAYERS=1 draft and target forwards cost the same and fusion
+    has nothing to amortize, and at 3 layers the thin k2n4 margin
+    (1.02x) flaked under full-suite load on the 1-core host — 4 layers
+    + the longer 64-token decode measure 1.04-1.2x vs the host-drafted
+    referee and hold >=1.14x even under two busy-loop CPU hogs."""
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu",
+        "SERVE_SPEC_FUSED": "k2n4,k4n8",
+        "SERVE_SPEC_FUSED_TRIES": "4",
+        "SERVE_LAYERS": "4", "SERVE_DMODEL": "64", "SERVE_VOCAB": "128",
+        "SERVE_REQUESTS": "3", "SERVE_MAX_NEW": "17",
+        "SERVE_SPEC_MAX_NEW": "64", "SERVE_CHUNK": "8",
+        "SERVE_PROMPT_LEN": "8",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byc = {r["config"]: r for r in rows
+           if r.get("metric") == "serve_spec_fused" and "config" in r}
+    assert set(byc) == {"k2n4", "k4n8"}, proc.stderr[-800:]
+    for r in byc.values():
+        assert "error" not in r, r
+        assert r["value"] > 0
+        assert r["parity_ok"] is True          # greedy, all three engines
+        assert r["sampled_parity_ok"] is True  # same PRNG chains as host
+        assert r["spec_fused_ok"] is True
+        assert r["fused_spec_windows"] > 0     # the fused window engaged
+        assert r["value"] >= r["host_spec_tokens_per_sec"]
+        assert r["value"] >= r["plain_fused_tokens_per_sec"]
+        # acceptance accounting is real, not vestigial: the ceiling
+        # workload's constant greedy stream drafts at ~1.0
+        assert r["accept_rate"] is not None and r["accept_rate"] > 0.5
+        assert r["draft_accepted"] > 0
+    # unregistered configs fail fast, like the workload-name registries
+    bad = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu", "SERVE_SPEC_FUSED": "k3n5"}, timeout=300)
+    assert bad.returncode != 0
+    assert "spec-fused" in (bad.stderr + bad.stdout)
+
+
+def test_serve_spec_fused_gap_gate(tmp_path):
+    """tools/bench_gaps serve_spec_fused stage: CPU smoke rows, error
+    rows, parity-broken rows, and rows that lost to a baseline
+    (spec_fused_ok False) never close a config; banked TPU rows that
+    passed the full gate do (the watcher's config-accumulation
+    contract, same rules as the serve_fused stage)."""
+    from tools.bench_gaps import (SERVE_SPEC_FUSED_CONFIGS,
+                                  serve_spec_fused_missing)
+
+    d = str(tmp_path)
+    assert serve_spec_fused_missing(d) == list(SERVE_SPEC_FUSED_CONFIGS)
+    ok = {"metric": "serve_spec_fused", "value": 9000.0,
+          "parity_ok": True, "spec_fused_ok": True}
+    rows = [
+        {**ok, "config": "k2n4", "device_kind": "cpu"},   # smoke: no
+        {"metric": "serve_spec_fused", "config": "k2n4",
+         "error": "relay wedged"},                        # error: no
+        {**ok, "config": "k2n4", "parity_ok": False,
+         "device_kind": "TPU v5 lite"},                   # parity: no
+        {**ok, "config": "k4n8", "spec_fused_ok": False,
+         "device_kind": "TPU v5 lite"},                   # lost: no
+        {**ok, "config": "k2n4", "device_kind": "TPU v5 lite"},  # yes
+    ]
+    with open(os.path.join(d, "serve_spec_fused.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_spec_fused_missing(d) == ["k4n8"]
+    with open(os.path.join(d, "serve_spec_fused.history.jsonl"),
+              "w") as f:
+        f.write(json.dumps(
+            {**ok, "config": "k4n8",
+             "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_spec_fused_missing(d) == []  # banked history row counts
 
 
 def test_serve_tenancy_bench_row_parses():

@@ -34,6 +34,10 @@ def test_accum_matches_oneshot_exactly():
         np.asarray(s4.params["h_0"]["mlp_fc"]["kernel"]), atol=1e-5)
 
 
+@pytest.mark.slow  # full VGG mesh8 accum compile (~26s) for a
+# finite-loss smoke; accumulation exactness is pinned fast by
+# test_accum_matches_oneshot_exactly and the sharded VGG step compile
+# by test_train.py::test_gspmd_vgg_step_compiles
 def test_accum_with_batchnorm_trains(mesh8):
     """VGG (BatchNorm): per-microbatch stats are a documented semantic
     difference — assert the sharded accum step runs and learns."""

@@ -336,6 +336,15 @@ def test_program_donations_mirror_rules_tables():
         "serve.prefill_paged": "prefill_paged",
         "serve.fused_decode_paged": "fused_paged",
         "serve.fused_decode_paged_stream": "fused_paged",
+        # On-device speculation: fused window + tree-verify programs
+        # (dense and paged twins) donate the target arena/pool + obs
+        # counters; the draft KV is loop-carry scratch with no row.
+        "serve.fused_spec_decode": "fused_spec_step",
+        "serve.fused_spec_decode_stream": "fused_spec_step",
+        "serve.fused_spec_paged": "fused_spec_paged",
+        "serve.fused_spec_paged_stream": "fused_spec_paged",
+        "serve.tree_verify": "tree_step",
+        "serve.tree_verify_paged": "tree_paged",
         "prefix.copy_block_in": "copy_block_in",
         "prefix.copy_block_out": "copy_block_out",
         "train.step_single": "train_step",

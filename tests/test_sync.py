@@ -87,6 +87,9 @@ def vgg_fp32_ref(mesh8):
     return model, tx, x, y, float(loss)
 
 
+@pytest.mark.slow  # end-to-end VGG convergence (~40s with the shared
+# fixture); the wire numerics are pinned fast by
+# test_allreduce_bf16_approximates_mean + test_strategies_produce_mean
 def test_allreduce_bf16_trains_like_fp32(mesh8, vgg_fp32_ref):
     """End to end: the compressed rung follows the fp32 trajectory closely
     enough to train (loose tolerance — wire precision, not exactness)."""
@@ -232,6 +235,9 @@ def test_allreduce_int8_no_wraparound_on_identical_grads(nsub):
     np.testing.assert_allclose(w, 1.0, rtol=1e-6)
 
 
+@pytest.mark.slow  # end-to-end VGG convergence (~22s); the int8 wire
+# numerics are pinned fast by test_allreduce_int8_approximates_mean +
+# test_allreduce_int8_no_wraparound_on_identical_grads
 def test_allreduce_int8_trains_like_fp32(mesh8, vgg_fp32_ref):
     """End to end: the int8 rung trains (looser than bf16 — 8-bit wire).
     Shares the fp32 reference trajectory with the bf16 test (r4 #8)."""

@@ -107,6 +107,20 @@ SERVE_PAGED_WORKLOADS = ("shared_prefix",)
 # (dispatch_ok: <= 1/N x 1.25) — a fused run that dispatched per token
 # proved the loop never engaged.  N=1 is the single-step control row.
 SERVE_FUSED_NS = (1, 4, 8)
+# On-device fused speculation configs (serve_bench.py --spec-fused:
+# ONE lax.while_loop program per dispatch runs up to N iterations of
+# [k draft-model forwards + one k+1-wide verify + rejection sampling],
+# draft KV living in its own in-carry arena — the draft never leaves
+# the device).  Each config name is "k{K}n{N}".  A config closes only
+# when the fused-spec engine measured something (tokens/sec > 0), its
+# greedy outputs were bit-identical to BOTH referees — the host-drafted
+# speculative engine and the plain fused engine — AND its sampled
+# outputs matched the host-drafted engine under identical per-slot PRNG
+# chains (parity_ok), and the full gate held (spec_fused_ok: the fused
+# window actually engaged and tokens/sec >= max(host-drafted spec,
+# plain fused) — on-device speculation that loses to either baseline
+# proved the fusion isn't paying for itself).
+SERVE_SPEC_FUSED_CONFIGS = ("k2n4", "k4n8")
 # Fault-injection soak seeds (serve_bench.py --soak: random cancels,
 # deadline mix, injected drafter/step faults — and, since the tenancy
 # PR, a deterministic preemption storm — against the serve engine's
@@ -341,6 +355,50 @@ def serve_fused_missing(d: str) -> list[int]:
     return [n for n in SERVE_FUSED_NS if n not in done]
 
 
+def serve_spec_fused_missing(d: str) -> list[str]:
+    """On-device fused-speculation configs still lacking a real TPU
+    measurement.  A row closes its config only when it measured
+    something (tokens/sec > 0), held bit-exact parity against both
+    referees (``parity_ok`` — greedy vs host-drafted spec AND plain
+    fused; sampled vs host-drafted under the same PRNG chains), and
+    passed the full gate (``spec_fused_ok`` — the fused window engaged
+    and tokens/sec >= max of both baselines).  CPU smoke and error rows
+    never close a config (same rules as serve_missing).  Comma-ready
+    for SERVE_SPEC_FUSED so a window resumes the sweep mid-way."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_spec_fused.jsonl")):
+        if (r.get("metric") == "serve_spec_fused"
+                and r.get("config") in SERVE_SPEC_FUSED_CONFIGS
+                and measured(r)
+                and r.get("parity_ok") is True
+                and r.get("spec_fused_ok") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["config"])
+    return [c for c in SERVE_SPEC_FUSED_CONFIGS if c not in done]
+
+
+def stale_tpu_rows(d: str) -> list[str]:
+    """Named ``stale-tpu-row`` gap: result files whose CURRENT artifact
+    is a banked last-known-good re-emission rather than a fresh
+    measurement.  A re-emitted row is honest (it carries ``source:
+    last_known_good``, ``fresh: false`` and ``stale_since`` — the
+    capture timestamp it was banked at) but it is still STALE evidence,
+    and the watcher must keep treating the stage as owed instead of
+    silently re-dating the old number.  Scans the files themselves (not
+    the history twins — banked history is supposed to be old)."""
+    stale = []
+    for fname in ("bench.json", "bench_bf16.json"):
+        path = os.path.join(d, fname)
+        try:
+            with open(path) as f:
+                rows = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, json.JSONDecodeError):
+            continue
+        if any(r.get("source") == "last_known_good" for r in rows):
+            stale.append(f"stale-tpu-row:{fname}")
+    return stale
+
+
 def serve_soak_missing(d: str) -> list[int]:
     """Soak seeds still lacking a PASSING real-TPU run.  A soak row
     closes its seed only when it measured something (``value`` =
@@ -545,8 +603,8 @@ ANALYSIS_LINT_PATHS = ("tpudp", "tools", "benchmarks")
 #: metrics sidecar (serve_bench_metrics.json — per-stage
 #: Engine.metrics() snapshots: device counters, span rollups, stats).
 OBS_SIDECAR_STAGES = ("serve.jsonl", "serve_spec.jsonl",
-                      "serve_fused.jsonl", "serve_prefix.jsonl",
-                      "serve_paged.jsonl")
+                      "serve_fused.jsonl", "serve_spec_fused.jsonl",
+                      "serve_prefix.jsonl", "serve_paged.jsonl")
 OBS_SIDECAR_NAME = "serve_bench_metrics.json"
 
 
@@ -621,12 +679,13 @@ def main() -> None:
     p.add_argument("stage", choices=["matrix", "flash", "epoch", "mfu",
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_fused",
+                                     "serve_spec_fused",
                                      "serve_soak", "serve_prefix",
                                      "serve_paged", "serve_paged_kernel",
                                      "serve_tenancy",
                                      "train_soak",
                                      "train_soak_multihost", "analysis",
-                                     "obs"])
+                                     "obs", "stale"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
     if args.stage == "matrix":
@@ -643,6 +702,10 @@ def main() -> None:
     elif args.stage == "serve_fused":
         print(",".join(str(n) for n in serve_fused_missing(args.dir)),
               end="")
+    elif args.stage == "serve_spec_fused":
+        print(",".join(serve_spec_fused_missing(args.dir)), end="")
+    elif args.stage == "stale":
+        print(",".join(stale_tpu_rows(args.dir)), end="")
     elif args.stage == "serve_soak":
         print(",".join(str(s) for s in serve_soak_missing(args.dir)),
               end="")

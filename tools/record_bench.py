@@ -56,8 +56,14 @@ def main() -> None:
     head = next(iter(heads.values()), None)
     if head:
         if head.get("source") == "last_known_good":
+            # Never silently re-date stale evidence: the stale_since
+            # marker (the banked row's own capture timestamp) renders
+            # explicitly, and bench_gaps' `stale` stage reports the
+            # matching named stale-tpu-row gap off the same artifact.
             print(f"| (headline row is a banked last-known-good re-emission "
-                  f"from {head.get('measured_at_utc')}) | | | |")
+                  f"— STALE since "
+                  f"{head.get('stale_since', head.get('measured_at_utc'))})"
+                  f" | | | |")
         if head.get("value", 0) > 0:
             sec = head.get("sec_per_step")
             sec_s = f"{sec * 1e3:.2f} ms/step, " if sec is not None else ""
@@ -233,6 +239,32 @@ def main() -> None:
                   f"{r.get('host_dispatches_per_token')} host dispatches "
                   f"per token vs 1.0, parity intact) | "
                   f"`serve_bench.py --decode-fuse` | |")
+
+    # On-device fused-speculation rows render pass/fail on the same
+    # criteria as bench_gaps.serve_spec_fused_missing (parity against
+    # BOTH referees + the full spec_fused_ok gate), so recorder and
+    # gate can't disagree.
+    sf = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_spec_fused.jsonl"))
+         if "config" in r and "serve_spec_fused" not in r), "config")
+    for r in sorted(sf.values(), key=lambda r: str(r.get("config"))):
+        if (not measured(r) or r.get("parity_ok") is not True
+                or r.get("spec_fused_ok") is not True):
+            why = r.get("error") or (
+                "parity broken" if r.get("parity_ok") is False
+                else "lost to a baseline or never engaged"
+                if r.get("spec_fused_ok") is False
+                else "no real measurement")
+            print(f"| serve_spec_fused {r.get('config')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --spec-fused` | |")
+        else:
+            print(f"| on-device fused speculation {r['config']} "
+                  f"(ceiling workload, c={r.get('concurrency')}) | "
+                  f"**{r['value']:,} tokens/sec** "
+                  f"({r.get('speedup_vs_host_spec')}x host-drafted spec, "
+                  f"{r.get('speedup_vs_plain_fused')}x plain fused, "
+                  f"acceptance {r.get('accept_rate')}, parity intact) | "
+                  f"`serve_bench.py --spec-fused` | |")
 
     # Prefix-caching rows: TTFT with the block-pool cache on vs off on
     # the shared-prefix / multi-turn workloads, plus the hit accounting
@@ -478,6 +510,7 @@ STAGE_FILES = {
     "flash": "flash.jsonl", "collective": "collective.jsonl",
     "serve": "serve.jsonl", "serve_spec": "serve_spec.jsonl",
     "serve_fused": "serve_fused.jsonl",
+    "serve_spec_fused": "serve_spec_fused.jsonl",
     "serve_prefix": "serve_prefix.jsonl",
     "serve_paged": "serve_paged.jsonl",
     "serve_soak": "serve_soak.jsonl",

@@ -95,6 +95,10 @@ serve_fused_ok() {
   local out; out=$(python tools/bench_gaps.py serve_fused) || return 1
   [ -z "$out" ]
 }
+serve_spec_fused_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_spec_fused) || return 1
+  [ -z "$out" ]
+}
 serve_soak_ok() {
   local out; out=$(python tools/bench_gaps.py serve_soak) || return 1
   [ -z "$out" ]
@@ -387,6 +391,23 @@ while true; do
         > bench_results/serve_fused.jsonl 2> bench_results/serve_fused.err
       log "serve_fused_bench rc=$? -> bench_results/serve_fused.jsonl"
     fi
+    if serve_spec_fused_ok; then
+      log "serve_spec_fused.jsonl already good; skipping fused-speculation bench"
+    else
+      # On-device fused speculation (ONE lax.while_loop program fusing
+      # k draft-model forwards + the k+1-wide verify + rejection
+      # sampling per iteration, Engine(speculate_k=K, decode_fuse=N,
+      # drafter=DraftModelDrafter)): tokens/sec vs BOTH the
+      # host-drafted speculative engine and the plain fused engine at
+      # identical geometry — resumes at config granularity via
+      # bench_gaps, like the serve_spec stage.
+      bank bench_results/serve_spec_fused.jsonl
+      ensure_window
+      SERVE_SPEC_FUSED="$(python tools/bench_gaps.py serve_spec_fused)" \
+        timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/serve_bench.py \
+        > bench_results/serve_spec_fused.jsonl 2> bench_results/serve_spec_fused.err
+      log "serve_spec_fused_bench rc=$? -> bench_results/serve_spec_fused.jsonl"
+    fi
     if serve_prefix_ok; then
       log "serve_prefix.jsonl already good; skipping prefix-cache bench"
     else
@@ -524,6 +545,7 @@ PYEOF
     # e.g. per-stage timeout — must not end the watch with gaps).
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
         && lever_ok && collective_ok && serve_ok && serve_spec_ok \
+        && serve_fused_ok && serve_spec_fused_ok \
         && serve_soak_ok && serve_prefix_ok && serve_paged_ok \
         && serve_tenancy_ok \
         && train_soak_ok && train_soak_multihost_ok; then

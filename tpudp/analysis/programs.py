@@ -62,6 +62,10 @@ TRACE_COUNTER_PROGRAMS = {
     "verify_paged": "serve.verify_paged",
     "prefill_paged": "serve.prefill_paged",
     "fused_decode_paged": "serve.fused_decode_paged",
+    "fused_spec_decode": "serve.fused_spec_decode",
+    "fused_spec_paged": "serve.fused_spec_paged",
+    "tree_verify": "serve.tree_verify",
+    "tree_verify_paged": "serve.tree_verify_paged",
     "prefix_block_in": "prefix.copy_block_in",
     "prefix_block_out": "prefix.copy_block_out",
     "draft_model": "serve.draft_model",
@@ -90,6 +94,18 @@ PROGRAM_DONATIONS = {
     "serve.prefill_paged": (0,),
     "serve.fused_decode_paged": (0, 12),
     "serve.fused_decode_paged_stream": (0, 12),
+    # On-device speculation (Engine(speculate_k=k, decode_fuse=N,
+    # drafter=DraftModelDrafter(...))): the fused draft→verify→accept
+    # while_loop donates the target arena/pool and the counters — the
+    # draft model's KV arena is carry-local scratch, never an argument.
+    # The tree-verify window donates like verify_step (its paged twin's
+    # accepted-path commit is what makes rejected branches zero-write).
+    "serve.fused_spec_decode": (0, 12),
+    "serve.fused_spec_decode_stream": (0, 12),
+    "serve.fused_spec_paged": (0, 13),
+    "serve.fused_spec_paged_stream": (0, 13),
+    "serve.tree_verify": (0, 9),
+    "serve.tree_verify_paged": (0, 10),
     "serve.sample_row": (),
     "serve.draft_model": (),
     "prefix.copy_block_in": (0,),
@@ -112,6 +128,16 @@ PROGRAM_DONATIONS = {
 SERVE = dict(vocab=64, seq=64, layers=2, heads=2, d_model=32,
              slots=2, max_len=32, chunk=8, k=3, blocks=4, fuse=4,
              pages=6)
+# Draft-model smoke geometry for the fused speculative programs: a
+# 1-layer model whose max_seq_len covers max_len + k (the Engine
+# eligibility bound `dcfg.max_seq_len >= max_len + speculate_k`), its
+# weights frozen into the fused program next to the target's.
+DRAFT = dict(vocab=64, seq=64, layers=1, heads=2, d_model=16)
+# Tree-verify smoke shape: fork2x2 (last token at node 0 → two branches
+# of depth 2) — the smallest registered shape whose attention mask
+# actually diverges (node 3 must NOT see nodes 1/2), matching
+# tpudp.serve.speculate.TREE_SHAPES["fork2x2"].
+TREE_PARENTS = (-1, 0, 1, 0, 3)
 # Train smoke geometry: a tiny conv-free net over 8x8x3 inputs on the
 # 8-virtual-device CPU mesh the tier-1 suite runs on.
 TRAIN = dict(input=(8, 8, 3), classes=4, batch=8, devices=8)
@@ -132,6 +158,21 @@ def _tiny_lm():
     return cfg, params
 
 
+def _tiny_draft():
+    import jax
+    import jax.numpy as jnp
+
+    from tpudp.models.gpt2 import GPT2, GPT2Config
+
+    cfg = GPT2Config(vocab_size=DRAFT["vocab"], max_seq_len=DRAFT["seq"],
+                     num_layers=DRAFT["layers"], num_heads=DRAFT["heads"],
+                     d_model=DRAFT["d_model"])
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+    return cfg, params
+
+
 def _serve_args():
     import jax.numpy as jnp
     import numpy as np
@@ -148,6 +189,8 @@ def _serve_args():
         keys=jnp.zeros((s, 2), jnp.uint32),
         window=np.zeros((s, k + 1), np.int32),
         ndraft=np.zeros(s, np.int32),
+        hist=np.zeros((s, m), np.int32),
+        tree=np.zeros((s, len(TREE_PARENTS)), np.int32),
         chunk=np.zeros((1, SERVE["chunk"]), np.int32),
         budgets=np.zeros(s, np.int32),
         eos=np.full(s, -1, np.int32),
@@ -173,8 +216,11 @@ def build_programs() -> dict:
     from tpudp.serve import engine as _engine
 
     cfg, params, cache, h = _serve_args()
-    (decode, verify, prefill, fused, decode_paged, verify_paged,
-     prefill_paged, fused_paged) = _engine._build_steps(cfg, params)
+    dcfg, dparams = _tiny_draft()
+    (decode, verify, prefill, fused, fused_spec, tree_verify,
+     decode_paged, verify_paged, prefill_paged, fused_paged,
+     fused_spec_paged, tree_paged) = _engine._build_steps(
+        cfg, params, draft=(dcfg, dparams))
     geo = f"s{SERVE['slots']}m{SERVE['max_len']}"
     programs[f"serve.decode_step@{geo}"] = (
         decode, (cache, h["last"], h["lens"], h["active"], h["temps"],
@@ -202,6 +248,32 @@ def build_programs() -> dict:
     programs[f"serve.fused_decode_stream@{geo}n{SERVE['fuse']}"] = (
         functools.partial(fused, n_steps=SERVE["fuse"], stream=True),
         fused_args)
+    # On-device speculation (ISSUE 16): the fused draft→verify→accept
+    # while_loop — both drafters' weights frozen in, the slot histories
+    # in, k+1-wide verify windows and per-slot PRNG chains advanced
+    # in-carry.  Pinned in BOTH stream variants like the plain fused
+    # window: a new host callback inside the speculative loop (the
+    # regression class this whole program deletes) fails the audit by
+    # name.
+    spec_args = (cache, h["hist"], h["last"], h["lens"], h["active"],
+                 h["temps"], h["topk"], h["topp"], h["keys"],
+                 h["budgets"], h["eos"], np.int32(-1), h["counts"])
+    sgeo = f"{geo}k{SERVE['k']}n{SERVE['fuse']}"
+    programs[f"serve.fused_spec_decode@{sgeo}"] = (
+        functools.partial(fused_spec, n_draft_k=SERVE["k"],
+                          n_steps=SERVE["fuse"], stream=False), spec_args)
+    programs[f"serve.fused_spec_decode_stream@{sgeo}"] = (
+        functools.partial(fused_spec, n_draft_k=SERVE["k"],
+                          n_steps=SERVE["fuse"], stream=True), spec_args)
+    # The speculative TREE window (Engine(speculate_tree=...)): one
+    # tree-masked forward over fork2x2's five nodes, accepted-path-only
+    # commit.  The parents tuple is static (part of the compile key and
+    # the lock identity, like n_steps on the fused window).
+    tgeo = f"{geo}t{len(TREE_PARENTS)}"
+    tree_args = (cache, h["tree"], h["lens"], h["active"], h["ndraft"],
+                 h["temps"], h["topk"], h["topp"], h["keys"], h["counts"])
+    programs[f"serve.tree_verify@{tgeo}"] = (
+        functools.partial(tree_verify, parents=TREE_PARENTS), tree_args)
     # Paged twins (Engine(kv_pages=N)): same math read through per-slot
     # block tables into ONE shared page pool (+1 trailing scratch page)
     # — since the gather-free rework, THROUGH the table inside the
@@ -242,6 +314,27 @@ def build_programs() -> dict:
     programs[f"serve.fused_decode_paged_stream@{pgeo2}n{SERVE['fuse']}"] = (
         functools.partial(fused_paged, n_steps=SERVE["fuse"], stream=True),
         fused_paged_args)
+    # Paged speculative twins: same fused draft/verify/accept carry and
+    # tree-verify math through the block-table indirection — the tree
+    # twin's accepted-path commit is the zero-write-on-reject claim the
+    # byte-diff test pins, so its trace (and any new transfer in it) is
+    # locked here.
+    spec_paged_args = (
+        pool, table, h["hist"], h["last"], h["lens"], h["active"],
+        h["temps"], h["topk"], h["topp"], h["keys"], h["budgets"],
+        h["eos"], np.int32(-1), h["counts"])
+    programs[f"serve.fused_spec_paged@{pgeo2}k{SERVE['k']}n{SERVE['fuse']}"] = (
+        functools.partial(fused_spec_paged, n_draft_k=SERVE["k"],
+                          n_steps=SERVE["fuse"], stream=False),
+        spec_paged_args)
+    programs[f"serve.fused_spec_paged_stream@{pgeo2}k{SERVE['k']}n{SERVE['fuse']}"] = (
+        functools.partial(fused_spec_paged, n_draft_k=SERVE["k"],
+                          n_steps=SERVE["fuse"], stream=True),
+        spec_paged_args)
+    programs[f"serve.tree_verify_paged@{pgeo2}t{len(TREE_PARENTS)}"] = (
+        functools.partial(tree_paged, parents=TREE_PARENTS),
+        (pool, table, h["tree"], h["lens"], h["active"], h["ndraft"],
+         h["temps"], h["topk"], h["topp"], h["keys"], h["counts"]))
     # The Pallas paged-decode kernel twin (Engine(paged_attn='kernel')):
     # same signature/donations as serve.decode_paged, but the attention
     # contraction is the online-softmax kernel with the table as scalar
@@ -251,7 +344,7 @@ def build_programs() -> dict:
     # traces in interpret mode — host-independent like the rest of the
     # lock.
     decode_paged_kernel = _engine._build_steps(cfg, params,
-                                               paged_attn="kernel")[4]
+                                               paged_attn="kernel")[6]
     programs[f"serve.decode_paged_kernel@{pgeo2}"] = (
         decode_paged_kernel,
         (pool, table, h["last"], h["lens"], h["active"], h["temps"],

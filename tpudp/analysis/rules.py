@@ -29,7 +29,9 @@ HOT_PATHS = {
     "tpudp/serve/engine.py": {
         "Engine.step", "Engine._run_prefill_chunk", "Engine._run_decode",
         "Engine._run_decode_fused", "Engine._run_verify",
-        "Engine._gather_drafts", "Engine._commit",
+        "Engine._run_spec_fused", "Engine._run_verify_tree",
+        "Engine._gather_drafts", "Engine._gather_tree_drafts",
+        "Engine._commit",
     },
     "tpudp/train.py": {
         "Trainer.train_epoch", "Trainer.evaluate",
@@ -49,7 +51,8 @@ DEVICE_ROOTS = {
 DEVICE_CALL_ATTRS = {
     "_device", "train_step", "eval_step", "fwd_step", "decode_step",
     "verify_step", "prefill_step", "fused_step", "decode_paged",
-    "verify_paged", "prefill_paged", "fused_paged", "copy_block_in",
+    "verify_paged", "prefill_paged", "fused_paged", "fused_spec_step",
+    "fused_spec_paged", "tree_step", "tree_paged", "copy_block_in",
     "copy_block_out", "_sample_row",
 }
 
@@ -69,6 +72,12 @@ DONATING = {
     # host-authoritative and uploaded per call).
     "decode_paged": (0, 9), "verify_paged": (0, 10),
     "prefill_paged": (0,), "fused_paged": (0, 12),
+    # On-device speculation (ISSUE 16): the fused speculative window and
+    # the tree-verify window donate the target arena/pool + the obs
+    # counters; the draft model's KV is carry-local scratch, never an
+    # argument, so it has no donation row.
+    "fused_spec_step": (0, 12), "fused_spec_paged": (0, 13),
+    "tree_step": (0, 9), "tree_paged": (0, 10),
 }
 
 #: Pass-through wrappers: ``self._device("kind", fn, *args)`` runs

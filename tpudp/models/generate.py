@@ -515,6 +515,101 @@ def _forward_cached(cfg, params: dict, tokens: jnp.ndarray,
     return logits, KVCache(jnp.stack(new_k), jnp.stack(new_v))
 
 
+def _block_tree(cfg: GPT2Config, p: dict, x: jnp.ndarray,
+                k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                pos0: jnp.ndarray, anc: tuple):
+    """One pre-LN block over a speculative token TREE of ``T+1`` nodes
+    (node 0 = the row's last committed token; see
+    ``tpudp.serve.speculate.TreeShape``) — the NO-WRITE twin of
+    :func:`_block_decode`'s vector-pos path.
+
+    Sibling nodes at one depth share a logical cache position, so the
+    write-then-attend scheme cannot hold them; instead the window K/V
+    stay out of the cache and each node attends the committed cache
+    (positions ``< pos0``, uniform — node 0's own KV is not yet
+    written, exactly like the verify window's first slot) JOINTLY with
+    its in-window ancestors-or-self (``anc``, the shape's static
+    ``(T+1, T+1)`` matrix) under one softmax.  The caller commits the
+    ACCEPTED path's K/V afterwards — rejected branches never touch the
+    cache.  Same op/dtype sequence as :func:`_block_decode` (einsum in
+    ``cfg.dtype``, fp32 softmax), vmapped per node; the joint reduction
+    spans ``max_len + T + 1`` keys, so outputs are tolerance-bounded —
+    not bitwise — against the sequential write-then-attend program
+    (the tree engine's documented opt-in contract)."""
+    b, T1, d = x.shape
+    h = cfg.num_heads
+    dh = d // h
+
+    hN = _layer_norm(p["ln_1"], x, cfg.ln_eps)
+    qkv = _dense(p["attn"]["qkv"], hN, cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, T1, h, dh)
+    k = k.reshape(b, T1, h, dh)
+    v = v.reshape(b, T1, h, dh)
+    max_len = k_cache.shape[1]
+    scale = dh ** -0.5
+    kk = jnp.concatenate([k_cache, k], axis=1)
+    vv = jnp.concatenate([v_cache, v], axis=1)
+    cache_vis = jnp.arange(max_len)[None, :] < pos0[:, None]  # (b, M)
+    anc_m = jnp.asarray(anc, bool)
+
+    def _attend(qj, ancj):  # qj (b, h, dh), ancj (T1,)
+        lg = jnp.einsum("bhd,bkhd->bhk", qj, kk) * scale
+        vis = jnp.concatenate(
+            [cache_vis, jnp.broadcast_to(ancj[None], (b, T1))], axis=1)
+        lg = jnp.where(vis[:, None, :], lg, jnp.finfo(lg.dtype).min)
+        pr = jax.nn.softmax(lg.astype(jnp.float32),
+                            axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bhk,bkhd->bhd", pr, vv)
+
+    out = jax.vmap(_attend, in_axes=(1, 0), out_axes=1)(q, anc_m)
+    x = x + _dense(p["attn"]["proj"], out.reshape(b, T1, d), cfg.dtype)
+
+    hN = _layer_norm(p["ln_2"], x, cfg.ln_eps)
+    m = jax.nn.gelu(_dense(p["mlp_fc"], hN, cfg.dtype))
+    x = x + _dense(p["mlp_proj"], m, cfg.dtype)
+    return x, k, v
+
+
+def _forward_tree(cfg, params: dict, tokens: jnp.ndarray, view: KVCache,
+                  pos0, depths: tuple, anc: tuple):
+    """Tree-verify forward: node tokens ``(batch, T+1)`` (node 0 = each
+    row's last committed token) against a READ-ONLY dense cache view at
+    per-row root positions ``pos0`` -> ``(logits (batch, T+1, vocab),
+    wk, wv)`` where ``wk``/``wv`` ``(layers, batch, T+1, kv_heads, dh)``
+    are the window K/V the caller commits for accepted nodes only.
+
+    ``depths``/``anc`` are the static shape tables
+    (``TreeShape.depths``/``.ancestors``); node positions decouple from
+    storage — GPT-2's learned embeddings and LLaMA's RoPE both rotate
+    at ``pos0 + depth`` while the window K/V never enter the cache
+    (:func:`_block_tree` / ``llama.block_tree``).  The cache view is
+    NOT returned: this forward writes nothing, which is what makes
+    rejected tree branches literally free."""
+    from tpudp.models import llama as _llama
+
+    pos0 = jnp.asarray(pos0)
+    positions = pos0[:, None] + jnp.asarray(depths, jnp.int32)[None, :]
+    is_llama = isinstance(cfg, _llama.LlamaConfig)
+    if is_llama:
+        x = _llama.embed_tokens(cfg, params, tokens)
+    else:
+        x = embed_tokens(cfg, params, tokens, positions)
+    wk, wv = [], []
+    for i in range(cfg.num_layers):
+        if is_llama:
+            x, k_i, v_i = _llama.block_tree(
+                cfg, params[f"h_{i}"], x, view.k[i], view.v[i], pos0,
+                positions, anc)
+        else:
+            x, k_i, v_i = _block_tree(cfg, params[f"h_{i}"], x,
+                                      view.k[i], view.v[i], pos0, anc)
+        wk.append(k_i)
+        wv.append(v_i)
+    head = _llama.lm_head if is_llama else lm_head
+    return head(cfg, params, x), jnp.stack(wk), jnp.stack(wv)
+
+
 def validate_decode_config(cfg, fn_name: str) -> None:
     """Reject configs the raw-param decode twins cannot serve faithfully.
 

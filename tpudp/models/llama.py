@@ -302,6 +302,61 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     return x, k_cache, v_cache
 
 
+def block_tree(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
+               k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+               pos0: jnp.ndarray, positions: jnp.ndarray, anc: tuple):
+    """One LLaMA block over a speculative token TREE of ``T+1`` nodes —
+    the NO-WRITE twin of :func:`block_decode`'s per-row path (see
+    ``generate._block_tree`` for the scheme).  Sibling nodes share a
+    logical position, so the window K/V never enter the cache: each
+    node attends the committed cache (positions ``< pos0``) jointly
+    with its in-window ancestors-or-self (``anc``, the tree shape's
+    static ``(T+1, T+1)`` matrix) under one fp32 softmax.  RoPE rotates
+    q/k at ``positions = pos0 + depth`` — node positions decouple from
+    storage.  Returns ``(x, k_win, v_win)``; the caller commits the
+    accepted path's K/V only."""
+    b, T1, d = x.shape
+    h, kv = cfg.num_heads, cfg.kv_heads
+    dh = d // h
+    max_len = k_cache.shape[1]
+
+    hN = _rms(p["rms_attn"], x, cfg.rms_eps)
+    attn = p["attn"]
+    q = apply_rope(_dense_nb(attn["wq"], hN, cfg.dtype).reshape(b, T1, h,
+                                                                dh),
+                   positions, cfg.rope_theta)
+    k = apply_rope(_dense_nb(attn["wk"], hN, cfg.dtype).reshape(b, T1, kv,
+                                                                dh),
+                   positions, cfg.rope_theta)
+    v = _dense_nb(attn["wv"], hN, cfg.dtype).reshape(b, T1, kv, dh)
+
+    g = h // kv
+    qg = q.reshape(b, T1, kv, g, dh)
+    scale = dh ** -0.5
+    kk = jnp.concatenate([k_cache, k], axis=1)  # (b, max_len + T1, kv, dh)
+    vv = jnp.concatenate([v_cache, v], axis=1)
+    cache_vis = jnp.arange(max_len)[None, :] < pos0[:, None]  # (b, M)
+    anc_m = jnp.asarray(anc, bool)
+
+    def _attend(qj, ancj):  # qj (b, kv, g, dh), ancj (T1,)
+        lg = jnp.einsum("bkgd,bmkd->bkgm", qj, kk) * scale
+        vis = jnp.concatenate(
+            [cache_vis, jnp.broadcast_to(ancj[None], (b, T1))], axis=1)
+        lg = jnp.where(vis[:, None, None, :], lg, jnp.finfo(lg.dtype).min)
+        pr = jax.nn.softmax(lg.astype(jnp.float32),
+                            axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bkgm,bmkd->bkgd", pr, vv)
+
+    out = jax.vmap(_attend, in_axes=(1, 0), out_axes=1)(qg, anc_m)
+    x = x + _dense_nb(attn["wo"], out.reshape(b, T1, d), cfg.dtype)
+
+    hN = _rms(p["rms_mlp"], x, cfg.rms_eps)
+    gate = nn.silu(_dense_nb(p["gate"], hN, cfg.dtype))
+    x = x + _dense_nb(p["down"],
+                      gate * _dense_nb(p["up"], hN, cfg.dtype), cfg.dtype)
+    return x, k, v
+
+
 class Llama(nn.Module):
     """Decoder-only LM: ``(B, T) int tokens -> (B, T, vocab) fp32 logits``.
 

@@ -218,6 +218,119 @@ def verify_tokens(logits: jnp.ndarray, draft: jnp.ndarray,
                     None)
 
 
+def tree_depths(parents: tuple) -> tuple:
+    """Static depth per tree node from a static ``parents`` tuple
+    (``parents[0] == -1`` for the root; ``parents[j] < j`` — nodes are
+    topologically ordered).  Plain Python: runs at trace time only."""
+    depths = []
+    for j, p in enumerate(parents):
+        if j == 0:
+            if p != -1:
+                raise ValueError("parents[0] must be -1 (the root)")
+            depths.append(0)
+            continue
+        if not 0 <= p < j:
+            raise ValueError(
+                f"parents[{j}] must be in [0, {j}) (topological order), "
+                f"got {p}")
+        depths.append(depths[p] + 1)
+    return tuple(depths)
+
+
+def verify_tree_tokens(logits: jnp.ndarray, cand: jnp.ndarray,
+                       parents: tuple, n_cand: jnp.ndarray,
+                       temperature: jnp.ndarray, top_k: jnp.ndarray,
+                       top_p: jnp.ndarray, keys: jnp.ndarray):
+    """Accept/reject a speculative token TREE per row; emit one
+    root-to-leaf path's tokens.
+
+    The tree generalizes :func:`verify_tokens`'s single draft sequence
+    to a static shape of candidate branches scored by ONE forward:
+    ``parents`` (a static tuple, ``parents[0] == -1``) names each
+    node's parent; node 0 is the row's last committed token and nodes
+    ``1..T`` are candidates whose tokens sit in ``cand`` ``(n, T)``.
+    ``logits`` ``(n, T+1, vocab)`` are the target model's logits at
+    every node (node ``j`` predicts the token AFTER node ``j``);
+    ``n_cand`` rows with 0 run the plain no-draft decode.
+
+    Walking from the root, each node's children are tried in node-index
+    order.  Greedy rows accept the first child matching the current
+    node's argmax — on a chain-shaped tree this is bit-identical to
+    :func:`verify_tokens`'s greedy rule.  Sampled rows run sequential
+    multi-candidate rejection sampling (the SpecInfer rule with
+    point-mass proposals): accept child ``c`` with probability
+    ``p(c)``; on rejection zero ``c``'s mass out of the residual and
+    try the next sibling; when no child survives, the final token draws
+    from the last residual with the row's window subkey ITSELF — so a
+    no-candidate row samples bit-identically to the plain decode step,
+    and on a chain the whole procedure is bit-identical to
+    :func:`verify_tokens`.  Distribution-preserving either way.
+
+    Returns ``(tokens (n, D+1) int32, n_emitted (n,) int32, path
+    (n, D+1) int32)`` where ``D`` is the tree's max depth: the row
+    emits ``tokens[:n_emitted]`` (``n_emitted - 1`` accepted candidates
+    plus the final correction/bonus token) and ``path[d]`` is the
+    accepted NODE id at depth ``d`` (``path[0] == 0``) — the caller
+    commits exactly those nodes' KV.
+    """
+    n, Tp1, v = logits.shape
+    T = Tp1 - 1
+    depths = tree_depths(parents)
+    W = max(depths) + 1
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (n, T+1)
+    sampled = temperature > 0
+    safe_t = jnp.where(sampled, temperature, 1.0)[:, None, None]
+    scaled = logits / safe_t
+    any_trunc = jnp.any(sampled & ((top_k > 0) | (top_p < 1.0)))
+    kw = jnp.broadcast_to(top_k[:, None], (n, Tp1))
+    pw = jnp.broadcast_to(top_p[:, None], (n, Tp1))
+    masked = lax.cond(any_trunc,
+                      lambda s: truncate_logits(s, kw, pw),
+                      lambda s: s, scaled)
+    # Acceptance uniforms: the window subkey's split children, one per
+    # candidate node, in node order — on a chain this is exactly
+    # verify_tokens' schedule (split(key, k), uniform per slot).
+    subs = jax.vmap(lambda key: jax.random.split(key, max(T, 1)))(keys)
+    u = jax.vmap(jax.vmap(jax.random.uniform))(subs[:, :T])  # (n, T)
+
+    cur = jnp.zeros((n,), jnp.int32)          # current path node
+    acc_d = jnp.zeros((n,), jnp.int32)        # accepted depth so far
+    res = masked[:, 0]                        # residual logits at cur
+    out = jnp.zeros((n, W), jnp.int32)
+    path = jnp.zeros((n, W), jnp.int32)
+    for j in range(1, T + 1):                 # static unroll (small T)
+        pj, dj = parents[j], depths[j]
+        tok = cand[:, j - 1]
+        # Node j is in play iff the walk currently sits at its parent
+        # (an accepted sibling moved `cur` past it; a deeper walk never
+        # returns) and the row drafted this node.
+        at = (cur == pj) & (j - 1 < n_cand)
+        tgt = jnp.take_along_axis(targets, cur[:, None], axis=1)[:, 0]
+        p_tok = jnp.take_along_axis(jax.nn.softmax(res, axis=-1),
+                                    tok[:, None], axis=1)[:, 0]
+        acc = at & jnp.where(sampled, u[:, j - 1] < p_tok, tok == tgt)
+        rej = at & ~acc
+        cur = jnp.where(acc, j, cur)
+        acc_d = jnp.where(acc, dj, acc_d)
+        out = out.at[:, dj - 1].set(jnp.where(acc, tok, out[:, dj - 1]))
+        path = path.at[:, dj].set(jnp.where(acc, j, path[:, dj]))
+        # Accept: the residual resets to the child's own distribution.
+        # Reject: the sibling's mass is zeroed out of the residual (the
+        # renormalized max(p - q, 0) of a point-mass proposal) before
+        # the next sibling — or the final draw — is tried.
+        res = jnp.where(
+            acc[:, None], masked[:, j],
+            jnp.where(rej[:, None] & (jnp.arange(v)[None, :]
+                                      == tok[:, None]),
+                      -jnp.inf, res))
+    final_g = jnp.take_along_axis(targets, cur[:, None], axis=1)[:, 0]
+    drawn = jax.vmap(jax.random.categorical)(keys, res)
+    final = jnp.where(sampled, drawn.astype(jnp.int32), final_g)
+    fin_col = jnp.arange(W)[None, :] == acc_d[:, None]
+    out = jnp.where(fin_col, final[:, None], out)
+    return out, (acc_d + 1).astype(jnp.int32), path
+
+
 def split_keys(keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Split ``(n, 2)`` uint32 keys row-wise into (carry, subkey) pairs.
 

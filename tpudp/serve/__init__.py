@@ -9,11 +9,12 @@ from tpudp.serve.engine import (TRACE_COUNTS, Engine, EngineClosed,
                                 FinishReason, QueueFull, Request,
                                 RequestFailed)
 from tpudp.serve.prefix_cache import PageIndex, PagePool, PrefixCache
-from tpudp.serve.speculate import Drafter, DraftModelDrafter, NgramDrafter
+from tpudp.serve.speculate import (TREE_SHAPES, Drafter, DraftModelDrafter,
+                                   NgramDrafter, TreeShape, tree_shape)
 from tpudp.serve.tenancy import TenantClass, TenantScheduler
 
 __all__ = ["Engine", "Request", "TRACE_COUNTS", "Drafter",
            "DraftModelDrafter", "NgramDrafter", "FinishReason",
            "PageIndex", "PagePool", "PrefixCache", "QueueFull",
            "EngineClosed", "RequestFailed", "TenantClass",
-           "TenantScheduler"]
+           "TenantScheduler", "TreeShape", "TREE_SHAPES", "tree_shape"]

@@ -227,9 +227,14 @@ import numpy as np
 from jax import lax
 
 from tpudp.models.generate import (KVCache, _forward_cached,
-                                   _forward_paged, validate_decode_config)
+                                   _forward_paged, _forward_tree,
+                                   _layer_pages, _stack_pages,
+                                   gather_pages, update_cache_rows,
+                                   validate_decode_config,
+                                   write_token_pages)
 from tpudp.obs import FlightRecorder, Recorder
-from tpudp.ops.sampling import sample_tokens, split_keys, verify_tokens
+from tpudp.ops.sampling import (sample_tokens, split_keys, tree_depths,
+                                verify_tokens, verify_tree_tokens)
 from tpudp.utils.compile_cache import ProgramCache
 
 # Trace-time side-effect counters: each jitted step body bumps its entry
@@ -442,9 +447,203 @@ def _fused_decode_math(forward, state, last_tokens, lengths, active,
     return state, out, n_emit, keys, iters, counts
 
 
-def _build_steps(cfg, params, paged_attn: str = "einsum"):
+def _fused_spec_math(forward, draft_cfg, draft_params, state, hist,
+                     last_tokens, lengths, active, temps, top_k, top_p,
+                     keys, budgets, eos_ids, ring_id, counts, *,
+                     n_draft_k, n_steps, stream):
+    """The ONE fused speculative-decode ``lax.while_loop`` shared by the
+    dense and paged programs: each iteration drafts ``n_draft_k`` greedy
+    tokens per running slot WITH THE DRAFT MODEL ON DEVICE, scores the
+    ``k+1`` window with one batched verify forward, and runs the
+    rejection-sampling accept/commit inside the carry — the host round
+    trip per window (``_run_verify``'s draft gather + verify fetch)
+    collapses to one fetch per up-to-``n_steps``-window program.
+
+    The drafter math replicates ``speculate._draft_greedy`` batched over
+    slots: an UNCACHED prefill of the ``(slots, hist_w)`` token history
+    (pads behind the causal mask — contributing exact zeros — like the
+    host drafter's padded bucket), then ``n_draft_k`` cached greedy
+    steps.  The draft KV lives in its own arena INSIDE THE CARRY
+    (``hist_w + k`` wide, the host drafter's exact ``bucket + k``
+    geometry so a ``DraftModelDrafter(bucket=max_len)`` referee drafts
+    bit-identically), zeroed at each window's re-prefill exactly as the
+    host drafter recomputes per propose.  The PRNG discipline is
+    ``_verify_math``'s verbatim: one split per window, subkey consumed
+    by :func:`verify_tokens`, carry committed only for rows still
+    running — so greedy AND sampled streams are bit-identical to the
+    host-drafted engine's under identical chains (the parity oracle).
+    The committed tokens scatter back into ``hist`` so the next window
+    drafts from the grown context, again matching the host drafter.
+
+    Per-row truncation mirrors the host replay: a window's emissions cut
+    at the first EOS and at the remaining budget, the row's length/last/
+    chain freeze when it stops, and the loop exits early once no row
+    runs — the returned carry equals having run ``n_windows[s]`` verify
+    steps per slot, which is the fall-back seam to ``_run_verify``.
+    """
+    n_slots, hist_w = hist.shape
+    W = n_draft_k + 1
+    out0 = jnp.zeros((n_slots, n_steps * W), jnp.int32)
+    zeros_i = jnp.zeros((n_slots,), jnp.int32)
+
+    def cond(carry):
+        (i, _state, _hist, _last, _lens, running, _keys, _out, _n_emit,
+         _n_win, _n_acc, _counts) = carry
+        return (i < n_steps) & jnp.any(running)
+
+    def body(carry):
+        (i, state, hist, last, lens, running, keys, out, n_emit, n_win,
+         n_acc, counts) = carry
+        carry_keys, sub = split_keys(keys)
+        # -- draft: k greedy tokens per slot from the draft model (the
+        # batched _draft_greedy), re-prefilled from hist each window.
+        dcache = KVCache.zeros(draft_cfg, n_slots, hist_w + n_draft_k)
+        dlogits, dcache = _forward_cached(draft_cfg, draft_params, hist,
+                                          dcache, 0)
+        dlast = jax.vmap(lambda l, n: lax.dynamic_index_in_dim(
+            l, n, axis=0, keepdims=False))(dlogits, lens)
+
+        def dstep(dc, j):
+            dcache, dlast = dc
+            tok = jnp.argmax(dlast, axis=-1).astype(jnp.int32)
+            lg, dcache = _forward_cached(draft_cfg, draft_params,
+                                         tok[:, None], dcache,
+                                         lens + 1 + j)
+            return (dcache, lg[:, 0]), tok
+
+        _, drafts_t = lax.scan(dstep, (dcache, dlast),
+                               jnp.arange(n_draft_k))
+        drafts = drafts_t.T  # (n_slots, k)
+
+        # -- verify: the k+1 window through the TARGET forward + the
+        # shared rejection-sampling op (the _verify_math body inline).
+        window = jnp.concatenate([last[:, None], drafts], axis=1)
+        logits, state = forward(state, window, lens, running)
+        nd = jnp.where(running, n_draft_k, 0)
+        toks, n_w = verify_tokens(logits, drafts, nd, temps, top_k,
+                                  top_p, sub)
+        keys = jnp.where(running[:, None], carry_keys, keys)
+
+        # -- in-carry replay: cut each row's emissions at its first EOS
+        # and at its remaining budget (exactly the host _commit loop).
+        jidx = jnp.arange(W)[None, :]
+        valid = jidx < n_w[:, None]
+        eos_at = jnp.min(jnp.where(valid & (toks == eos_ids[:, None]),
+                                   jidx, W), axis=1)
+        take = jnp.minimum(n_w, jnp.minimum(eos_at + 1,
+                                            budgets - n_emit))
+        take = jnp.where(running, take, 0)
+        if stream:
+            from jax.experimental import io_callback
+
+            for j in range(W):
+                io_callback(_stream_tap, None, ring_id, toks[:, j],
+                            running & (j < take), ordered=True)
+        # Committed tokens land in the output buffer at columns
+        # [n_emit, n_emit+take) and back into hist at positions
+        # [lens+1, lens+1+take) — the next window's draft context.
+        cols = jnp.arange(out.shape[1])[None, :]
+        rel = cols - n_emit[:, None]
+        put = (rel >= 0) & (rel < take[:, None])
+        vals = jnp.take_along_axis(toks, jnp.clip(rel, 0, W - 1), axis=1)
+        out = jnp.where(put, vals, out)
+        hp = jnp.arange(hist_w)[None, :]
+        rel_h = hp - (lens + 1)[:, None]
+        put_h = (rel_h >= 0) & (rel_h < take[:, None])
+        vals_h = jnp.take_along_axis(toks, jnp.clip(rel_h, 0, W - 1),
+                                     axis=1)
+        hist = jnp.where(put_h, vals_h, hist)
+        last_new = jnp.take_along_axis(
+            toks, jnp.maximum(take - 1, 0)[:, None], axis=1)[:, 0]
+        last = jnp.where(running, last_new, last)
+        lens = lens + take
+        n_emit = n_emit + take
+        n_win = n_win + running.astype(jnp.int32)
+        acc = jnp.where(running & (nd > 0), n_w - 1, 0)
+        n_acc = n_acc + acc
+        hit_eos = running & (take == eos_at + 1)
+        one = jnp.ones((), counts.dtype)
+        counts = counts + jnp.stack(
+            [one, jnp.sum(take).astype(counts.dtype),
+             jnp.sum(running).astype(counts.dtype),
+             jnp.sum(acc).astype(counts.dtype),
+             jnp.sum(hit_eos).astype(counts.dtype)])
+        running = running & ~hit_eos & (n_emit < budgets)
+        return (i + 1, state, hist, last, lens, running, keys, out,
+                n_emit, n_win, n_acc, counts)
+
+    (iters, state, _hist, _last, _lens, _running, keys, out, n_emit,
+     n_win, n_acc, counts) = lax.while_loop(
+        cond, body, (jnp.int32(0), state, hist, last_tokens, lengths,
+                     active, keys, out0, zeros_i, zeros_i, zeros_i,
+                     counts))
+    return state, out, n_emit, n_win, n_acc, keys, iters, counts
+
+
+def _ancestor_matrix(parents: tuple) -> tuple:
+    """Static ancestor-or-self visibility ``(T+1, T+1)`` bool matrix for
+    a tree-``parents`` tuple: row ``i`` marks the in-window nodes node
+    ``i`` may attend (itself and its transitive parents).  Plain Python
+    at trace time — the tree shape is a compile-time static."""
+    T1 = len(parents)
+    rows = []
+    for i in range(T1):
+        vis = [False] * T1
+        j = i
+        while j >= 0:
+            vis[j] = True
+            j = parents[j]
+        rows.append(tuple(vis))
+    return tuple(rows)
+
+
+def _tree_verify_math(forward, commit, state, tokens, lengths, active,
+                      n_cand, temps, top_k, top_p, keys, counts, *,
+                      parents):
+    """The ONE tree-verify body shared by the dense and paged programs:
+    score a static tree of candidate branches (``tokens`` ``(slots,
+    T+1)``, node 0 = each row's last token) in a single tree-masked
+    forward, walk the accept/reject procedure
+    (:func:`tpudp.ops.sampling.verify_tree_tokens`), then commit ONLY
+    the accepted root-to-leaf path's K/V — ``forward`` returns the
+    window K/V instead of writing it (the no-write tree twins), and
+    ``commit`` lands path node ``d``'s vectors at position ``lens + d``
+    (dense arena-row writes, or PR 14 single-page writes where rejected
+    branches route to the scratch page: zero pool writes).  PRNG and
+    counter discipline are ``_verify_math``'s verbatim; the returned
+    tuple has the verify step's exact shape so the host replay seam is
+    shared."""
+    depths = tree_depths(parents)
+    anc = _ancestor_matrix(parents)
+    logits, wk, wv = forward(state, tokens, lengths, depths, anc)
+    carry, sub = split_keys(keys)
+    out, n_emit, path = verify_tree_tokens(logits, tokens[:, 1:],
+                                           parents, n_cand, temps,
+                                           top_k, top_p, sub)
+    new_keys = jnp.where(active[:, None], carry, keys)
+    state = commit(state, wk, wv, lengths, path, n_emit, active)
+    zero = jnp.zeros((), counts.dtype)
+    one = jnp.ones((), counts.dtype)
+    act = jnp.sum(active).astype(counts.dtype)
+    emitted = jnp.sum(jnp.where(active, n_emit, 0)).astype(counts.dtype)
+    accepted = jnp.sum(jnp.where(active & (n_cand > 0), n_emit - 1,
+                                 0)).astype(counts.dtype)
+    new_counts = counts + jnp.stack([one, emitted, act, accepted, zero])
+    return state, out, n_emit, new_keys, new_counts
+
+
+def _build_steps(cfg, params, paged_attn: str = "einsum", draft=None):
     """Jitted step programs with the WEIGHTS CLOSED OVER as compile-time
     constants rather than traced arguments.
+
+    ``draft`` — a ``(draft_cfg, draft_params)`` pair — additionally
+    builds the fused SPECULATIVE programs (``fused_spec_step`` and its
+    paged twin), which close over the draft model's weights the same
+    way: an ``Engine(speculate_k=k, decode_fuse=N,
+    drafter=DraftModelDrafter(...))`` runs draft→verify→accept as one
+    ``lax.while_loop`` program (``_fused_spec_math``).  ``None`` (every
+    other engine) builds no such program — the returned tuple carries
+    ``None`` in those positions and the step cache key never grows.
 
     ``paged_attn`` selects the PAGED programs' KV indirection (the
     dense programs never change): ``'einsum'`` — the default — is the
@@ -570,6 +769,86 @@ def _build_steps(cfg, params, paged_attn: str = "einsum"):
             lax.dynamic_update_slice_in_dim(cache.k, row.k, slot, axis=1),
             lax.dynamic_update_slice_in_dim(cache.v, row.v, slot, axis=1))
 
+    if draft is None:
+        fused_spec_step = None
+    else:
+        draft_cfg, draft_params = draft
+
+        @functools.partial(jax.jit, donate_argnums=(0, 12),
+                           static_argnames=("n_draft_k", "n_steps",
+                                            "stream"))
+        def fused_spec_step(cache, hist, last_tokens, lengths, active,
+                            temps, top_k, top_p, keys, budgets, eos_ids,
+                            ring_id, counts, *, n_draft_k, n_steps,
+                            stream=False):
+            """Up to ``n_steps`` SPECULATIVE windows in ONE device
+            program: each ``lax.while_loop`` iteration drafts
+            ``n_draft_k`` greedy tokens per running slot with the
+            draft model (whose weights are frozen into this program
+            exactly like the target's), scores the k+1 window with the
+            verify forward, and commits the accepted prefix + bonus
+            token in-carry — ``_fused_spec_math``, the one copy shared
+            with the paged twin.  ``hist`` ``(num_slots, max_len)``
+            holds each slot's prompt+committed tokens (the drafter's
+            context; committed tokens scatter back into it between
+            windows).  Compiles once per (num_slots, max_len, k,
+            n_steps); returns ``(cache, out, n_emit, n_windows,
+            n_accepted, keys, iters, counts)`` with ONE host fetch per
+            multi-window program — the per-window draft gather AND
+            verify fetch are gone."""
+            TRACE_COUNTS["fused_spec_decode"] += 1
+            return _fused_spec_math(
+                _dense_fwd, draft_cfg, draft_params, cache, hist,
+                last_tokens, lengths, active, temps, top_k, top_p, keys,
+                budgets, eos_ids, ring_id, counts, n_draft_k=n_draft_k,
+                n_steps=n_steps, stream=stream)
+
+    def _tree_dense_fwd(cache, tokens, lengths, depths, anc):
+        """Dense tree-verify indirection: the no-write tree forward
+        reads the arena directly and hands back the window K/V."""
+        return _forward_tree(cfg, params, tokens, cache, lengths,
+                             depths, anc)
+
+    def _tree_dense_commit(cache, wk, wv, lengths, path, n_emit, active):
+        """Dense accepted-path commit: path node ``d``'s K/V lands at
+        arena position ``lens + d`` (unconditionally — positions past
+        the accepted depth hold garbage beyond the row's length, the
+        arena's standing overwrite-before-visible contract, and masked
+        rows land in their own rows like every dense write)."""
+        del n_emit, active
+        k_all, v_all = cache.k, cache.v
+        for d in range(path.shape[1]):
+            idx = path[:, d][None, :, None, None, None]
+            ksel = jnp.take_along_axis(wk, idx, axis=2)
+            vsel = jnp.take_along_axis(wv, idx, axis=2)
+            k_all = jax.vmap(update_cache_rows, in_axes=(0, 0, None))(
+                k_all, ksel, lengths + d)
+            v_all = jax.vmap(update_cache_rows, in_axes=(0, 0, None))(
+                v_all, vsel, lengths + d)
+        return KVCache(k_all, v_all)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 9),
+                       static_argnames=("parents",))
+    def tree_verify_step(cache, tokens, lengths, active, n_cand, temps,
+                         top_k, top_p, keys, counts, *, parents):
+        """One speculative TREE window for every slot
+        (``Engine(speculate_tree=shape)``): ``tokens`` ``(num_slots,
+        T+1)`` holds each row's last token at node 0 and the drafter's
+        candidate branches at nodes 1..T; one tree-masked forward
+        scores every branch, ``verify_tree_tokens`` walks the
+        accept/reject, and only the accepted root-to-leaf path's K/V
+        commits (``_tree_verify_math``).  ``parents`` is static — one
+        compile per (geometry, tree shape); the tree attention is
+        tolerance-bounded vs the sequential write-then-attend window
+        (its joint softmax spans cache+window), hence its own
+        TRACE_COUNTS key and pinned trace.  Return tuple mirrors
+        ``verify_step`` so the host replay seam is shared."""
+        TRACE_COUNTS["tree_verify"] += 1
+        return _tree_verify_math(
+            _tree_dense_fwd, _tree_dense_commit, cache, tokens, lengths,
+            active, n_cand, temps, top_k, top_p, keys, counts,
+            parents=parents)
+
     # -- paged twins (Engine(kv_pages=N)): identical math read through
     # per-slot block tables into one shared page pool.  The DEFAULT
     # ("einsum") indirection is GATHER-FREE: each layer writes the
@@ -671,9 +950,82 @@ def _build_steps(cfg, params, paged_attn: str = "einsum"):
             active, temps, top_k, top_p, keys, budgets, eos_ids, ring_id,
             counts, n_steps=n_steps, stream=stream)
 
+    if draft is None:
+        fused_spec_paged = None
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 13),
+                           static_argnames=("n_draft_k", "n_steps",
+                                            "stream"))
+        def fused_spec_paged(pool, table, hist, last_tokens, lengths,
+                             active, temps, top_k, top_p, keys, budgets,
+                             eos_ids, ring_id, counts, *, n_draft_k,
+                             n_steps, stream=False):
+            """Paged fused speculative window: ``_fused_spec_math`` —
+            the one shared copy of draft/verify/accept carry — with the
+            paged indirection inside the loop (the table is
+            loop-invariant; the host backs every window position's page
+            before dispatch, including the k-token speculative tail).
+            The DRAFT model's KV stays a dense carry-local arena
+            either way — it is scratch recomputed per window, never
+            pooled state."""
+            TRACE_COUNTS["fused_spec_paged"] += 1
+            return _fused_spec_math(
+                _paged_fwd(table, win_impl), draft_cfg, draft_params,
+                pool, hist, last_tokens, lengths, active, temps, top_k,
+                top_p, keys, budgets, eos_ids, ring_id, counts,
+                n_draft_k=n_draft_k, n_steps=n_steps, stream=stream)
+
+    def _tree_paged_fwd(table):
+        """Paged tree-verify indirection: materialize the read-only
+        dense view (gather — the tree step's documented read cost;
+        nothing is scattered back) and run the no-write tree forward
+        over it."""
+        def fwd(pool, tokens, lengths, depths, anc):
+            view = gather_pages(cfg, pool, table)
+            return _forward_tree(cfg, params, tokens, view, lengths,
+                                 depths, anc)
+        return fwd
+
+    def _tree_paged_commit(table):
+        """Paged accepted-path commit: PR 14 single-page writes of path
+        node ``d``'s K/V at position ``lens + d``, ACTIVE-masked past
+        the accepted depth — rejected branches and rejected depths
+        route to the trailing scratch page, so they cost ZERO real
+        pool writes (the byte-diff pin)."""
+        def commit(pool, wk, wv, lengths, path, n_emit, active):
+            acc = n_emit - 1
+            layers = []
+            for i in range(cfg.num_layers):
+                pages = _layer_pages(pool, i)
+                for d in range(path.shape[1]):
+                    idx = path[:, d][:, None, None, None]
+                    ksel = jnp.take_along_axis(wk[i], idx, axis=1)
+                    vsel = jnp.take_along_axis(wv[i], idx, axis=1)
+                    pages = write_token_pages(
+                        pages, ksel, vsel, table, lengths + d,
+                        active & (d <= acc))
+                layers.append(pages)
+            return _stack_pages(pool, layers)
+        return commit
+
+    @functools.partial(jax.jit, donate_argnums=(0, 10),
+                       static_argnames=("parents",))
+    def tree_verify_paged(pool, table, tokens, lengths, active, n_cand,
+                          temps, top_k, top_p, keys, counts, *, parents):
+        """Paged speculative tree window (the shared
+        ``_tree_verify_math`` body): tree-masked scoring over the
+        gathered view, then accepted-path-only single-page commits —
+        rejected branches write nothing into the pool."""
+        TRACE_COUNTS["tree_verify_paged"] += 1
+        return _tree_verify_math(
+            _tree_paged_fwd(table), _tree_paged_commit(table), pool,
+            tokens, lengths, active, n_cand, temps, top_k, top_p, keys,
+            counts, parents=parents)
+
     return (decode_step, verify_step, prefill_step, fused_decode_step,
+            fused_spec_step, tree_verify_step,
             decode_step_paged, verify_step_paged, prefill_step_paged,
-            fused_decode_step_paged)
+            fused_decode_step_paged, fused_spec_paged, tree_verify_paged)
 
 
 # LRU of built step programs keyed by ((cfg, paged_attn), id(params)):
@@ -685,16 +1037,42 @@ def _build_steps(cfg, params, paged_attn: str = "einsum"):
 # itself lives in tpudp.utils.compile_cache (ProgramCache documents the
 # id()-key safety argument); the trace-stability audit pins its reuse
 # semantics.
+class _DraftKey:
+    """Rides the hashable half of the step-cache key for engines whose
+    programs fuse in a DRAFT model (``_build_steps(draft=...)``):
+    hashes and compares the draft params by IDENTITY while holding them
+    STRONGLY — the same argument :class:`ProgramCache` makes for the
+    main params' ``id()`` key: the id can't be reused while this key
+    (inside a live cache entry) pins the object, and ``__eq__``'s
+    ``is`` check confirms it on every hit."""
+
+    __slots__ = ("cfg", "params")
+
+    def __init__(self, cfg, params):
+        self.cfg = cfg
+        self.params = params
+
+    def __hash__(self):
+        return hash((self.cfg, id(self.params)))
+
+    def __eq__(self, other):
+        return (isinstance(other, _DraftKey) and self.cfg == other.cfg
+                and self.params is other.params)
+
+
 def _build_steps_keyed(key, params):
-    cfg, paged_attn = key
-    return _build_steps(cfg, params, paged_attn)
+    cfg, paged_attn, draft = key
+    return _build_steps(cfg, params, paged_attn,
+                        draft=None if draft is None
+                        else (draft.cfg, draft.params))
 
 
 _STEP_CACHE = ProgramCache(_build_steps_keyed, max_entries=8)
 
 
-def _engine_steps(cfg, params, paged_attn: str = "einsum"):
-    return _STEP_CACHE.get((cfg, paged_attn), params)
+def _engine_steps(cfg, params, paged_attn: str = "einsum", draft=None):
+    dk = None if draft is None else _DraftKey(*draft)
+    return _STEP_CACHE.get((cfg, paged_attn, dk), params)
 
 
 class _ModelState:
@@ -711,8 +1089,10 @@ class _ModelState:
 
     __slots__ = ("name", "model", "config", "params", "decode_step",
                  "verify_step", "prefill_step", "fused_step",
+                 "fused_spec_step", "tree_step",
                  "decode_paged", "verify_paged", "prefill_paged",
-                 "fused_paged", "cache", "prefix_cache", "pool", "index",
+                 "fused_paged", "fused_spec_paged", "tree_paged",
+                 "cache", "prefix_cache", "pool", "index",
                  "table", "slot_nodes", "obs_counts")
 
     def __init__(self, name, model, params, steps):
@@ -721,8 +1101,10 @@ class _ModelState:
         self.config = model.config
         self.params = params
         (self.decode_step, self.verify_step, self.prefill_step,
-         self.fused_step, self.decode_paged, self.verify_paged,
-         self.prefill_paged, self.fused_paged) = steps
+         self.fused_step, self.fused_spec_step, self.tree_step,
+         self.decode_paged, self.verify_paged,
+         self.prefill_paged, self.fused_paged, self.fused_spec_paged,
+         self.tree_paged) = steps
         self.cache = None
         self.prefix_cache = None
         # Paged mode (Engine(kv_pages=N)): no dense arena — ``pool`` is
@@ -948,6 +1330,7 @@ class Engine:
     def __init__(self, model, params: dict, *, num_slots: int = 8,
                  max_len: int | None = None, prefill_chunk: int = 16,
                  speculate_k: int = 0, drafter=None,
+                 speculate_tree=None,
                  prefix_cache_blocks: int = 0,
                  kv_pages: int = 0, kv_dtype: str | None = None,
                  paged_attn: str = "einsum",
@@ -1049,6 +1432,50 @@ class Engine:
                 f"max_len ({self.max_len}) must exceed speculate_k "
                 f"({speculate_k}) — the arena reserves k scratch "
                 f"positions per slot for the speculative window")
+        # Tree speculation (opt-in): a static shape of candidate
+        # branches verified per step by the tree programs.  Rides the
+        # speculative window's arena reserve, so the shape's depth is
+        # bounded by speculate_k; tolerance-bounded attention (like
+        # paged_attn='kernel'), hence opt-in.
+        self.speculate_tree = None
+        if speculate_tree is not None:
+            from tpudp.serve.speculate import tree_shape
+
+            if speculate_k == 0:
+                raise ValueError(
+                    "speculate_tree requires speculate_k >= 1 — the "
+                    "tree rides the speculative window's arena reserve")
+            shape = tree_shape(speculate_tree)
+            if shape.max_depth > speculate_k:
+                raise ValueError(
+                    f"speculate_tree {shape.name!r} max_depth "
+                    f"({shape.max_depth}) exceeds speculate_k "
+                    f"({speculate_k}) — the arena reserves exactly k "
+                    f"scratch positions per slot")
+            if not hasattr(drafter, "propose_tree"):
+                raise ValueError(
+                    f"speculate_tree requires a drafter with "
+                    f"propose_tree() (e.g. NgramDrafter); "
+                    f"{type(drafter).__name__} has none")
+            self.speculate_tree = shape
+        # Fused speculation (the tentpole seam): with a MODEL drafter
+        # whose weights can be frozen into the device program, a
+        # fuse-eligible iteration runs draft→verify→accept as one
+        # lax.while_loop program instead of host-drafted per-step
+        # verify.  The draft model must cover max_len + k positions:
+        # the in-carry drafter prefills the full max_len-wide history
+        # (the host DraftModelDrafter's pinned-bucket geometry — the
+        # bit-parity referee) and decodes k past it.  Anything else
+        # (ngram drafter, short draft model, decode_fuse=1, tree mode)
+        # keeps the host-drafted path byte-for-byte.
+        dparams = getattr(drafter, "params", None)
+        self._spec_fusable = (
+            speculate_k > 0 and decode_fuse > 1
+            and speculate_tree is None
+            and dcfg is not None and dparams is not None
+            and dcfg.max_seq_len >= self.max_len + speculate_k)
+        self._draft_pair = ((dcfg, dparams) if self._spec_fusable
+                            else None)
         if queue_limit is not None and queue_limit < 1:
             raise ValueError(
                 f"queue_limit must be >= 1 (or None for unbounded), "
@@ -1212,7 +1639,8 @@ class Engine:
         ms = _ModelState(name, model, params,
                          _engine_steps(cfg, params,
                                        self.paged_attn if self._paged
-                                       else "einsum"))
+                                       else "einsum",
+                                       draft=self._draft_pair))
         # Prefix cache: blocks sized to prefill_chunk so a cached block
         # boundary is always a chunk boundary (imported lazily — the
         # module imports TRACE_COUNTS from here, and the cache is
@@ -1510,7 +1938,12 @@ class Engine:
                     if not active.any():
                         continue
                 if self.speculate_k and not self._drafter_quarantined:
-                    self._run_verify(ms, active, emitted)
+                    if self.speculate_tree is not None:
+                        self._run_verify_tree(ms, active, emitted)
+                    elif fuse and self._spec_fusable:
+                        self._run_spec_fused(ms, active, emitted)
+                    else:
+                        self._run_verify(ms, active, emitted)
                 elif fuse:
                     self._run_decode_fused(ms, active, emitted)
                 else:
@@ -1934,7 +2367,17 @@ class Engine:
             # the fused window's positions would route the window
             # tail's KV writes to the scratch page — silent corruption.
             if self.speculate_k and not self._drafter_quarantined:
-                ahead = self.speculate_k + 1
+                if fuse and self._spec_fusable:
+                    # The fused spec window advances up to
+                    # decode_fuse x (k+1) committed positions, and its
+                    # LAST verify window's writes extend k speculative
+                    # positions past the final committed length.
+                    ahead = min(r.max_new_tokens - len(r.tokens),
+                                self.decode_fuse
+                                * (self.speculate_k + 1)) \
+                        + self.speculate_k
+                else:
+                    ahead = self.speculate_k + 1
             elif fuse:
                 ahead = min(r.max_new_tokens - len(r.tokens),
                             self.decode_fuse)
@@ -2506,6 +2949,192 @@ class Engine:
                 # Each commit after the first lands because the PREVIOUS
                 # emitted token's KV was written by this window; += 1
                 # per commit advances the row past exactly those writes.
+                self._len[s] += 1
+                self._commit(s, int(out[s, j]), emitted)
+
+    def _run_spec_fused(self, ms: _ModelState, active, emitted) -> None:
+        """One fused SPECULATIVE window: up to ``decode_fuse``
+        draft→verify→accept iterations in a single device program
+        (``fused_spec_step`` — the drafter runs ON DEVICE from each
+        slot's token history), then ONE fetch and the same host replay
+        seam as ``_run_decode_fused``: per-slot key carry committed
+        just before that slot's replay, every token through the
+        unchanged ``_commit`` path, acceptance accounting charged
+        before replay like ``_run_verify``.  The device already cut
+        each row at its EOS/budget, so replay retirement agrees with
+        the loop predicate by construction — a later fall-back to
+        host-drafted verify (or plain decode) resumes bit-exactly."""
+        k = self.speculate_k
+        budgets = np.zeros(self.num_slots, np.int32)
+        eos = np.full(self.num_slots, -1, np.int32)
+        hist = np.zeros((self.num_slots, self.max_len), np.int32)
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            budgets[s] = r.max_new_tokens - len(r.tokens)
+            if r.eos_id is not None:
+                eos[s] = r.eos_id
+            ctx = np.concatenate(
+                [r.prompt, np.asarray(r.tokens, np.int32)])
+            hist[s, :ctx.size] = ctx  # fits: prompt+budget+k <= max_len
+        # Each iteration runs k draft steps + a draft prefill + one
+        # verify window, so the watchdog budget scales with both the
+        # window and the draft work per window.
+        budget_s = (self._step_timeout_s * self.decode_fuse * (k + 2)
+                    if self._step_timeout_s is not None else None)
+        if self._paged:
+            (ms.pool.pages, out, n_emit, n_win, n_acc, keys, iters,
+             ms.obs_counts) = self._device(
+                "fused_spec", ms.fused_spec_paged,
+                ms.pool.pages, ms.table, hist, self._last, self._len,
+                active, self._temps, self._topk, self._topp, self._keys,
+                budgets, eos, np.int32(self._ring_id), ms.obs_counts,
+                guard_timeout_s=budget_s, n_draft_k=k,
+                n_steps=self.decode_fuse, stream=self._fuse_stream)
+        else:
+            (ms.cache, out, n_emit, n_win, n_acc, keys, iters,
+             ms.obs_counts) = self._device(
+                "fused_spec", ms.fused_spec_step,
+                ms.cache, hist, self._last, self._len, active,
+                self._temps, self._topk, self._topp, self._keys,
+                budgets, eos, np.int32(self._ring_id), ms.obs_counts,
+                guard_timeout_s=budget_s, n_draft_k=k,
+                n_steps=self.decode_fuse, stream=self._fuse_stream)
+        # tpudp: lint-ok(host-sync): the per-PROGRAM fetch — one round
+        # trip per up-to-decode_fuse speculative windows, replacing the
+        # host-drafted path's per-window draft gather + verify fetch.
+        out = np.asarray(out)
+        n_emit = np.asarray(n_emit)  # tpudp: lint-ok(host-sync): same fetch
+        n_win = np.asarray(n_win)  # tpudp: lint-ok(host-sync): same fetch
+        n_acc = np.asarray(n_acc)  # tpudp: lint-ok(host-sync): same fetch
+        self.stats["fused_spec_windows"] += 1
+        self.stats["fused_spec_steps"] += int(iters)  # tpudp: lint-ok(host-sync): same fetch
+        # A row participates in one verify window per loop iteration it
+        # was running — n_win.sum() is the window's active-slot-step
+        # count (the occupancy denominator's fused-spec share).
+        self.stats["active_slot_steps"] += int(n_win.sum())
+        self.stats["draft_tokens"] += int(n_win.sum()) * k
+        self.stats["draft_accepted"] += int(n_acc.sum())
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            r.draft_proposed += int(n_win[s]) * k
+            r.draft_accepted += int(n_acc[s])
+            # Per-slot key carry just before that slot's replay — the
+            # containment-mid-replay argument of _run_decode_fused.
+            self._keys = self._keys.at[s].set(keys[s])
+            for j in range(int(n_emit[s])):
+                if self._slots[s] is not r:
+                    break  # retired (EOS / budget / cancel) mid-replay
+                self._len[s] += 1
+                self._commit(int(s), int(out[s, j]), emitted)
+
+    def _gather_tree_drafts(self, ms, active, shape):
+        """Host-side TREE proposals behind the same fault-isolation
+        wall as ``_gather_drafts``: a drafter whose ``propose_tree``
+        raises, returns a wrong-shaped or out-of-vocab array, or blows
+        its time budget is quarantined and the step falls back (None).
+        Rows where the drafter has no proposal (``propose_tree`` →
+        None) simply run the no-candidate path in-window."""
+        proposed = []
+        budget = self.drafter_timeout_s
+        T = shape.num_candidates
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            context = np.concatenate(
+                [r.prompt, np.asarray(r.tokens, np.int32)])
+            t0 = time.perf_counter()
+            try:
+                with self._guard(budget if budget is not None
+                                 else self._step_timeout_s,
+                                 name="draft_propose_tree"):
+                    raw = self.drafter.propose_tree(context, shape)
+            except Exception as exc:  # noqa: BLE001 — isolation by design
+                self._quarantine_drafter(
+                    f"propose_tree() raised {type(exc).__name__}: {exc}")
+                return None
+            took = time.perf_counter() - t0
+            draft = (np.zeros(0, np.int32) if raw is None
+                     else np.asarray(raw).reshape(-1))
+            if (self._watchdog is not None
+                    and self._watchdog.acknowledge()):
+                self._quarantine_drafter(
+                    f"propose_tree() exceeded the armed watchdog "
+                    f"deadline ({took:.4f}s elapsed)", r,
+                    int(draft.size))
+                return None
+            if raw is None:
+                continue
+            if draft.size != T or draft.dtype.kind not in "iu":
+                self._quarantine_drafter(
+                    f"propose_tree() returned a malformed candidate "
+                    f"array (size {draft.size}, dtype {draft.dtype}; "
+                    f"shape {shape.name!r} wants {T} int tokens)",
+                    r, int(draft.size))
+                return None
+            if int(draft.min()) < 0 or int(draft.max()) >= \
+                    ms.config.vocab_size:
+                self._quarantine_drafter(
+                    "propose_tree() returned out-of-vocab token ids",
+                    r, int(draft.size))
+                return None
+            if budget is not None and took > budget:
+                self._quarantine_drafter(
+                    f"propose_tree() took {took:.4f}s "
+                    f"(drafter_timeout_s={budget})", r, int(draft.size))
+                return None
+            proposed.append((int(s), draft.astype(np.int32)))
+        return proposed
+
+    def _run_verify_tree(self, ms: _ModelState, active, emitted) -> None:
+        """Draft a TREE host-side, verify device-side in one tree-masked
+        forward (``Engine(speculate_tree=shape)``): candidate branches
+        ride the window with each row's last token, the accepted
+        root-to-leaf path (plus the bonus token) commits in order
+        through the ``_run_verify`` replay seam.  Rows without a
+        proposal run the no-candidate path (one plain-decode-equivalent
+        token); a step where NOTHING drafted falls through to the plain
+        decode step like ``_run_verify`` does."""
+        shape = self.speculate_tree
+        proposed = self._gather_tree_drafts(ms, active, shape)
+        if not proposed:  # nothing drafted, or the drafter just got cut
+            self._run_decode(ms, active, emitted)
+            return
+        tokens = np.zeros((self.num_slots, shape.num_candidates + 1),
+                          np.int32)
+        tokens[:, 0] = self._last
+        n_cand = np.zeros(self.num_slots, np.int32)
+        for s, draft in proposed:
+            tokens[s, 1:] = draft  # validated in-vocab, exactly T wide
+            n_cand[s] = draft.size
+            self._slots[s].draft_proposed += int(draft.size)
+        if self._paged:
+            (ms.pool.pages, out, n_emit, self._keys,
+             ms.obs_counts) = self._device(
+                "tree_verify", ms.tree_paged,
+                ms.pool.pages, ms.table, tokens, self._len, active,
+                n_cand, self._temps, self._topk, self._topp, self._keys,
+                ms.obs_counts, parents=shape.parents)
+        else:
+            (ms.cache, out, n_emit, self._keys,
+             ms.obs_counts) = self._device(
+                "tree_verify", ms.tree_step,
+                ms.cache, tokens, self._len, active, n_cand,
+                self._temps, self._topk, self._topp, self._keys,
+                ms.obs_counts, parents=shape.parents)
+        # tpudp: lint-ok(host-sync): the per-window verify fetch — the
+        # tree twin of _run_verify's, one round trip per tree window.
+        out = np.asarray(out)
+        n_emit = np.asarray(n_emit)  # tpudp: lint-ok(host-sync): same fetch
+        self.stats["tree_verify_steps"] += 1
+        self.stats["active_slot_steps"] += int(active.sum())
+        self.stats["draft_tokens"] += int(n_cand.sum())
+        for s in np.nonzero(active)[0]:
+            r = self._slots[s]
+            accepted = int(n_emit[s]) - 1
+            r.draft_accepted += accepted
+            self.stats["draft_accepted"] += accepted
+            for j in range(int(n_emit[s])):
+                if self._slots[s] is not r:
+                    break  # retired (EOS / budget / cancel) mid-window
                 self._len[s] += 1
                 self._commit(s, int(out[s, j]), emitted)
 

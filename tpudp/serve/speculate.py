@@ -59,6 +59,100 @@ class Drafter(Protocol):
         ...
 
 
+class TreeShape:
+    """A STATIC speculative token tree: ``parents[j]`` names node j's
+    parent (``parents[0] == -1`` — node 0 is the row's last committed
+    token; candidate nodes are ``1..T`` in topological order).  The
+    shape is a compile-time constant of the tree-verify program (one
+    compilation per shape, like ``decode_fuse``'s ``n_steps``), so it
+    is hashable and carries its derived statics: per-node ``depths``,
+    the ``(T+1, T+1)`` ancestor-or-self matrix the tree attention mask
+    is built from, and the root-to-leaf ``paths`` drafters fill with
+    candidate continuations.  A chain shape reproduces the sequence
+    draft exactly (``tpudp.ops.sampling.verify_tree_tokens``)."""
+
+    __slots__ = ("name", "parents", "depths", "max_depth", "ancestors",
+                 "paths")
+
+    def __init__(self, name: str, parents: tuple):
+        from tpudp.ops.sampling import tree_depths
+
+        self.name = name
+        self.parents = tuple(int(p) for p in parents)
+        self.depths = tree_depths(self.parents)
+        self.max_depth = max(self.depths)
+        n = len(self.parents)
+        anc = [[False] * n for _ in range(n)]
+        for j in range(n):
+            a = j
+            while a != -1:
+                anc[j][a] = True
+                a = self.parents[a] if a else -1
+        self.ancestors = tuple(tuple(row) for row in anc)
+        children = {j: [c for c in range(1, n) if self.parents[c] == j]
+                    for j in range(n)}
+        leaves = [j for j in range(n) if not children[j]]
+        paths = []
+        for leaf in leaves:
+            path, a = [], leaf
+            while a != 0:
+                path.append(a)
+                a = self.parents[a]
+            paths.append(tuple(reversed(path)))
+        self.paths = tuple(paths)
+
+    @property
+    def num_candidates(self) -> int:
+        return len(self.parents) - 1
+
+    def __hash__(self):
+        return hash(self.parents)
+
+    def __eq__(self, other):
+        return (isinstance(other, TreeShape)
+                and self.parents == other.parents)
+
+    def __repr__(self):
+        return f"TreeShape({self.name!r}, parents={self.parents})"
+
+
+def _chain(k: int) -> tuple:
+    return (-1,) + tuple(range(k))
+
+
+#: Named static tree shapes (``Engine(speculate_tree=<name>)``).  A
+#: ``chainK`` is the sequence draft expressed as a tree (the parity
+#: referee); the branched shapes spend the same verify window on
+#: sibling candidates that rescue a window the main chain's first
+#: token would lose outright.
+TREE_SHAPES = {
+    "chain2": TreeShape("chain2", _chain(2)),
+    "chain3": TreeShape("chain3", _chain(3)),
+    "chain4": TreeShape("chain4", _chain(4)),
+    # 2 branches x depth 2: nodes 1-2 chain off the root, node 3 is a
+    # sibling first step with its own continuation node 4.
+    "fork2x2": TreeShape("fork2x2", (-1, 0, 1, 0, 3)),
+    # main chain of 3 + one sibling at the root: same candidate count
+    # as chain4, one unit shallower, branch-diverse at the first step.
+    "fork3+1": TreeShape("fork3+1", (-1, 0, 1, 2, 0)),
+}
+
+
+def tree_shape(spec) -> TreeShape:
+    """Resolve ``Engine(speculate_tree=...)``: a registry name, a
+    ``TreeShape``, or a raw parents tuple (ad-hoc shapes compile like
+    named ones — the shape itself is the compilation key)."""
+    if isinstance(spec, TreeShape):
+        return spec
+    if isinstance(spec, str):
+        if spec not in TREE_SHAPES:
+            raise ValueError(
+                f"unknown tree shape {spec!r} (registered: "
+                f"{sorted(TREE_SHAPES)}; or pass a parents tuple)")
+        return TREE_SHAPES[spec]
+    return TreeShape("custom", tuple(spec))
+
+
 class NgramDrafter:
     """Prompt-lookup drafting: the request's own context is the draft
     model.  The last ``n`` tokens (longest match wins, ``n`` from
@@ -107,6 +201,66 @@ class NgramDrafter:
                 best = cand.astype(np.int32)
         return best
 
+    def _continuations(self, context: np.ndarray, k: int,
+                       want: int) -> list:
+        """Up to ``want`` DISTINCT k-token continuations, most recent
+        match first — the per-branch proposals ``propose_tree`` fills a
+        shape's root-to-leaf paths with.  The first entry is exactly
+        what :meth:`propose` returns (the tree's main chain is the
+        sequence draft), later entries come from older matches whose
+        next token differs — the ambiguity a branched tree exists to
+        hedge."""
+        context = np.asarray(context, np.int32).reshape(-1)
+        size = context.size
+        if k < 1 or size < self.min_ngram + 1:
+            return []
+        out, first_toks = [], set()
+        main = self.propose(context, k)
+        if main.size:  # path 0 is EXACTLY the sequence draft
+            out.append(main)
+            first_toks.add(int(main[0]))
+        for n in range(min(self.max_ngram, size - 1),
+                       self.min_ngram - 1, -1):
+            if len(out) >= want:
+                break
+            pattern = context[size - n:]
+            windows = np.lib.stride_tricks.sliding_window_view(context, n)
+            hits = np.nonzero((windows[:size - n] == pattern).all(1))[0]
+            for i in hits[::-1]:  # most recent match first
+                cand = context[i + n:i + n + k]
+                head = int(cand[0]) if cand.size else None
+                if head is None or head in first_toks:
+                    continue
+                first_toks.add(head)
+                out.append(cand.astype(np.int32))
+                if len(out) >= want:
+                    break
+        return out
+
+    def propose_tree(self, context: np.ndarray,
+                     shape: TreeShape) -> np.ndarray | None:
+        """Candidate tokens for every node of ``shape`` (``(T,)`` int32,
+        node j's token at index j-1), or None when the context has no
+        match at all.  Each root-to-leaf path gets its own continuation
+        (most recent match first — path 0 is exactly :meth:`propose`'s
+        sequence draft); shared prefixes keep the first assigner's
+        token, and paths beyond the available distinct continuations
+        repeat the last one (a duplicated hint can only be rejected)."""
+        conts = self._continuations(context, shape.max_depth,
+                                    len(shape.paths))
+        if not conts:
+            return None
+        tokens = np.zeros(shape.num_candidates, np.int32)
+        assigned = np.zeros(shape.num_candidates, bool)
+        for i, path in enumerate(shape.paths):
+            cont = conts[min(i, len(conts) - 1)]
+            for d, node in enumerate(path):
+                if assigned[node - 1] or d >= cont.size:
+                    continue
+                tokens[node - 1] = cont[d]
+                assigned[node - 1] = True
+        return tokens
+
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k"))
 def _draft_greedy(cfg, params, tokens, length, k):
@@ -145,11 +299,19 @@ class DraftModelDrafter:
     so the verify step's point-mass rejection rule applies unchanged at
     any temperature."""
 
-    def __init__(self, model, params: dict):
+    def __init__(self, model, params: dict, bucket: int | None = None):
         validate_decode_config(model.config, "DraftModelDrafter")
+        if bucket is not None and bucket < 1:
+            raise ValueError(f"bucket must be >= 1, got {bucket}")
         self.model = model
         self.config = model.config
         self.params = params
+        # Optional pinned context bucket: the engine's fused-spec
+        # program drafts in-device over a fixed max_len-wide history
+        # buffer, so its host-drafted parity referee pins bucket to the
+        # same width (padding behind the causal mask contributes exact
+        # zeros either way — the parity tests assert it).
+        self.bucket = bucket
 
     def propose(self, context: np.ndarray, k: int) -> np.ndarray:
         context = np.asarray(context, np.int32).reshape(-1)
@@ -161,10 +323,13 @@ class DraftModelDrafter:
         cap = max(self.config.max_seq_len - k, 1)
         length = min(context.size, cap)
         context = context[-length:]
-        bucket = 1
-        while bucket < length:
-            bucket *= 2
-        bucket = min(bucket, cap)
+        if self.bucket is not None:
+            bucket = min(max(self.bucket, length), cap)
+        else:
+            bucket = 1
+            while bucket < length:
+                bucket *= 2
+            bucket = min(bucket, cap)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :length] = context
         drafts = _draft_greedy(self.config, self.params, padded,
