@@ -155,7 +155,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
-                              SERVE_FUSED_NS, SERVE_PAGED_WORKLOADS,
+                              SERVE_FUSED_NS, SERVE_PAGED_TRAFFIC,
+                              SERVE_PAGED_WORKLOADS,
                               SERVE_PREFIX_WORKLOADS, SERVE_SOAK_SEEDS,
                               SERVE_SPEC_FUSED_CONFIGS, SERVE_SPEC_KS,
                               SERVE_TENANCY_SEEDS)
@@ -1626,6 +1627,150 @@ def main() -> None:
         })
         bank_metrics("serve_paged_kernel", workload, free_eng.metrics())
 
+    def run_paged_kernel_traffic(workload: str) -> None:
+        """Kernel-vs-einsum throughput rows per traffic kind (the same
+        ``serve_paged_kernel`` metric, distinguished by a ``traffic``
+        field): **prefill** (chunked prompt ingestion, one new token —
+        the flash-prefill kernel's path), **verify** (k=2 host
+        speculation through the multi-token verify-window kernel), and
+        **fused** (4-token in-loop decode windows dispatching the
+        decode kernel inside the while body).  Each kind runs THREE
+        engines over the same over-subscribed shared-prefix burst at
+        the same page budget: ``paged_attn='einsum'`` (the bit-exact
+        fallback the kernel must beat), ``paged_attn='gather'``
+        (PR 13's materialize-then-dense oracle), and
+        ``paged_attn='kernel'``.  Over-subscription (2x slots + 1
+        requests) retires and re-admits mid-burst, so later admissions
+        inherit recycled non-contiguous pages — the parity gate
+        (``parity_ok``: all three engines' greedy tokens identical)
+        runs over genuinely FRAGMENTED tables.  ``kernel_ok`` folds
+        parity with the throughput bar — kernel tokens/sec >= einsum —
+        whenever tokens/sec was measured; on a CPU host the kernel
+        lowers in interpret mode (timing the interpreter, not the
+        kernel), so tokens/sec is only taken on a TPU or under
+        ``SERVE_PAGED_KERNEL_TPS=1`` and the CPU smoke gate reads
+        parity alone (``value`` stays null, which keeps smoke rows
+        from ever closing the bench_gaps serve_paged_traffic stage)."""
+        deep_new = min(max_new, int(
+            os.environ.get("SERVE_PAGED_TRAFFIC_NEW", "12")))
+        kinds = {
+            "prefill": (dict(), 1),
+            "verify": (dict(speculate_k=2), deep_new),
+            "fused": (dict(decode_fuse=4), deep_new),
+        }
+        assert set(kinds) == set(SERVE_PAGED_TRAFFIC)
+        for traffic in SERVE_PAGED_TRAFFIC:
+            # Same isolation contract as the stage dispatch loop: one
+            # traffic kind crashing must not cost the remaining kinds.
+            try:
+                _run_traffic_kind(workload, traffic, *kinds[traffic])
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": PAGED_KERNEL_METRIC, "workload": workload,
+                      "traffic": traffic,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+
+    def _run_traffic_kind(workload, traffic, ekw, new) -> None:
+        prng = np.random.default_rng(seed + 7)
+        shared = prng.integers(0, cfg.vocab_size,
+                               size=prefix_len).astype(np.int32)
+        slots = int(os.environ.get("SERVE_PAGED_TRAFFIC_SLOTS",
+                                   prefix_conc))
+        kv_pages = slots * (cfg.max_seq_len // chunk)  # = one dense arena
+        reqs = [np.concatenate([shared, prng.integers(
+            0, cfg.vocab_size, size=prefix_tail).astype(np.int32)])
+            for _ in range(2 * slots + 1)]
+        reps = max(1, int(os.environ.get("SERVE_PAGED_REPS", "4")))
+        want_tps = ("TPU" in kind
+                    or os.environ.get("SERVE_PAGED_KERNEL_TPS") == "1")
+
+        def engine(impl):
+            return Engine(model, params, num_slots=slots,
+                          max_len=cfg.max_seq_len,
+                          prefill_chunk=chunk, kv_pages=kv_pages,
+                          paged_attn=impl, **ekw)
+
+        def warm_up(e):
+            warm = e.submit(reqs[0], new, seed=seed)
+            e.run_until_complete()  # compiles + publishes off the clock
+            return warm
+
+        def measure_once(e):
+            t0 = time.perf_counter()
+            handles = [e.submit(p, new, seed=seed + 1 + i)
+                       for i, p in enumerate(reqs[1:])]
+            e.run_until_complete()
+            elapsed = time.perf_counter() - t0
+            tokens = sum(len(h.tokens) for h in handles)
+            tps = tokens / elapsed if elapsed > 0 else None
+            return [h.tokens for h in handles], tps
+
+        engines = [engine("einsum"), engine("gather"),
+                   engine("kernel")]
+        warms = [warm_up(e) for e in engines]
+        # The gather oracle runs ONCE — it is a parity referee, not
+        # a measured contender.  When tokens/sec is off (CPU smoke)
+        # the einsum and kernel engines also run once, for outputs
+        # only; when it is on they interleave best-of-N with a
+        # discarded warmup rep, same as the gather-free row above.
+        timed = [want_tps, False, want_tps]
+        outs = [None] * len(engines)
+        best = [None] * len(engines)
+        for rep in range(reps + 1):
+            for i, e in enumerate(engines):
+                if rep > 0 and not timed[i]:
+                    continue
+                rep_outs, tps = measure_once(e)
+                rep_outs = [warms[i].tokens] + rep_outs
+                assert outs[i] is None or outs[i] == rep_outs
+                outs[i] = rep_outs
+                if rep == 0:
+                    continue  # warmup rep: run, verify, discard
+                if tps is not None and (best[i] is None
+                                        or tps > best[i]):
+                    best[i] = tps
+        einsum_out, gather_out, kernel_out = outs
+        tps_einsum, _, tps_kernel = best
+        parity_ok = einsum_out == gather_out == kernel_out
+        kernel_ok = parity_ok and (
+            tps_kernel is None
+            or (tps_einsum is not None and tps_kernel >= tps_einsum))
+        pa = engines[2].metrics().get("paged_attn", {})
+        emit({
+            "metric": PAGED_KERNEL_METRIC,
+            "workload": workload,
+            "traffic": traffic,
+            "value": (round(tps_kernel / tps_einsum, 3)
+                      if tps_kernel and tps_einsum else None),
+            "unit": "kernel_tokens_per_sec_vs_einsum_paged",
+            "kernel_ok": kernel_ok,
+            "parity_ok": parity_ok,
+            "tokens_per_sec_einsum": (round(tps_einsum, 1)
+                                      if tps_einsum else None),
+            "tokens_per_sec_kernel": (round(tps_kernel, 1)
+                                      if tps_kernel else None),
+            "dispatch": pa.get("dispatch"),
+            "fallbacks": pa.get("fallbacks"),
+            # the burst's later admissions hit the shared prefix as
+            # table writes with COW at the divergence block, so the
+            # parity gate covered shared pages, not just private ones
+            "prefix_hit_tokens": int(
+                engines[2].stats["prefix_hit_tokens"]),
+            "speculate_k": ekw.get("speculate_k", 0),
+            "decode_fuse": ekw.get("decode_fuse", 1),
+            "kv_pages": kv_pages,
+            "num_slots": slots,
+            "requests": len(reqs),
+            "prefix_len": prefix_len,
+            "max_new_tokens": new,
+            "prefill_chunk": chunk,
+            "num_layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "vocab_size": cfg.vocab_size,
+            "device_kind": kind,
+        })
+        bank_metrics("serve_paged_kernel", f"{workload}:{traffic}",
+                     engines[2].metrics())
+
     # One level crashing (OOM, transient backend fault) must not cost
     # the remaining rows — same isolation contract as matrix_bench.
     if tenancy_seeds:
@@ -1659,17 +1804,34 @@ def main() -> None:
         print(json.dumps({"serve_prefix": results}))
         return
     if paged_workloads:
+        # SERVE_PAGED_TRAFFIC_ROWS gates the per-traffic kernel rows:
+        # "1" (default) emits them after the capacity + gather-free
+        # rows, "0" skips them, "only" skips the capacity + gather-free
+        # rows instead — the tier-1 smoke runs the two halves at
+        # different geometries (the gather-free >= gather margin needs
+        # depth; the traffic parity gate holds at any size) without
+        # paying for both twice.  A TPU capture leaves it at the
+        # default, so one --paged rerun still refills every row.
+        traffic_rows = os.environ.get("SERVE_PAGED_TRAFFIC_ROWS", "1")
         for w in paged_workloads:
-            try:
-                run_paged(w)
-            except Exception as exc:  # noqa: BLE001
-                emit({"metric": PAGED_METRIC, "workload": w,
-                      "error": f"{type(exc).__name__}: {exc}"[:500]})
-            try:
-                run_paged_kernel(w)
-            except Exception as exc:  # noqa: BLE001
-                emit({"metric": PAGED_KERNEL_METRIC, "workload": w,
-                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+            if traffic_rows != "only":
+                try:
+                    run_paged(w)
+                except Exception as exc:  # noqa: BLE001
+                    emit({"metric": PAGED_METRIC, "workload": w,
+                          "error": f"{type(exc).__name__}: {exc}"[:500]})
+                try:
+                    run_paged_kernel(w)
+                except Exception as exc:  # noqa: BLE001
+                    emit({"metric": PAGED_KERNEL_METRIC, "workload": w,
+                          "error": f"{type(exc).__name__}: {exc}"[:500]})
+            if traffic_rows != "0":
+                try:
+                    run_paged_kernel_traffic(w)
+                except Exception as exc:  # noqa: BLE001
+                    emit({"metric": PAGED_KERNEL_METRIC, "workload": w,
+                          "traffic": "?",
+                          "error": f"{type(exc).__name__}: {exc}"[:500]})
         write_sidecar()
         print(json.dumps({"serve_paged": results}))
         return

@@ -1,0 +1,21 @@
+# tpudp: kernel-module
+"""Seeded violation for unregistered-kernel: Pallas kernels whose
+dispatch sites tie to no registered trace-audit program."""
+
+import jax.experimental.pallas as pl
+
+
+def _body(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def orphan_kernel(x):
+    # finding: no TRACE_COUNTS bump anywhere up the enclosing chain and
+    # no kernel-program marker — the kernel body is unpinned
+    return pl.pallas_call(_body, out_shape=x)(x)
+
+
+# tpudp: kernel-program(serve.not_a_program)
+def mislabeled_kernel(x):
+    # finding: the marker names a program the registry does not know
+    return pl.pallas_call(_body, out_shape=x)(x)

@@ -1,0 +1,29 @@
+# tpudp: kernel-module
+"""Corrected twin of bad_unregistered_kernel: every pallas_call site
+is tied to a registered program — through the dispatching program's
+TRACE_COUNTS bump, or a kernel-program marker naming a registered
+program."""
+
+import collections
+
+import jax.experimental.pallas as pl
+
+TRACE_COUNTS = collections.Counter()
+
+
+def _body(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+# tpudp: kernel-program(serve.decode_paged_kernel)
+def pinned_kernel(x):
+    return pl.pallas_call(_body, out_shape=x)(x)
+
+
+def counted_step(x):
+    TRACE_COUNTS["decode_paged_kernel"] += 1
+    return pl.pallas_call(_body, out_shape=x)(x)
+
+
+def plain_helper(x):                # no kernel inside: no obligation
+    return x * 2

@@ -40,6 +40,7 @@ RULE_CASES = {
     "divergent-collective": 4,   # process_index, filesystem, except,
     #                              control-dependent flag
     "unregistered-jit": 2,       # decorator-form + call-form
+    "unregistered-kernel": 2,    # unpinned site + unknown program name
     "obs-in-hot-path": 2,        # .span() + .event() on a marked hot path
 }
 

@@ -354,6 +354,13 @@ def test_serve_paged_bench_rows_parse():
         "SERVE_PREFIX_LEN": "48", "SERVE_PREFIX_TURNS": "2",
         "SERVE_PREFIX_USERS": "2", "SERVE_PREFIX_CONCURRENCY": "2",
         "SERVE_PREFIX_BLOCKS": "16", "SERVE_PAGED_KERNEL_SLOTS": "4",
+        # The per-traffic kernel rows have their own smoke
+        # (test_serve_paged_traffic_rows_parse) at a tiny geometry —
+        # their parity gate holds at any size, while THIS row's
+        # gather-free >= gather margin needs the L4/d128 depth; running
+        # the traffic rows here too would pay three interpret-mode
+        # kernel engines at the deep geometry for nothing.
+        "SERVE_PAGED_TRAFFIC_ROWS": "0",
     })
     rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
             if l.startswith("{")]
@@ -376,8 +383,9 @@ def test_serve_paged_bench_rows_parse():
     # with all three engines bit-identical.
     byk = {r["workload"]: r for r in rows
            if r.get("metric") == "serve_paged_kernel"
-           and "workload" in r}
+           and "workload" in r and "traffic" not in r}
     assert set(byk) == {"shared_prefix"}, proc.stderr[-800:]
+    assert not [r for r in rows if "traffic" in r]  # knob honored
     k = byk["shared_prefix"]
     assert "error" not in k, k
     assert k["gather_free_ok"] is True
@@ -459,6 +467,13 @@ def test_serve_paged_kernel_gap_gate(tmp_path):
         {"metric": "serve_paged", "workload": "shared_prefix",
          "value": 2.0, "capacity_ok": True, "prefix_hit_tokens": 320,
          "parity_ok": True, "device_kind": "TPU v5 lite"},
+        # nor a passing per-traffic row, even one that (nonsensically)
+        # carries gather_free_ok — the traffic field routes it to the
+        # serve_paged_traffic stage
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "traffic": "fused", "value": 1.4, "kernel_ok": True,
+         "gather_free_ok": True, "parity_ok": True,
+         "device_kind": "TPU v5 lite"},
     ]
     with open(os.path.join(d, "serve_paged.jsonl"), "w") as f:
         for r in rows:
@@ -473,6 +488,107 @@ def test_serve_paged_kernel_gap_gate(tmp_path):
     assert serve_paged_kernel_missing(d) == []  # banked history counts
 
 
+def test_serve_paged_traffic_rows_parse():
+    """The per-traffic kernel-vs-einsum rows' CPU smoke (tier-1's
+    guard on the serve_paged_kernel traffic rows the TPU watcher
+    resumes): SERVE_PAGED_TRAFFIC_ROWS=only emits one row per traffic
+    kind — prefill, verify (k=2), fused (N=4) — each with three-engine
+    parity (einsum / gather oracle / Pallas kernel, greedy tokens
+    bit-identical over the over-subscribed burst's fragmented tables)
+    and the kernel dispatch table recorded.  Off-TPU the kernel lowers
+    in interpret mode, so tokens/sec stays unmeasured (value null —
+    smoke rows can never close the bench_gaps stage) and the kernel_ok
+    gate reads parity alone.  The tiny geometry is deliberate: parity
+    is size-independent, unlike the capacity row's margin (see
+    test_serve_paged_bench_rows_parse)."""
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu",
+        "SERVE_PAGED": "shared_prefix",
+        "SERVE_PAGED_TRAFFIC_ROWS": "only",
+        "SERVE_LAYERS": "1", "SERVE_DMODEL": "64", "SERVE_VOCAB": "128",
+        "SERVE_MAX_NEW": "17", "SERVE_CHUNK": "8",
+        "SERVE_PREFIX_LEN": "16", "SERVE_PREFIX_TURNS": "2",
+        "SERVE_PREFIX_USERS": "2", "SERVE_PREFIX_CONCURRENCY": "2",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byt = {r["traffic"]: r for r in rows
+           if r.get("metric") == "serve_paged_kernel" and "traffic" in r}
+    assert set(byt) == {"prefill", "verify", "fused"}, proc.stderr[-800:]
+    # ... and ONLY the traffic rows: the capacity + gather-free halves
+    # were skipped, that's the "only" contract.
+    assert not [r for r in rows if "metric" in r and "traffic" not in r]
+    for traffic, r in byt.items():
+        assert "error" not in r, r
+        assert r["parity_ok"] is True   # einsum == gather == kernel
+        assert r["kernel_ok"] is True   # parity-only off-TPU
+        assert r["value"] is None       # no interpret-mode timings
+        assert r["tokens_per_sec_kernel"] is None
+        assert r["fallbacks"] == []     # every family dispatched
+        assert r["prefix_hit_tokens"] > 0  # shared pages + COW covered
+        assert r["dispatch"]["prefill_paged"] == "kernel"
+        assert r["dispatch"]["verify_paged"] == "kernel"
+        assert r["dispatch"]["fused_decode_paged"] == "kernel"
+    assert byt["prefill"]["max_new_tokens"] == 1
+    assert byt["verify"]["speculate_k"] == 2
+    assert byt["fused"]["decode_fuse"] == 4
+
+
+def test_serve_paged_traffic_gap_gate(tmp_path):
+    """tools/bench_gaps serve_paged_traffic stage: CPU smoke rows
+    (value null), error rows, and gate-failing rows never close a
+    (workload, traffic) pair; a measured TPU row with kernel_ok does.
+    Base serve_paged_kernel rows (no traffic field) never leak into
+    this stage and traffic rows never close the base stage — three row
+    kinds, one file, one SERVE_PAGED resume list."""
+    from tools.bench_gaps import (SERVE_PAGED_TRAFFIC,
+                                  serve_paged_kernel_missing,
+                                  serve_paged_traffic_missing)
+
+    d = str(tmp_path)
+    want = [f"shared_prefix:{t}" for t in SERVE_PAGED_TRAFFIC]
+    assert serve_paged_traffic_missing(d) == want
+    rows = [
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "traffic": "prefill", "value": None, "kernel_ok": True,
+         "parity_ok": True, "device_kind": "cpu"},     # smoke: no
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "traffic": "verify", "error": "relay wedged"},  # error: no
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "traffic": "fused", "value": 0.7, "kernel_ok": False,
+         "parity_ok": True,
+         "device_kind": "TPU v5 lite"},                # slower: no
+        # a passing BASE kernel row must not close any traffic pair
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "value": 1.1, "gather_free_ok": True, "parity_ok": True,
+         "device_kind": "TPU v5 lite"},
+        # a passing traffic row closes exactly its own pair...
+        {"metric": "serve_paged_kernel", "workload": "shared_prefix",
+         "traffic": "verify", "value": 1.3, "kernel_ok": True,
+         "parity_ok": True, "device_kind": "TPU v5 lite"},
+    ]
+    with open(os.path.join(d, "serve_paged.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_paged_traffic_missing(d) == [
+        "shared_prefix:prefill", "shared_prefix:fused"]
+    # ... and never the base stage (the base row above does that)
+    assert serve_paged_kernel_missing(d) == []
+    with open(os.path.join(d, "serve_paged.history.jsonl"), "w") as f:
+        for t in ("prefill", "fused"):
+            f.write(json.dumps(
+                {"metric": "serve_paged_kernel",
+                 "workload": "shared_prefix", "traffic": t,
+                 "value": 1.2, "kernel_ok": True, "parity_ok": True,
+                 "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_paged_traffic_missing(d) == []  # banked history counts
+
+
+@pytest.mark.slow  # ~8s; the fused serve_bench path now runs in the fast
+# tier via test_serve_paged_traffic_rows_parse (decode_fuse=4 engines
+# end-to-end through serve_bench) and fused-vs-generate parity stays via
+# test_serve_fused.py::test_greedy_parity_fused_vs_generate
+# (fast-tier margin, r4 #8)
 def test_serve_fused_bench_rows_parse():
     """The serve_fused stage's CPU smoke (tier-1's guard on the
     fused-decode bench the TPU watcher resumes): every registered
@@ -579,6 +695,13 @@ def test_serve_fused_gap_gate(tmp_path):
     assert serve_fused_missing(d) == [4]  # banked history row counts
 
 
+@pytest.mark.slow  # ~35s (4-layer target x 64-token decode x 3 engines);
+# the speculative serve_bench path now runs in the fast tier via
+# test_serve_paged_traffic_rows_parse (speculate_k=2 engines end-to-end
+# through serve_bench) and fused-spec parity/accounting stays via
+# test_spec_fused.py::test_fused_spec_greedy_parity_and_accounting;
+# the gap-gate logic keeps its own fast synthetic test
+# (fast-tier margin, r4 #8)
 def test_serve_spec_fused_bench_rows_parse():
     """The serve_spec_fused stage's CPU smoke (tier-1's guard on the
     on-device fused-speculation bench the TPU watcher resumes): every

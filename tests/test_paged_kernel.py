@@ -11,15 +11,24 @@ entirely through the gather-free einsum default):
      exactly ONE token row of exactly ONE real page
      (``write_token_pages``), never a page unroll, never the view
      scatter; inactive/unmapped writes route to the scratch page.
-  3. KERNEL ORACLE — the Pallas paged-decode kernel (interpret mode on
+  3. KERNEL ORACLE — every Pallas serving kernel (interpret mode on
      the CPU host) matches the gather-based oracle within fp tolerance
      across FRAGMENTED tables: shared prefix pages mapped by several
      slots, a copy-on-write divergence page, unmapped ``-1`` tail
      entries clamping to scratch — and dequantizes int8 pages
-     in-kernel within the quantization bound.
+     in-kernel within the quantization bound.  The matrix covers the
+     paged-decode kernel (``cur == 1``), the flash-window kernel on
+     both the k+1 verify shape (vector ``pos``) and the prefill-chunk
+     shape (scalar ``pos``, causal in-chunk), and the tree-verify
+     kernel (ancestor-or-self window mask, strict ``< pos0`` cache
+     visibility); engine-level token-equality pins cover verify,
+     fused-decode, fused-spec, and tree traffic plus the per-backend
+     default resolution and the int8-tree einsum fallback.
   4. LEDGER DELTA — the committed trace-lock budgets sit STRICTLY below
      the PR 13 gather-based peak-live values (the committed proof the
-     gather is gone), pinned against the historical numbers.
+     gather is gone), pinned against the historical numbers; and every
+     kernel program's committed peak sits STRICTLY below its einsum
+     twin's (the whole-hot-path memory claim), pinned the same way.
 """
 
 import json
@@ -69,6 +78,12 @@ def _reference(model, params, prompt, n):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~6s; gather≡einsum engine equality now runs in the
+# fast tier via test_bench_smoke.py::test_serve_paged_traffic_rows_parse
+# (three-engine einsum/gather/kernel parity on fragmented tables, warm
+# admission included) plus the op-level oracle tests above; the sampled
+# path keeps test_paged.py::test_paged_sampled_parity and the sampled
+# legs of _run_traffic below (fast-tier margin, r4 #8)
 def test_gather_and_einsum_engines_bit_identical(model_and_params):
     """The gather-free default ≡ the kept gather baseline ≡ generate()
     for greedy AND seeded-sampled traffic with a warm (table-write hit)
@@ -102,12 +117,59 @@ def test_paged_attn_validation(model_and_params):
         Engine(model, params, kv_pages=12, paged_attn="flash")
     with pytest.raises(ValueError, match="requires kv_pages"):
         Engine(model, params, paged_attn="gather")
-    with pytest.raises(ValueError, match="single-step decode"):
-        Engine(model, params, kv_pages=12, paged_attn="kernel",
-               decode_fuse=4)
-    with pytest.raises(ValueError, match="single-step decode"):
-        Engine(model, params, kv_pages=12, paged_attn="kernel",
-               speculate_k=2)
+    # The kernel hot path now covers fused decode and speculative
+    # verify — these used to raise "single-step decode only"; today
+    # they build and dispatch kernel programs across the board.
+    eng = Engine(model, params, kv_pages=12, paged_attn="kernel",
+                 decode_fuse=4, speculate_k=2)
+    assert eng.paged_attn == "kernel"
+    assert set(eng.paged_attn_dispatch.values()) == {"kernel"}
+
+
+def test_paged_attn_default_resolution(model_and_params):
+    """``paged_attn=None`` (the new default) resolves per backend: CPU
+    hosts silently land on the bit-exact einsum path, the request is
+    recorded, and dense engines carry no paged dispatch state at all."""
+    import jax
+
+    model, params = model_and_params
+    assert jax.default_backend() == "cpu"  # tier-1 runs JAX_PLATFORMS=cpu
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+                 kv_pages=12)
+    assert eng.paged_attn_requested is None
+    assert eng.paged_attn == "einsum"
+    m = eng.metrics()
+    assert m["paged_attn"]["requested"] is None
+    assert m["paged_attn"]["resolved"] == "einsum"
+    assert m["paged_attn"]["fallbacks"] == []
+    # dense engine: no paged arena, no paged_attn dispatch surface
+    dense = Engine(model, params, num_slots=2, max_len=48,
+                   prefill_chunk=8)
+    assert "paged_attn" not in dense.metrics()
+    # an explicit einsum request on a dense engine stays allowed (it is
+    # the resolved default everywhere), any other impl still demands
+    # pages to exist
+    Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+           paged_attn="einsum")
+
+
+def test_kernel_int8_tree_fallback_visible_in_metrics(model_and_params):
+    """The one per-program einsum fallback in the kernel default:
+    int8 pools keep tree-verify on the bit-exact einsum path (the tree
+    kernel's in-kernel dequant is fp-only), and the engine's metrics
+    surface exactly that dispatch decision."""
+    model, params = model_and_params
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+                 kv_pages=12, kv_dtype="int8", paged_attn="kernel",
+                 speculate_k=2, speculate_tree="fork2x2")
+    m = eng.metrics()["paged_attn"]
+    assert m["resolved"] == "kernel"
+    assert m["dispatch"]["tree_verify_paged"] == "einsum"
+    assert m["fallbacks"] == ["tree_verify_paged"]
+    # every other family stays kernel
+    others = {f: i for f, i in m["dispatch"].items()
+              if f != "tree_verify_paged"}
+    assert set(others.values()) == {"kernel"}
 
 
 # ---------------------------------------------------------------------------
@@ -196,11 +258,14 @@ def test_engine_decode_step_writes_exactly_one_page(model_and_params):
 # ---------------------------------------------------------------------------
 
 
-def _fragmented_fixture(kv_dtype=None, seed=2):
+def _fragmented_fixture(kv_dtype=None, seed=2, cur=1, scalar_pos=None):
     """A pool + tables shaped like real COW traffic: slots 0 and 1 MAP
     THE SAME prefix pages (shared system prompt), diverge into private
     pages, and leave ``-1`` tail entries (clamping to scratch); slot 2
-    is shallower.  Returns (pages tuple, table, pos, q, cfg-ish dims)."""
+    is shallower.  ``cur`` widens the query window (the verify / prefill
+    kernels' multi-token shape); ``scalar_pos`` swaps the per-slot depth
+    vector for the prefill chunk's shared scalar depth.  Returns
+    (pages tuple, table, pos, q, cfg-ish dims)."""
     rng = np.random.default_rng(seed)
     S, M, T, H, KV, DH = 3, 4, 8, 4, 2, 16
     P = 8
@@ -217,17 +282,24 @@ def _fragmented_fixture(kv_dtype=None, seed=2):
         [0, 1, 3, 4],    # same prefix, different COW page, one deeper
         [5, -1, -1, -1],  # shallow slot
     ], np.int32))
-    pos = jnp.asarray([17, 26, 4], jnp.int32)
-    q = jnp.asarray(rng.standard_normal((S, 1, H, DH)), jnp.float32)
+    pos = (jnp.int32(scalar_pos) if scalar_pos is not None
+           else jnp.asarray([17, 26, 4], jnp.int32))
+    q = jnp.asarray(rng.standard_normal((S, cur, H, DH)), jnp.float32)
     return pages, table, pos, q, (S, M, T, H, KV, DH, P)
 
 
 def _gather_oracle(pages, table, pos, q, dims):
     """gather_pages' math (one layer) + the dense grouped einsums —
-    PR 13's exact gather→dense path, spelled as the oracle."""
+    PR 13's exact gather→dense path, spelled as the oracle.  Window
+    position ``j`` attends keys ``<= pos + j`` (the engine's
+    write-before-attend contract), which covers decode (``cur == 1``),
+    the k+1 verify window (vector ``pos``) and the prefill chunk
+    (scalar ``pos``) with the same math."""
     import jax
 
     S, M, T, H, KV, DH, P = dims
+    cur = q.shape[1]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (S,))
     # exactly gather_pages' per-layer semantics: -1 clamps to scratch,
     # int8 dequantizes after the gather
     tbl = jnp.where(table >= 0, table, P)
@@ -241,7 +313,7 @@ def _gather_oracle(pages, table, pos, q, dims):
 
     kc, vc = grab(0), grab(1)  # (S, M*T, KV, DH)
     G = H // KV
-    qg = q.reshape(S, 1, KV, G, DH)
+    qg = q.reshape(S, cur, KV, G, DH)
     scale = DH ** -0.5
 
     def _attend(qj, pj):
@@ -252,9 +324,9 @@ def _gather_oracle(pages, table, pos, q, dims):
         pr = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
         return jnp.einsum("bkgm,bmkd->bkgd", pr, vc)
 
-    q_pos = pos[:, None] + jnp.arange(1)
+    q_pos = pos[:, None] + jnp.arange(cur)
     out = jax.vmap(_attend, in_axes=(1, 1), out_axes=1)(qg, q_pos)
-    return out.reshape(S, 1, H, DH)
+    return out.reshape(S, cur, H, DH)
 
 
 def test_kernel_matches_gather_oracle_on_fragmented_tables():
@@ -292,14 +364,112 @@ def test_kernel_int8_in_kernel_dequant_tolerance():
     assert np.max(np.abs(fp_oracle - kernel8)) > 0  # really quantized
 
 
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_verify_window_kernel_matches_gather_oracle(kv_dtype):
+    """The flash-window kernel on the k+1 VERIFY shape (multi-token
+    window, per-slot depth vector) vs the gather-based oracle on
+    fragmented tables: per-row visibility ``k_pos <= pos + j`` agrees
+    within fp tolerance; the fp einsum backend agrees with the oracle
+    BITWISE (it is the engine's auto-fallback, so the fallback must be
+    provably exact)."""
+    pages, table, pos, q, dims = _fragmented_fixture(
+        kv_dtype=kv_dtype, cur=3)
+    oracle = np.asarray(_gather_oracle(pages, table, pos, q, dims))
+    einsum = np.asarray(paged_attention(
+        q, pages, table, pos, dtype=jnp.float32, grouped=True))
+    if kv_dtype is None:
+        np.testing.assert_array_equal(oracle, einsum)
+    else:
+        np.testing.assert_allclose(oracle, einsum, rtol=2e-6, atol=2e-6)
+    kernel = np.asarray(paged_attention(
+        q, pages, table, pos, dtype=jnp.float32, grouped=True,
+        impl="kernel", interpret=True))
+    np.testing.assert_allclose(oracle, kernel, rtol=2e-6, atol=2e-6)
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_prefill_chunk_kernel_matches_gather_oracle(kv_dtype):
+    """The flash-prefill kernel shape — a page-wide chunk at a shared
+    SCALAR depth, causal in-chunk masking — vs the same gather oracle.
+    Every slot's window page is mapped (the engine preallocates pages
+    under the window before dispatch; on a violating table the einsum
+    path attends scratch garbage while the kernel skips the page, so
+    the contract only defines mapped-window traffic), while ``-1``
+    tails BEYOND the visibility edge stay in the table — masked
+    garbage on both sides, so they must agree there too."""
+    pages, table, pos, q, dims = _fragmented_fixture(
+        kv_dtype=kv_dtype, cur=8, scalar_pos=16)
+    table = table.at[2].set(jnp.asarray([5, 6, 7, -1], jnp.int32))
+    oracle = np.asarray(_gather_oracle(pages, table, pos, q, dims))
+    einsum = np.asarray(paged_attention(
+        q, pages, table, pos, dtype=jnp.float32, grouped=True))
+    if kv_dtype is None:
+        np.testing.assert_array_equal(oracle, einsum)
+    else:
+        np.testing.assert_allclose(oracle, einsum, rtol=2e-6, atol=2e-6)
+    kernel = np.asarray(paged_attention(
+        q, pages, table, pos, dtype=jnp.float32, grouped=True,
+        impl="kernel", interpret=True))
+    np.testing.assert_allclose(oracle, kernel, rtol=2e-6, atol=2e-6)
+
+
+def test_tree_kernel_matches_masked_dense_oracle():
+    """The tree-verify kernel vs a dense masked reference on fragmented
+    tables: cache visibility is STRICT ``< pos0`` (node 0 re-attends
+    its own position from the window, not the pages) and in-window
+    visibility is ancestor-or-self; the window K/V never touch the
+    pool."""
+    import jax
+
+    from tpudp.ops.paged_attention import tree_paged_attention
+
+    rng = np.random.default_rng(5)
+    pages, table, pos0, _, dims = _fragmented_fixture()
+    S, M, T, H, KV, DH, P = dims
+    parents = (-1, 0, 1, 0, 3)
+    t1 = len(parents)
+    anc = np.zeros((t1, t1), np.int32)
+    for j in range(t1):
+        c = j
+        while c != -1:
+            anc[j, c] = 1
+            c = parents[c]
+    q = jnp.asarray(rng.standard_normal((S, t1, H, DH)), jnp.float32)
+    wk = jnp.asarray(rng.standard_normal((S, t1, KV, DH)), jnp.float32)
+    wv = jnp.asarray(rng.standard_normal((S, t1, KV, DH)), jnp.float32)
+
+    tbl = jnp.where(table >= 0, table, P)
+    kc = pages[0][tbl].reshape(S, M * T, KV, DH)
+    vc = pages[1][tbl].reshape(S, M * T, KV, DH)
+    kk = jnp.concatenate([kc, wk], axis=1)
+    vv = jnp.concatenate([vc, wv], axis=1)
+    G = H // KV
+    qg = q.reshape(S, t1, KV, G, DH)
+    lg = jnp.einsum("bjkgd,btkd->bjkgt", qg, kk) * (DH ** -0.5)
+    cache_vis = jnp.arange(M * T)[None, :] < pos0[:, None]
+    vis = jnp.concatenate(
+        [jnp.broadcast_to(cache_vis[:, None], (S, t1, M * T)),
+         jnp.broadcast_to((jnp.asarray(anc) > 0)[None], (S, t1, t1))],
+        axis=2)
+    lg = jnp.where(vis[:, :, None, None], lg, -1e30)
+    pr = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+    ref = jnp.einsum("bjkgt,btkd->bjkgd", pr, vv).reshape(S, t1, H, DH)
+
+    out = tree_paged_attention(q, pages, table, pos0, wk, wv,
+                               tuple(map(tuple, anc)), dtype=jnp.float32,
+                               interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-6, atol=2e-6)
+
+
 def test_kernel_engine_decode_end_to_end(model_and_params):
     """Engine(paged_attn='kernel'): the single-token decode program
     dispatches the Pallas kernel (its OWN trace-count key — the pinned
-    ``decode_paged_kernel`` program), prefill stays on the exact
-    einsum path, and greedy outputs match generate() on this geometry
-    (the tiny model's argmax gaps dwarf the kernel's fp tolerance;
-    the contract is tolerance-bounded, not bit-exact — exactly
-    flash's)."""
+    ``decode_paged_kernel`` program), prefill chunks run the
+    flash-prefill kernel (``prefill_paged_kernel``), and greedy outputs
+    match generate() on this geometry (the tiny model's argmax gaps
+    dwarf the kernel's fp tolerance; the contract is tolerance-bounded,
+    not bit-exact — exactly flash's)."""
     model, params = model_and_params
     rng = np.random.default_rng(3)
     prompts = [rng.integers(0, 61, size=9 + 3 * i).astype(np.int32)
@@ -314,6 +484,81 @@ def test_kernel_engine_decode_end_to_end(model_and_params):
         np.testing.assert_array_equal(_reference(model, params, p, 5),
                                       np.asarray(h.tokens))
     eng.check_paged()
+
+
+def _run_traffic(model, params, paged_attn, **engine_kw):
+    """One engine's worth of mixed traffic: greedy with a shared-prefix
+    admission pattern, then a seeded-sampled request — the matrix the
+    kernel-vs-einsum token-equality pins run over."""
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, 61, size=16).astype(np.int32)
+    prompts = [np.concatenate([shared, rng.integers(0, 61, size=3 + i)
+                               .astype(np.int32)]) for i in range(3)]
+    eng = Engine(model, params, num_slots=2, max_len=48, prefill_chunk=8,
+                 kv_pages=12, paged_attn=paged_attn, **engine_kw)
+    greedy = [eng.submit(p, 5) for p in prompts]
+    eng.run_until_complete()
+    sampled = eng.submit(prompts[0], 6, temperature=0.9, top_k=12, seed=7)
+    eng.run_until_complete()
+    return [h.tokens for h in greedy] + [sampled.tokens]
+
+
+def test_kernel_engine_verify_window_matches_einsum(model_and_params):
+    """Engine(speculate_k=2, paged_attn='kernel'): the k+1 verify
+    window runs the flash-window kernel (its own pinned
+    ``verify_paged_kernel`` program) and greedy AND seeded-sampled
+    tokens match the einsum twin exactly on this geometry."""
+    model, params = model_and_params
+    before = TRACE_COUNTS["verify_paged_kernel"]
+    kern = _run_traffic(model, params, "kernel", speculate_k=2)
+    assert TRACE_COUNTS["verify_paged_kernel"] > before
+    assert _run_traffic(model, params, "einsum", speculate_k=2) == kern
+
+
+def test_kernel_engine_fused_decode_matches_einsum(model_and_params):
+    """Engine(decode_fuse=4, paged_attn='kernel'): every iteration of
+    the fused ``lax.while_loop`` dispatches the paged-decode kernel
+    (``fused_decode_paged_kernel``) and tokens match the einsum twin
+    for greedy and sampled traffic."""
+    model, params = model_and_params
+    before = TRACE_COUNTS["fused_decode_paged_kernel"]
+    kern = _run_traffic(model, params, "kernel", decode_fuse=4)
+    assert TRACE_COUNTS["fused_decode_paged_kernel"] > before
+    assert _run_traffic(model, params, "einsum", decode_fuse=4) == kern
+
+
+@pytest.mark.slow
+def test_kernel_engine_fused_spec_and_tree_match_einsum(model_and_params):
+    """The remaining two kernel programs end-to-end (slow tier: each
+    build compiles a draft model alongside the target): the fused
+    speculative window (``fused_spec_paged_kernel``) and the static
+    tree verify (``tree_verify_paged_kernel``) match their einsum
+    twins token-for-token."""
+    from tpudp.models.gpt2 import gpt2_small as _small
+    from tpudp.serve.speculate import DraftModelDrafter
+
+    model, params = model_and_params
+    draft = _small(vocab_size=61, max_seq_len=96, num_layers=1,
+                   num_heads=2, d_model=16)
+    dparams = init_state(draft, make_optimizer(),
+                         input_shape=(1, 8)).params
+
+    def drafter():
+        return DraftModelDrafter(draft, dparams)
+
+    before = TRACE_COUNTS["fused_spec_paged_kernel"]
+    kern = _run_traffic(model, params, "kernel", speculate_k=2,
+                        decode_fuse=4, drafter=drafter())
+    assert TRACE_COUNTS["fused_spec_paged_kernel"] > before
+    assert _run_traffic(model, params, "einsum", speculate_k=2,
+                        decode_fuse=4, drafter=drafter()) == kern
+
+    before = TRACE_COUNTS["tree_verify_paged_kernel"]
+    kern = _run_traffic(model, params, "kernel", speculate_k=2,
+                        speculate_tree="fork2x2")
+    assert TRACE_COUNTS["tree_verify_paged_kernel"] > before
+    assert _run_traffic(model, params, "einsum", speculate_k=2,
+                        speculate_tree="fork2x2") == kern
 
 
 # ---------------------------------------------------------------------------
@@ -339,3 +584,47 @@ def test_budget_ledger_strictly_below_pr13_gather_values():
     names = [n for n in progs
              if n.startswith("serve.decode_paged_kernel@")]
     assert names and progs[names[0]]["budget"]["peak_live_bytes"] > 0
+
+
+#: The einsum twins' committed peak_live_bytes at the audit smoke
+#: geometry (s2m32p6...) — the bar every kernel program must beat.
+#: Hardcoded like the PR 13 gather pins above: regenerating the lock
+#: cannot silently weaken the claim.
+EINSUM_TWIN_PEAK_LIVE = {
+    "serve.decode_paged_kernel": ("serve.decode_paged", 178_806),
+    "serve.verify_paged_kernel": ("serve.verify_paged", 181_934),
+    "serve.prefill_paged_kernel": ("serve.prefill_paged", 174_665),
+    "serve.fused_decode_paged_kernel": ("serve.fused_decode_paged",
+                                        193_206),
+    "serve.fused_spec_paged_kernel": ("serve.fused_spec_paged", 241_362),
+    "serve.tree_verify_paged_kernel": ("serve.tree_verify_paged",
+                                       212_188),
+}
+
+
+def test_kernel_programs_peak_live_strictly_below_einsum_twins():
+    """Every kernel program's committed peak_live_bytes sits STRICTLY
+    below its einsum twin's — both the twin's live lock row and the
+    hardcoded value above (so neither side of the comparison can drift
+    without this test noticing).  This is the whole-hot-path memory
+    claim: whole-pool committed writes + BlockSpec layer indexing mean
+    the kernel builds never materialize a per-layer page slice, an
+    attention score tile, or the einsum path's softmax intermediates
+    at XLA level."""
+    with open(os.path.join(ROOT, "tools", "trace_lock.json")) as f:
+        progs = json.load(f)["programs"]
+
+    def peak(prefix):
+        names = [n for n in progs if n.startswith(prefix + "@")]
+        assert names, f"{prefix} missing from the lock"
+        return progs[names[0]]["budget"]["peak_live_bytes"]
+
+    for kern, (eins, pinned) in EINSUM_TWIN_PEAK_LIVE.items():
+        kp, ep = peak(kern), peak(eins)
+        assert ep == pinned, (
+            f"{eins}: committed peak_live_bytes {ep} drifted from the "
+            f"pinned {pinned} — re-derive the pin (and the claim) "
+            f"deliberately, not by regenerating the lock")
+        assert 0 < kp < ep, (
+            f"{kern}: peak_live_bytes {kp} not strictly below the "
+            f"einsum twin's {ep}")

@@ -333,9 +333,15 @@ def test_program_donations_mirror_rules_tables():
         # _ModelState.decode_paged attribute (same signature/donations)
         "serve.decode_paged_kernel": "decode_paged",
         "serve.verify_paged": "verify_paged",
+        # ... and likewise for the remaining ISSUE 17 kernel twins:
+        # each dispatches through the same engine attribute as its
+        # einsum sibling, so signatures and donations are shared.
+        "serve.verify_paged_kernel": "verify_paged",
         "serve.prefill_paged": "prefill_paged",
+        "serve.prefill_paged_kernel": "prefill_paged",
         "serve.fused_decode_paged": "fused_paged",
         "serve.fused_decode_paged_stream": "fused_paged",
+        "serve.fused_decode_paged_kernel": "fused_paged",
         # On-device speculation: fused window + tree-verify programs
         # (dense and paged twins) donate the target arena/pool + obs
         # counters; the draft KV is loop-carry scratch with no row.
@@ -343,8 +349,10 @@ def test_program_donations_mirror_rules_tables():
         "serve.fused_spec_decode_stream": "fused_spec_step",
         "serve.fused_spec_paged": "fused_spec_paged",
         "serve.fused_spec_paged_stream": "fused_spec_paged",
+        "serve.fused_spec_paged_kernel": "fused_spec_paged",
         "serve.tree_verify": "tree_step",
         "serve.tree_verify_paged": "tree_paged",
+        "serve.tree_verify_paged_kernel": "tree_paged",
         "prefix.copy_block_in": "copy_block_in",
         "prefix.copy_block_out": "copy_block_out",
         "train.step_single": "train_step",

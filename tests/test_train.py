@@ -223,6 +223,10 @@ def test_gspmd_matches_single_device_without_bn(mesh8):
     np.testing.assert_allclose(gspmd, single, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~21s compiling the full VGG conv stack under GSPMD;
+# gspmd-mode semantics stay fast-tier via the cheaper siblings
+# test_gspmd_matches_single_device_without_bn (trajectory) and
+# test_gspmd_bn_is_syncbn_semantics (BN path) (fast-tier margin, r4 #8)
 def test_gspmd_vgg_step_compiles(mesh8):
     """GSPMD VGG step (BN included) compiles and executes on the mesh."""
     batches = _fake_batches(1, seed=5)

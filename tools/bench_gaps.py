@@ -96,6 +96,18 @@ SERVE_PREFIX_WORKLOADS = ("shared_prefix", "multiturn")
 # cache actually served (prefix_hit_tokens > 0), and greedy outputs
 # were bit-identical between the two engines (parity_ok).
 SERVE_PAGED_WORKLOADS = ("shared_prefix",)
+# Paged-attention traffic kinds whose kernel-vs-einsum throughput the
+# same --paged invocation must measure on the TPU (serve_bench.py
+# emits one serve_paged_kernel row per kind with a ``traffic`` field:
+# prefill = chunked prompt ingestion through the flash-prefill kernel,
+# verify = k=2 host speculation through the multi-token verify-window
+# kernel, fused = 4-token in-loop decode windows dispatching the
+# decode kernel inside the while body).  A row closes its
+# (workload, traffic) pair only when the kernel at least matched the
+# einsum fallback's tokens/sec with all three engines — einsum, the
+# PR 13 gather oracle, and the kernel — bit-identical over fragmented
+# tables (kernel_ok, which folds in parity_ok).
+SERVE_PAGED_TRAFFIC = ("prefill", "verify", "fused")
 # Fused decode window sizes (serve_bench.py --decode-fuse: one
 # lax.while_loop program runs up to N decode steps on device per host
 # dispatch — the on-device decode loop, ROADMAP "kill the per-token
@@ -199,11 +211,12 @@ def measured(r: dict) -> bool:
     if "error" in r:
         return False
     if "config" in r:
-        return r.get("value", 0) > 0
+        return (r.get("value") or 0) > 0
     if "t" in r:
         return bool(r.get("flash_ms"))
-    if "metric" in r:  # bench.py headline rows
-        return r.get("value", 0) > 0
+    if "metric" in r:  # bench.py headline rows (value may be null: a
+        # CPU-smoke traffic row that deliberately skipped timing)
+        return (r.get("value") or 0) > 0
     if "variant" in r:  # mfu_attribution.py rows
         return r.get("sec_per_step", 0) > 0
     if "strategy" in r:  # collective_bench.py rows
@@ -326,12 +339,38 @@ def serve_paged_kernel_missing(d: str) -> list[str]:
     done = set()
     for r in rows_with_history(os.path.join(d, "serve_paged.jsonl")):
         if (r.get("metric") == "serve_paged_kernel"
+                and "traffic" not in r  # traffic rows have their own stage
                 and r.get("workload") in SERVE_PAGED_WORKLOADS
                 and measured(r)
                 and r.get("gather_free_ok") is True
                 and "TPU" in str(r.get("device_kind", ""))):
             done.add(r["workload"])
     return [w for w in SERVE_PAGED_WORKLOADS if w not in done]
+
+
+def serve_paged_traffic_missing(d: str) -> list[str]:
+    """Kernel-vs-einsum traffic rows still owed (the per-traffic
+    ``serve_paged_kernel`` rows — ``traffic`` in prefill / verify /
+    fused — the same ``--paged`` invocation emits after the gather-free
+    row).  A pair closes only when the row measured a real kernel/einsum
+    throughput ratio (``value`` > 0; CPU smoke rows never measure one —
+    interpret mode times the interpreter, so tokens/sec is only taken on
+    a TPU), the kernel at least matched the einsum fallback with all
+    three engines bit-identical over fragmented tables (``kernel_ok``,
+    which folds in ``parity_ok``), and the row is from the TPU.  Same
+    file, same SERVE_PAGED resume contract — one rerun refills every
+    row of the workload."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_paged.jsonl")):
+        if (r.get("metric") == "serve_paged_kernel"
+                and r.get("workload") in SERVE_PAGED_WORKLOADS
+                and r.get("traffic") in SERVE_PAGED_TRAFFIC
+                and measured(r)
+                and r.get("kernel_ok") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add((r["workload"], r["traffic"]))
+    return [f"{w}:{t}" for w in SERVE_PAGED_WORKLOADS
+            for t in SERVE_PAGED_TRAFFIC if (w, t) not in done]
 
 
 def serve_fused_missing(d: str) -> list[int]:
@@ -682,6 +721,7 @@ def main() -> None:
                                      "serve_spec_fused",
                                      "serve_soak", "serve_prefix",
                                      "serve_paged", "serve_paged_kernel",
+                                     "serve_paged_traffic",
                                      "serve_tenancy",
                                      "train_soak",
                                      "train_soak_multihost", "analysis",
@@ -725,6 +765,8 @@ def main() -> None:
         print(",".join(serve_paged_missing(args.dir)), end="")
     elif args.stage == "serve_paged_kernel":
         print(",".join(serve_paged_kernel_missing(args.dir)), end="")
+    elif args.stage == "serve_paged_traffic":
+        print(",".join(serve_paged_traffic_missing(args.dir)), end="")
     elif args.stage == "analysis":
         print(",".join(analysis_missing()), end="")
     elif args.stage == "obs":

@@ -336,7 +336,8 @@ def main() -> None:
     # the gather baseline's, with all three engines bit-identical —
     # the same criteria as bench_gaps.serve_paged_kernel_missing.
     paged_k = _dedupe((r for r in paged_rows
-                       if r.get("metric") == "serve_paged_kernel"),
+                       if r.get("metric") == "serve_paged_kernel"
+                       and "traffic" not in r),
                       "workload")
     for r in sorted(paged_k.values(),
                     key=lambda r: str(r.get("workload"))):
@@ -357,6 +358,37 @@ def main() -> None:
                   f"{r.get('tokens_per_sec_gather')} tok/s; dense "
                   f"{r.get('tokens_per_sec_dense')}{kern_s}) at "
                   f"{r.get('pool_bytes')} pool bytes, parity intact | "
+                  f"`serve_bench.py --paged` | |")
+
+    # Per-traffic kernel-vs-einsum rows (serve_paged_kernel rows
+    # carrying a ``traffic`` field — prefill / verify / fused):
+    # pass/fail on the kernel_ok gate — Pallas kernel tokens/sec at
+    # least the einsum fallback's, with the einsum, gather-oracle, and
+    # kernel engines bit-identical over fragmented tables — the same
+    # criteria as bench_gaps.serve_paged_traffic_missing.
+    paged_t = _dedupe(
+        ({**r, "_wt": f"{r.get('workload')}:{r.get('traffic')}"}
+         for r in paged_rows
+         if r.get("metric") == "serve_paged_kernel" and "traffic" in r),
+        "_wt")
+    for r in sorted(paged_t.values(), key=lambda r: r["_wt"]):
+        tag = f"{r.get('workload')} {r.get('traffic')}"
+        if not measured(r) or r.get("kernel_ok") is not True:
+            why = r.get("error") or (
+                "parity broken" if r.get("parity_ok") is False
+                else "kernel slower than the einsum fallback"
+                if r.get("kernel_ok") is False
+                else "no real measurement")
+            print(f"| serve_paged_kernel {tag} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --paged` | |")
+        else:
+            print(f"| paged kernel, {tag} traffic | "
+                  f"**{r['value']}x vs einsum-paged** "
+                  f"({r.get('tokens_per_sec_kernel')} vs "
+                  f"{r.get('tokens_per_sec_einsum')} tok/s at "
+                  f"{r.get('num_slots')} slots, k="
+                  f"{r.get('speculate_k')}, fuse={r.get('decode_fuse')})"
+                  f", three-engine parity intact | "
                   f"`serve_bench.py --paged` | |")
 
     # Multi-tenant rows render pass/fail on the tenancy gates: the high

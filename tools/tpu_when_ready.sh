@@ -108,13 +108,15 @@ serve_prefix_ok() {
   [ -z "$out" ]
 }
 serve_paged_ok() {
-  # One --paged invocation fills BOTH row kinds (capacity + the
-  # gather-free-vs-gather serve_paged_kernel throughput rows), so the
-  # stage is good only when neither gap list has entries.
-  local out kout
+  # One --paged invocation fills ALL THREE row kinds (capacity, the
+  # gather-free-vs-gather serve_paged_kernel throughput rows, and the
+  # per-traffic kernel-vs-einsum rows), so the stage is good only when
+  # none of the gap lists has entries.
+  local out kout tout
   out=$(python tools/bench_gaps.py serve_paged) || return 1
   kout=$(python tools/bench_gaps.py serve_paged_kernel) || return 1
-  [ -z "$out" ] && [ -z "$kout" ]
+  tout=$(python tools/bench_gaps.py serve_paged_traffic) || return 1
+  [ -z "$out" ] && [ -z "$kout" ] && [ -z "$tout" ]
 }
 serve_tenancy_ok() {
   local out; out=$(python tools/bench_gaps.py serve_tenancy) || return 1
@@ -434,13 +436,19 @@ while true; do
       # via bench_gaps, like the serve_prefix stage.  The same run
       # emits the serve_paged_kernel rows (gather-free vs gather-paged
       # vs dense decode tokens/sec at fixed pool bytes, gated
-      # gather_free_ok), so the resume list is the union of both gaps.
+      # gather_free_ok) AND the per-traffic kernel-vs-einsum rows
+      # (prefill/verify/fused, gated kernel_ok), so the resume list is
+      # the union of all three gaps.
       bank bench_results/serve_paged.jsonl
       ensure_window
       SERVE_PAGED="$(python - <<'PYEOF'
-from tools.bench_gaps import serve_paged_kernel_missing, serve_paged_missing
+from tools.bench_gaps import (serve_paged_kernel_missing,
+                              serve_paged_missing,
+                              serve_paged_traffic_missing)
 missing = dict.fromkeys(serve_paged_missing("bench_results"))
 missing.update(dict.fromkeys(serve_paged_kernel_missing("bench_results")))
+missing.update(dict.fromkeys(
+    m.split(":", 1)[0] for m in serve_paged_traffic_missing("bench_results")))
 print(",".join(missing), end="")
 PYEOF
 )" \

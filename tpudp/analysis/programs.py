@@ -60,12 +60,17 @@ TRACE_COUNTER_PROGRAMS = {
     "decode_paged": "serve.decode_paged",
     "decode_paged_kernel": "serve.decode_paged_kernel",
     "verify_paged": "serve.verify_paged",
+    "verify_paged_kernel": "serve.verify_paged_kernel",
     "prefill_paged": "serve.prefill_paged",
+    "prefill_paged_kernel": "serve.prefill_paged_kernel",
     "fused_decode_paged": "serve.fused_decode_paged",
+    "fused_decode_paged_kernel": "serve.fused_decode_paged_kernel",
     "fused_spec_decode": "serve.fused_spec_decode",
     "fused_spec_paged": "serve.fused_spec_paged",
+    "fused_spec_paged_kernel": "serve.fused_spec_paged_kernel",
     "tree_verify": "serve.tree_verify",
     "tree_verify_paged": "serve.tree_verify_paged",
+    "tree_verify_paged_kernel": "serve.tree_verify_paged_kernel",
     "prefix_block_in": "prefix.copy_block_in",
     "prefix_block_out": "prefix.copy_block_out",
     "draft_model": "serve.draft_model",
@@ -86,14 +91,18 @@ PROGRAM_DONATIONS = {
     "serve.fused_decode_stream": (0, 11),
     # Paged twins (Engine(kv_pages=N)): the shared page POOL donates in
     # place of the dense arena; the block table is host-authoritative
-    # and never donated.  The kernel twin (Engine(paged_attn='kernel'))
-    # shares the einsum twin's signature and donation facts.
+    # and never donated.  The kernel twins (Engine(paged_attn='kernel')
+    # — the TPU default) share their einsum twins' signatures and
+    # donation facts program-for-program.
     "serve.decode_paged": (0, 9),
     "serve.decode_paged_kernel": (0, 9),
     "serve.verify_paged": (0, 10),
+    "serve.verify_paged_kernel": (0, 10),
     "serve.prefill_paged": (0,),
+    "serve.prefill_paged_kernel": (0,),
     "serve.fused_decode_paged": (0, 12),
     "serve.fused_decode_paged_stream": (0, 12),
+    "serve.fused_decode_paged_kernel": (0, 12),
     # On-device speculation (Engine(speculate_k=k, decode_fuse=N,
     # drafter=DraftModelDrafter(...))): the fused draft→verify→accept
     # while_loop donates the target arena/pool and the counters — the
@@ -104,8 +113,10 @@ PROGRAM_DONATIONS = {
     "serve.fused_spec_decode_stream": (0, 12),
     "serve.fused_spec_paged": (0, 13),
     "serve.fused_spec_paged_stream": (0, 13),
+    "serve.fused_spec_paged_kernel": (0, 13),
     "serve.tree_verify": (0, 9),
     "serve.tree_verify_paged": (0, 10),
+    "serve.tree_verify_paged_kernel": (0, 10),
     "serve.sample_row": (),
     "serve.draft_model": (),
     "prefix.copy_block_in": (0,),
@@ -335,20 +346,45 @@ def build_programs() -> dict:
         functools.partial(tree_paged, parents=TREE_PARENTS),
         (pool, table, h["tree"], h["lens"], h["active"], h["ndraft"],
          h["temps"], h["topk"], h["topp"], h["keys"], h["counts"]))
-    # The Pallas paged-decode kernel twin (Engine(paged_attn='kernel')):
-    # same signature/donations as serve.decode_paged, but the attention
-    # contraction is the online-softmax kernel with the table as scalar
-    # prefetch — pinned so a kernel-body change (or a new callback/
-    # transfer around it) is a named, reviewed event like every other
-    # hot-path trace.  The audit captures on forced CPU, so the kernel
-    # traces in interpret mode — host-independent like the rest of the
-    # lock.
+    # The Pallas kernel twins (Engine(paged_attn='kernel') — the TPU
+    # default): same signatures/donations as their einsum twins
+    # program-for-program, but the attention contractions run the
+    # hot-path kernels — the paged-decode kernel, the flash-window
+    # verify/prefill kernel, kernels dispatched inside the fused loop
+    # bodies, and the tree-verify kernel — each with the block table as
+    # scalar prefetch.  Pinned so a kernel-body change (or a new
+    # callback/transfer around one) is a named, reviewed event like
+    # every other hot-path trace.  The audit captures on forced CPU, so
+    # the kernels trace in interpret mode — host-independent like the
+    # rest of the lock.
+    (_, verify_k, prefill_k, fused_k, fused_spec_k,
+     tree_k) = _engine._build_steps(cfg, params, paged_attn="kernel",
+                                    draft=(dcfg, dparams))[6:]
     decode_paged_kernel = _engine._build_steps(cfg, params,
                                                paged_attn="kernel")[6]
     programs[f"serve.decode_paged_kernel@{pgeo2}"] = (
         decode_paged_kernel,
         (pool, table, h["last"], h["lens"], h["active"], h["temps"],
          h["topk"], h["topp"], h["keys"], h["counts"]))
+    programs[f"serve.verify_paged_kernel@{pgeo2}k{SERVE['k']}"] = (
+        verify_k, (pool, table, h["window"], h["lens"], h["active"],
+                   h["ndraft"], h["temps"], h["topk"], h["topp"],
+                   h["keys"], h["counts"]))
+    programs[f"serve.prefill_paged_kernel@{pgeo2}c{SERVE['chunk']}"] = (
+        prefill_k, (pool, table[0], h["chunk"], np.int32(0),
+                    np.int32(SERVE["chunk"] - 1)))
+    programs[f"serve.fused_decode_paged_kernel@{pgeo2}n{SERVE['fuse']}"] = (
+        functools.partial(fused_k, n_steps=SERVE["fuse"], stream=False),
+        fused_paged_args)
+    programs[
+        f"serve.fused_spec_paged_kernel@{pgeo2}k{SERVE['k']}n{SERVE['fuse']}"
+    ] = (functools.partial(fused_spec_k, n_draft_k=SERVE["k"],
+                           n_steps=SERVE["fuse"], stream=False),
+         spec_paged_args)
+    programs[f"serve.tree_verify_paged_kernel@{pgeo2}t{len(TREE_PARENTS)}"] = (
+        functools.partial(tree_k, parents=TREE_PARENTS),
+        (pool, table, h["tree"], h["lens"], h["active"], h["ndraft"],
+         h["temps"], h["topk"], h["topp"], h["keys"], h["counts"]))
 
     programs["serve.sample_row@v%d" % SERVE["vocab"]] = (
         _engine._sample_row,

@@ -16,6 +16,7 @@ Rules are pure stdlib — see tpudp/analysis/core.py.
 from __future__ import annotations
 
 import ast
+import re
 
 from .core import Module, Rule, mentions, ordered_walk
 
@@ -89,6 +90,19 @@ DEVICE_WRAPPERS = {"_device": (1, 2)}
 #: compile-once discipline); fixtures opt in with
 #: ``# tpudp: compile-once-module``.
 COMPILE_ONCE_PREFIXES = ("tpudp/serve/",)
+
+#: Modules whose Pallas kernels must belong to a pinned trace-audit
+#: program family: every ``pl.pallas_call`` site must sit inside a
+#: program that bumps TRACE_COUNTS itself, or inside a wrapper marked
+#: ``# tpudp: kernel-program(<name>)`` where <name> is a registered
+#: program (tpudp/analysis/programs.py TRACE_COUNTER_PROGRAMS values).
+#: The training-side flash/ring kernels are deliberately OUT of scope —
+#: they sit behind explicit attn_impl opt-ins, not the serving hot
+#: path's default dispatch.  Fixtures opt in with
+#: ``# tpudp: kernel-module``.
+KERNEL_SCOPE_PREFIXES = ("tpudp/serve/", "tpudp/ops/paged_attention.py")
+
+KERNEL_PROGRAM_RE = re.compile(r"#\s*tpudp:\s*kernel-program\(([\w.\-]+)\)")
 
 #: Modules where host-side ordering feeds collectives/checkpoint
 #: protocols, so unordered filesystem listings are a cross-host
@@ -722,6 +736,17 @@ class DivergentCollective(Rule):
                     cur = mod.parents.get(cur)
 
 
+def _bumps_trace_counts(fn) -> bool:
+    """Does this def's body contain ``TRACE_COUNTS[...] += 1``?"""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.AugAssign)
+                and isinstance(node.target, ast.Subscript)
+                and isinstance(node.target.value, ast.Name)
+                and node.target.value.id == "TRACE_COUNTS"):
+            return True
+    return False
+
+
 class UnregisteredJit(Rule):
     """Jitted programs in compile-once modules must be observable.
 
@@ -739,13 +764,7 @@ class UnregisteredJit(Rule):
                "TRACE_COUNTS — recompiles in it are unobservable")
 
     def _bumps_trace_counts(self, fn) -> bool:
-        for node in ast.walk(fn):
-            if (isinstance(node, ast.AugAssign)
-                    and isinstance(node.target, ast.Subscript)
-                    and isinstance(node.target.value, ast.Name)
-                    and node.target.value.id == "TRACE_COUNTS"):
-                return True
-        return False
+        return _bumps_trace_counts(fn)
 
     def check(self, mod: Module):
         if not _in_scope(mod, COMPILE_ONCE_PREFIXES, "compile-once-module"):
@@ -788,6 +807,78 @@ class UnregisteredJit(Rule):
                         f"the compile-once tests; add "
                         f"TRACE_COUNTS[\"{fn.name}\"] += 1 in the traced "
                         f"body and register it for the trace audit")
+
+
+class UnregisteredKernel(Rule):
+    """Pallas kernels outside the pinned program registry.
+
+    Every hand-written kernel on the serving hot path is pinned in the
+    trace-audit registry (tpudp/analysis/programs.py) through the
+    program that dispatches it: the program bumps its TRACE_COUNTS key,
+    the key maps to a registered program name, and the lockfile carries
+    the kernel body's fingerprint.  A ``pl.pallas_call`` reachable from
+    code that is neither inside a counter-bumping program nor inside a
+    wrapper marked ``# tpudp: kernel-program(<registered name>)`` is a
+    kernel whose body can change without any named, reviewed lockfile
+    event — exactly the silent-regression class the audit exists to
+    close (mirrors ``unregistered-jit``, one layer down).
+    """
+
+    name = "unregistered-kernel"
+    summary = ("pl.pallas_call site not tied to a registered trace-audit "
+               "program — kernel-body changes would dodge the lock")
+
+    def _program_marker(self, mod: Module, fn) -> str | None:
+        """``# tpudp: kernel-program(NAME)`` on the def line or the
+        line above it (the hot-path marker placement)."""
+        start = fn.lineno
+        if fn.decorator_list:
+            start = fn.decorator_list[0].lineno
+        for line in range(max(1, start - 1), fn.lineno + 1):
+            m = KERNEL_PROGRAM_RE.search(mod.comments.get(line, ""))
+            if m:
+                return m.group(1)
+        return None
+
+    def check(self, mod: Module):
+        if not _in_scope(mod, KERNEL_SCOPE_PREFIXES, "kernel-module"):
+            return
+        # Stdlib-safe: programs.py's module level is pure tables (the
+        # heavy imports live inside its builders).
+        from .programs import TRACE_COUNTER_PROGRAMS
+        registered = set(TRACE_COUNTER_PROGRAMS.values())
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func) or ""
+            if dotted.split(".")[-1] != "pallas_call":
+                continue
+            marker, covered = None, False
+            fn = mod.enclosing_function(node)
+            while fn is not None:
+                if _bumps_trace_counts(fn):
+                    covered = True  # inside a counted (hence registered
+                    break           # or registry-test-caught) program
+                if marker is None:
+                    marker = self._program_marker(mod, fn)
+                fn = mod.enclosing_function(fn)
+            if covered or marker in registered:
+                continue
+            if marker is None:
+                yield self.finding(
+                    mod, node,
+                    "pl.pallas_call site belongs to no registered "
+                    "program — dispatch it from a TRACE_COUNTS-bumping "
+                    "program, or mark its wrapper `# tpudp: "
+                    "kernel-program(<name>)` with a name from "
+                    "TRACE_COUNTER_PROGRAMS")
+            else:
+                yield self.finding(
+                    mod, node,
+                    f"kernel-program({marker}) names no registered "
+                    f"program — register it in tpudp/analysis/"
+                    f"programs.py (TRACE_COUNTER_PROGRAMS + "
+                    f"build_programs) so the kernel body is pinned")
 
 
 class ObsInHotPath(Rule):
@@ -833,6 +924,7 @@ RULES = [
     UseAfterDonation(),
     DivergentCollective(),
     UnregisteredJit(),
+    UnregisteredKernel(),
     ObsInHotPath(),
 ]
 

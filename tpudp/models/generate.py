@@ -172,7 +172,7 @@ def scatter_pages(pool, view: KVCache, table: jnp.ndarray,
 
 def write_token_pages(pages, k_new: jnp.ndarray, v_new: jnp.ndarray,
                       table: jnp.ndarray, pos: jnp.ndarray,
-                      active: jnp.ndarray):
+                      active: jnp.ndarray, layer: int | None = None):
     """Commit a ``cur``-token window's K/V directly into the pages
     holding positions ``[pos, pos+cur)`` — the single-page committed
     write that replaces :func:`scatter_pages`'s page-level unroll on
@@ -188,10 +188,19 @@ def write_token_pages(pages, k_new: jnp.ndarray, v_new: jnp.ndarray,
     quantization is idempotent on already-quantized vectors, the pool
     bytes match the old whole-page rewrite exactly).  Writes of
     inactive slots, and of positions past the table (never expected —
-    the engine preallocates), route to the trailing scratch page."""
-    T = pages[0].shape[1]
+    the engine preallocates), route to the trailing scratch page.
+
+    With ``layer`` (the kernel build's whole-pool mode) ``pages`` are
+    the FULL stacked pool buffers ``(layers, pages, T, ...)`` and every
+    write scatters at ``[layer, page, ...]`` directly — same values at
+    the same pool coordinates as the per-layer-slice form, but no layer
+    slice has to stay live past its block and the end-of-forward
+    restack disappears, which is where the kernel programs' committed
+    peak-live drop below their einsum twins comes from."""
+    ix = () if layer is None else (layer,)
+    T = pages[0].shape[1 + len(ix)]
     n_pages = table.shape[1]
-    scratch = pages[0].shape[0] - 1
+    scratch = pages[0].shape[len(ix)] - 1
     b, cur = k_new.shape[0], k_new.shape[1]
     pos = jnp.asarray(pos)
     scalar_pos = not pos.ndim
@@ -215,12 +224,12 @@ def write_token_pages(pages, k_new: jnp.ndarray, v_new: jnp.ndarray,
         if len(pages) == 4:
             qk, sk = _quantize_kv(k_new)
             qv, sv = _quantize_kv(v_new)
-            return (pages[0].at[page].set(qk),
-                    pages[1].at[page].set(qv),
-                    pages[2].at[page].set(sk),
-                    pages[3].at[page].set(sv))
-        return (pages[0].at[page].set(k_new.astype(pages[0].dtype)),
-                pages[1].at[page].set(v_new.astype(pages[1].dtype)))
+            return (pages[0].at[(*ix, page)].set(qk),
+                    pages[1].at[(*ix, page)].set(qv),
+                    pages[2].at[(*ix, page)].set(sk),
+                    pages[3].at[(*ix, page)].set(sv))
+        return (pages[0].at[(*ix, page)].set(k_new.astype(pages[0].dtype)),
+                pages[1].at[(*ix, page)].set(v_new.astype(pages[1].dtype)))
     for j in range(cur):
         p = pos + j
         pidx = p // T
@@ -233,13 +242,15 @@ def write_token_pages(pages, k_new: jnp.ndarray, v_new: jnp.ndarray,
         if len(pages) == 4:
             qk, sk = _quantize_kv(kj)
             qv, sv = _quantize_kv(vj)
-            pages = (pages[0].at[page, off].set(qk),
-                     pages[1].at[page, off].set(qv),
-                     pages[2].at[page, off].set(sk),
-                     pages[3].at[page, off].set(sv))
+            pages = (pages[0].at[(*ix, page, off)].set(qk),
+                     pages[1].at[(*ix, page, off)].set(qv),
+                     pages[2].at[(*ix, page, off)].set(sk),
+                     pages[3].at[(*ix, page, off)].set(sv))
         else:
-            pages = (pages[0].at[page, off].set(kj.astype(pages[0].dtype)),
-                     pages[1].at[page, off].set(vj.astype(pages[1].dtype)))
+            pages = (pages[0].at[(*ix, page, off)].set(
+                         kj.astype(pages[0].dtype)),
+                     pages[1].at[(*ix, page, off)].set(
+                         vj.astype(pages[1].dtype)))
     return pages
 
 
@@ -278,9 +289,10 @@ class _PagedKV:
     layer."""
 
     __slots__ = ("cfg", "pages", "table", "pos", "active", "grouped",
-                 "impl")
+                 "impl", "layer")
 
-    def __init__(self, cfg, pages, table, pos, active, *, grouped, impl):
+    def __init__(self, cfg, pages, table, pos, active, *, grouped, impl,
+                 layer=None):
         self.cfg = cfg
         self.pages = pages
         self.table = table
@@ -288,17 +300,86 @@ class _PagedKV:
         self.active = active
         self.grouped = grouped
         self.impl = impl
+        # Whole-pool mode (kernel builds): ``pages`` are the FULL
+        # stacked pool buffers and ``layer`` picks the stratum — write
+        # scatters at [layer, ...] and attend indexes the layer inside
+        # the kernel's BlockSpec, so a per-layer slice never exists as
+        # an XLA value (the kernel programs' peak-live edge over their
+        # einsum twins).
+        self.layer = layer
 
     def write(self, k: jnp.ndarray, v: jnp.ndarray) -> None:
         self.pages = write_token_pages(self.pages, k, v, self.table,
-                                       self.pos, self.active)
+                                       self.pos, self.active,
+                                       layer=self.layer)
 
     def attend(self, q: jnp.ndarray) -> jnp.ndarray:
         from tpudp.ops.paged_attention import paged_attention
 
         return paged_attention(q, self.pages, self.table, self.pos,
                                dtype=self.cfg.dtype, grouped=self.grouped,
-                               impl=self.impl)
+                               impl=self.impl, layer=self.layer)
+
+
+class _TreePagedKV:
+    """One layer's READ-ONLY paged store for the tree-verify forward:
+    ``attend`` runs the tree kernel over the slot's cache pages (strict
+    ``< pos0`` visibility, through the block table) jointly with the
+    in-flight window K/V under the ancestor-or-self mask — the window
+    never touches the pages (rejected branches must leave zero pool
+    bytes), so unlike :class:`_PagedKV` there is no ``write``."""
+
+    __slots__ = ("cfg", "pages", "table", "pos0", "anc")
+
+    def __init__(self, cfg, pages, table, pos0, anc):
+        self.cfg = cfg
+        self.pages = pages
+        self.table = table
+        self.pos0 = pos0
+        self.anc = anc
+
+    def attend(self, q: jnp.ndarray, k: jnp.ndarray,
+               v: jnp.ndarray) -> jnp.ndarray:
+        from tpudp.ops.paged_attention import tree_paged_attention
+
+        return tree_paged_attention(q, self.pages, self.table, self.pos0,
+                                    k, v, self.anc, dtype=self.cfg.dtype)
+
+
+def _forward_tree_paged(cfg, params: dict, tokens: jnp.ndarray, pool,
+                        table: jnp.ndarray, pos0, depths: tuple,
+                        anc: tuple):
+    """Kernelized paged twin of :func:`_forward_tree`: node queries
+    attend the committed cache THROUGH the block table (the tree-verify
+    kernel — no dense view, no gather) jointly with the in-window
+    ancestor set.  Returns ``(logits, wk, wv)`` exactly like the dense
+    tree forward; the pool is read-only here (the caller commits the
+    accepted path via ``write_token_pages`` afterwards).  fp pools only
+    — the engine keeps int8 pools on the einsum/gather fallback and
+    records the dispatch."""
+    from tpudp.models import llama as _llama
+
+    pos0 = jnp.asarray(pos0)
+    positions = pos0[:, None] + jnp.asarray(depths, jnp.int32)[None, :]
+    is_llama = isinstance(cfg, _llama.LlamaConfig)
+    if is_llama:
+        x = _llama.embed_tokens(cfg, params, tokens)
+    else:
+        x = embed_tokens(cfg, params, tokens, positions)
+    wk, wv = [], []
+    for i in range(cfg.num_layers):
+        store = _TreePagedKV(cfg, _layer_pages(pool, i), table, pos0, anc)
+        if is_llama:
+            x, k_i, v_i = _llama.block_tree(
+                cfg, params[f"h_{i}"], x, None, None, pos0, positions,
+                anc, paged=store)
+        else:
+            x, k_i, v_i = _block_tree(cfg, params[f"h_{i}"], x, None,
+                                      None, pos0, anc, paged=store)
+        wk.append(k_i)
+        wv.append(v_i)
+    head = _llama.lm_head if is_llama else lm_head
+    return head(cfg, params, x), jnp.stack(wk), jnp.stack(wv)
 
 
 def _forward_paged(cfg, params: dict, tokens: jnp.ndarray, pool,
@@ -342,19 +423,34 @@ def _forward_paged(cfg, params: dict, tokens: jnp.ndarray, pool,
         offsets = jnp.arange(tokens.shape[1])
         positions = (pos[:, None] + offsets) if pos.ndim else pos + offsets
         x = embed_tokens(cfg, params, tokens, positions)
+    # Kernel builds run whole-pool mode: every layer's store shares the
+    # full stacked buffers (writes scatter at [layer, ...]; attend
+    # slices its stratum lazily), so no per-layer page slice stays live
+    # past its block and the end-of-forward restack disappears — the
+    # committed peak-live drop of every *_kernel program below its
+    # einsum twin.  The einsum path keeps the slice-and-restack form
+    # that its pinned traces were committed against.
+    whole = impl == "kernel"
+    bufs = tuple(pool) if whole else None
     layers = []
     for i in range(cfg.num_layers):
-        store = _PagedKV(cfg, _layer_pages(pool, i), table, pos, active,
-                         grouped=is_llama, impl=impl)
+        store = _PagedKV(cfg, bufs if whole else _layer_pages(pool, i),
+                         table, pos, active, grouped=is_llama, impl=impl,
+                         layer=i if whole else None)
         if is_llama:
             x, _, _ = _llama.block_decode(cfg, params[f"h_{i}"], x, None,
                                           None, pos, paged=store)
         else:
             x, _, _ = _block_decode(cfg, params[f"h_{i}"], x, None, None,
                                     pos, paged=store)
-        layers.append(store.pages)
+        if whole:
+            bufs = store.pages
+        else:
+            layers.append(store.pages)
     head = _llama.lm_head if is_llama else lm_head
-    return head(cfg, params, x), _stack_pages(pool, layers)
+    new_pool = (type(pool)(*bufs) if whole else
+                _stack_pages(pool, layers))
+    return head(cfg, params, x), new_pool
 
 
 def _layer_norm(p: dict, x: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -517,7 +613,7 @@ def _forward_cached(cfg, params: dict, tokens: jnp.ndarray,
 
 def _block_tree(cfg: GPT2Config, p: dict, x: jnp.ndarray,
                 k_cache: jnp.ndarray, v_cache: jnp.ndarray,
-                pos0: jnp.ndarray, anc: tuple):
+                pos0: jnp.ndarray, anc: tuple, paged=None):
     """One pre-LN block over a speculative token TREE of ``T+1`` nodes
     (node 0 = the row's last committed token; see
     ``tpudp.serve.speculate.TreeShape``) — the NO-WRITE twin of
@@ -546,23 +642,28 @@ def _block_tree(cfg: GPT2Config, p: dict, x: jnp.ndarray,
     q = q.reshape(b, T1, h, dh)
     k = k.reshape(b, T1, h, dh)
     v = v.reshape(b, T1, h, dh)
-    max_len = k_cache.shape[1]
-    scale = dh ** -0.5
-    kk = jnp.concatenate([k_cache, k], axis=1)
-    vv = jnp.concatenate([v_cache, v], axis=1)
-    cache_vis = jnp.arange(max_len)[None, :] < pos0[:, None]  # (b, M)
-    anc_m = jnp.asarray(anc, bool)
+    if paged is not None:
+        # Kernelized paged tree read (_TreePagedKV → tree kernel): the
+        # window K/V ride as kernel operands, never entering the pages.
+        out = paged.attend(q, k, v)
+    else:
+        max_len = k_cache.shape[1]
+        scale = dh ** -0.5
+        kk = jnp.concatenate([k_cache, k], axis=1)
+        vv = jnp.concatenate([v_cache, v], axis=1)
+        cache_vis = jnp.arange(max_len)[None, :] < pos0[:, None]  # (b, M)
+        anc_m = jnp.asarray(anc, bool)
 
-    def _attend(qj, ancj):  # qj (b, h, dh), ancj (T1,)
-        lg = jnp.einsum("bhd,bkhd->bhk", qj, kk) * scale
-        vis = jnp.concatenate(
-            [cache_vis, jnp.broadcast_to(ancj[None], (b, T1))], axis=1)
-        lg = jnp.where(vis[:, None, :], lg, jnp.finfo(lg.dtype).min)
-        pr = jax.nn.softmax(lg.astype(jnp.float32),
-                            axis=-1).astype(cfg.dtype)
-        return jnp.einsum("bhk,bkhd->bhd", pr, vv)
+        def _attend(qj, ancj):  # qj (b, h, dh), ancj (T1,)
+            lg = jnp.einsum("bhd,bkhd->bhk", qj, kk) * scale
+            vis = jnp.concatenate(
+                [cache_vis, jnp.broadcast_to(ancj[None], (b, T1))], axis=1)
+            lg = jnp.where(vis[:, None, :], lg, jnp.finfo(lg.dtype).min)
+            pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                axis=-1).astype(cfg.dtype)
+            return jnp.einsum("bhk,bkhd->bhd", pr, vv)
 
-    out = jax.vmap(_attend, in_axes=(1, 0), out_axes=1)(q, anc_m)
+        out = jax.vmap(_attend, in_axes=(1, 0), out_axes=1)(q, anc_m)
     x = x + _dense(p["attn"]["proj"], out.reshape(b, T1, d), cfg.dtype)
 
     hN = _layer_norm(p["ln_2"], x, cfg.ln_eps)

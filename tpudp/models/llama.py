@@ -304,7 +304,8 @@ def block_decode(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
 
 def block_tree(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
                k_cache: jnp.ndarray, v_cache: jnp.ndarray,
-               pos0: jnp.ndarray, positions: jnp.ndarray, anc: tuple):
+               pos0: jnp.ndarray, positions: jnp.ndarray, anc: tuple,
+               paged=None):
     """One LLaMA block over a speculative token TREE of ``T+1`` nodes —
     the NO-WRITE twin of :func:`block_decode`'s per-row path (see
     ``generate._block_tree`` for the scheme).  Sibling nodes share a
@@ -318,7 +319,7 @@ def block_tree(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
     b, T1, d = x.shape
     h, kv = cfg.num_heads, cfg.kv_heads
     dh = d // h
-    max_len = k_cache.shape[1]
+    max_len = k_cache.shape[1] if paged is None else None
 
     hN = _rms(p["rms_attn"], x, cfg.rms_eps)
     attn = p["attn"]
@@ -330,24 +331,31 @@ def block_tree(cfg: LlamaConfig, p: dict, x: jnp.ndarray,
                    positions, cfg.rope_theta)
     v = _dense_nb(attn["wv"], hN, cfg.dtype).reshape(b, T1, kv, dh)
 
-    g = h // kv
-    qg = q.reshape(b, T1, kv, g, dh)
-    scale = dh ** -0.5
-    kk = jnp.concatenate([k_cache, k], axis=1)  # (b, max_len + T1, kv, dh)
-    vv = jnp.concatenate([v_cache, v], axis=1)
-    cache_vis = jnp.arange(max_len)[None, :] < pos0[:, None]  # (b, M)
-    anc_m = jnp.asarray(anc, bool)
+    if paged is not None:
+        # Kernelized paged tree read (generate._TreePagedKV → the tree
+        # kernel, GQA handled by the kernel's grouped row layout): the
+        # window K/V ride as kernel operands, never entering the pages.
+        out = paged.attend(q, k, v)
+    else:
+        g = h // kv
+        qg = q.reshape(b, T1, kv, g, dh)
+        scale = dh ** -0.5
+        kk = jnp.concatenate([k_cache, k], axis=1)  # (b, max_len+T1, kv, dh)
+        vv = jnp.concatenate([v_cache, v], axis=1)
+        cache_vis = jnp.arange(max_len)[None, :] < pos0[:, None]  # (b, M)
+        anc_m = jnp.asarray(anc, bool)
 
-    def _attend(qj, ancj):  # qj (b, kv, g, dh), ancj (T1,)
-        lg = jnp.einsum("bkgd,bmkd->bkgm", qj, kk) * scale
-        vis = jnp.concatenate(
-            [cache_vis, jnp.broadcast_to(ancj[None], (b, T1))], axis=1)
-        lg = jnp.where(vis[:, None, None, :], lg, jnp.finfo(lg.dtype).min)
-        pr = jax.nn.softmax(lg.astype(jnp.float32),
-                            axis=-1).astype(cfg.dtype)
-        return jnp.einsum("bkgm,bmkd->bkgd", pr, vv)
+        def _attend(qj, ancj):  # qj (b, kv, g, dh), ancj (T1,)
+            lg = jnp.einsum("bkgd,bmkd->bkgm", qj, kk) * scale
+            vis = jnp.concatenate(
+                [cache_vis, jnp.broadcast_to(ancj[None], (b, T1))], axis=1)
+            lg = jnp.where(vis[:, None, None, :], lg,
+                           jnp.finfo(lg.dtype).min)
+            pr = jax.nn.softmax(lg.astype(jnp.float32),
+                                axis=-1).astype(cfg.dtype)
+            return jnp.einsum("bkgm,bmkd->bkgd", pr, vv)
 
-    out = jax.vmap(_attend, in_axes=(1, 0), out_axes=1)(qg, anc_m)
+        out = jax.vmap(_attend, in_axes=(1, 0), out_axes=1)(qg, anc_m)
     x = x + _dense_nb(attn["wo"], out.reshape(b, T1, d), cfg.dtype)
 
     hN = _rms(p["rms_mlp"], x, cfg.rms_eps)

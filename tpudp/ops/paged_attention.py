@@ -52,6 +52,12 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 _LANES = 128  # per-row online-softmax scratch, broadcast over one lane tile
+# The window/tree kernels' m/l stats keep one value per row; a narrow
+# 8-lane declaration is enough (an f32 VMEM tile is (8, 128) — the
+# array is lane-padded physically either way, but the narrow shape
+# keeps the committed budget ledger honest about bytes the kernel
+# actually carries).
+_STAT_LANES = 8
 
 
 def _interpret_default() -> bool:
@@ -226,22 +232,32 @@ def _decode_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
-def _kernel_paged(q, pages, table, pos, *, dtype, interpret):
+# tpudp: kernel-program(serve.decode_paged_kernel)
+def _kernel_paged(q, pages, table, pos, *, dtype, interpret, layer=None):
     """Dispatch one decode step (``cur == 1``) through the Pallas
     paged-decode kernel.  ``q``: ``(b, 1, h, dh)``; the grid is
     ``(b, M)`` with the online-softmax carry persisting across the
     inner (page) axis; the table row and per-slot positions are scalar
     prefetch, so each page block is DMA'd by TABLE VALUE — the gather
-    never exists even as a transient."""
+    never exists even as a transient.
+
+    With ``layer`` (the engine's whole-pool mode) ``pages`` carry the
+    FULL stacked pool ``(layers, ...)`` and the BlockSpec picks the
+    stratum (a ``None`` block axis, squeezed out of the refs) — the
+    layer slice is never materialized as an XLA value, so nothing
+    beyond the pool itself is ever live at the call."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, cur, h, dh = q.shape
     assert cur == 1, "the paged-decode kernel is a 1-token decode kernel"
     int8 = len(pages) == 4
+    lx = () if layer is None else (layer,)
+    pb = (None,) * len(lx)  # layer block axis, squeezed out of the refs
     k_pages, v_pages = pages[0], pages[1]
-    n_real = k_pages.shape[0] - 1  # trailing page is the write scratch
-    page_tokens, kv = k_pages.shape[1], k_pages.shape[2]
+    n_real = k_pages.shape[len(lx)] - 1  # trailing page is write scratch
+    page_tokens = k_pages.shape[1 + len(lx)]
+    kv = k_pages.shape[2 + len(lx)]
     n_pages = table.shape[1]
     groups = h // kv
     scale = dh ** -0.5
@@ -252,11 +268,11 @@ def _kernel_paged(q, pages, table, pos, *, dtype, interpret):
 
     def page_map(s, m, tbl_ref, pos_ref):
         t = tbl_ref[s * n_pages + m]
-        return (jnp.where(t >= 0, t, scratch_page), 0, 0, 0)
+        return (*lx, jnp.where(t >= 0, t, scratch_page), 0, 0, 0)
 
     def scale_map(s, m, tbl_ref, pos_ref):
         t = tbl_ref[s * n_pages + m]
-        return (jnp.where(t >= 0, t, scratch_page), 0, 0)
+        return (*lx, jnp.where(t >= 0, t, scratch_page), 0, 0)
 
     kernel = functools.partial(
         _decode_kernel, kv=kv, groups=groups, page_tokens=page_tokens,
@@ -264,12 +280,12 @@ def _kernel_paged(q, pages, table, pos, *, dtype, interpret):
     ins = (pages[0], pages[1]) + ((pages[2], pages[3]) if int8 else ())
     in_specs = [
         pl.BlockSpec((1, h, dh), lambda s, m, t, p: (s, 0, 0)),
-        pl.BlockSpec((1, page_tokens, kv, dh), page_map),
-        pl.BlockSpec((1, page_tokens, kv, dh), page_map),
+        pl.BlockSpec((*pb, 1, page_tokens, kv, dh), page_map),
+        pl.BlockSpec((*pb, 1, page_tokens, kv, dh), page_map),
     ]
     if int8:
-        in_specs += [pl.BlockSpec((1, page_tokens, kv), scale_map),
-                     pl.BlockSpec((1, page_tokens, kv), scale_map)]
+        in_specs += [pl.BlockSpec((*pb, 1, page_tokens, kv), scale_map),
+                     pl.BlockSpec((*pb, 1, page_tokens, kv), scale_map)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, n_pages),
@@ -290,12 +306,330 @@ def _kernel_paged(q, pages, table, pos, *, dtype, interpret):
     return out[:, None]
 
 
+def _window_tile(width: int) -> int:
+    """Largest query-tile width ≤ 32 dividing the window — the chunk
+    axis of the prefill grid (``chunk_tiles × kv_pages``).  Verify
+    windows (k+1 ≤ 32) always fit one tile."""
+    for cand in range(min(width, 32), 0, -1):
+        if width % cand == 0:
+            return cand
+    return width
+
+
+def _window_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                   kv: int, groups: int, width: int, page_tokens: int,
+                   n_pages: int, scale: float, int8: bool):
+    """One ``(slot, query-tile, page)`` grid step of the paged
+    flash-window kernel — the multi-token generalization of
+    ``_decode_kernel`` that covers chunked prefill (scalar base
+    position, ``width`` = chunk tile) and the k+1 speculative verify
+    window (vector base positions, one tile).
+
+    Query rows are flattened KV-head-major — row
+    ``r = ki·(width·groups) + j·groups + gi`` — so each KV head's rows
+    are one contiguous 2D dot against its page slice, and the causal
+    in-window mask is per ROW: window position ``j`` sees keys
+    ``<= pos[slot] + j`` (the engine writes the window's K/V into pages
+    BEFORE attending, so in-window causality and cache visibility are
+    the same comparison)."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    if int8:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+    m = pl.program_id(2)
+    rows = kv * width * groups
+    dh = q_ref.shape[-1]
+
+    @pl.when(m == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    mapped = tbl_ref[s * n_pages + m] >= 0
+
+    @pl.when(mapped)  # -1 (unmapped) pages: skip — nothing to attend
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (width, h, dh)
+        k_blk = k_ref[0].astype(jnp.float32)      # (T, kv, dh)
+        v_blk = v_ref[0].astype(jnp.float32)
+        if int8:
+            k_blk = k_blk * ks_ref[0].astype(jnp.float32)[..., None]
+            v_blk = v_blk * vs_ref[0].astype(jnp.float32)[..., None]
+        blocks = []
+        for ki in range(kv):
+            qk = q[:, ki * groups:(ki + 1) * groups, :].reshape(
+                width * groups, dh)
+            blocks.append(jnp.dot(qk, k_blk[:, ki, :].T,
+                                  preferred_element_type=jnp.float32))
+        s_blk = jnp.concatenate(blocks, axis=0)  # (rows, T)
+        k_pos = m * page_tokens + lax.broadcasted_iota(
+            jnp.int32, (rows, page_tokens), 1)
+        row_ids = lax.broadcasted_iota(jnp.int32, (rows, page_tokens), 0)
+        win_j = t * width + (row_ids % (width * groups)) // groups
+        s_blk = jnp.where(k_pos <= pos_ref[s] + win_j, s_blk, _NEG_INF)
+        m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)  # (rows, 1)
+        l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_blk - m_new)  # (rows, T)
+        l_ref[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        pv = []
+        for ki in range(kv):
+            pv.append(jnp.dot(
+                p[ki * width * groups:(ki + 1) * width * groups],
+                v_blk[:, ki, :], preferred_element_type=jnp.float32))
+        acc_ref[...] = acc_ref[...] * alpha + jnp.concatenate(pv, axis=0)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(m == n_pages - 1)
+    def _finalize():
+        l_safe = jnp.maximum(jnp.max(l_ref[...], axis=-1, keepdims=True),
+                             1e-30)
+        out = acc_ref[...] / l_safe  # (rows, dh), kv-head-major
+        for ki in range(kv):
+            blk = out[ki * width * groups:(ki + 1) * width * groups]
+            o_ref[0, :, ki * groups:(ki + 1) * groups, :] = (
+                blk.reshape(width, groups, dh).astype(o_ref.dtype))
+
+
+# tpudp: kernel-program(serve.verify_paged_kernel)
+def _window_paged(q, pages, table, pos, *, dtype, interpret, layer=None):
+    """Dispatch a multi-token window (k+1 verify, vector ``pos``; or a
+    prefill chunk, scalar ``pos``) through the flash-window kernel.
+    Grid ``(b, chunk_tiles, M)`` with the online-softmax carry
+    persisting across the inner page axis — the prefill grid the ISSUE
+    names, with verify as the one-tile case.  ``layer`` selects a
+    stratum of a full stacked pool via the BlockSpec (see
+    :func:`_kernel_paged`) — no layer slice is ever materialized."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, cur, h, dh = q.shape
+    int8 = len(pages) == 4
+    lx = () if layer is None else (layer,)
+    pb = (None,) * len(lx)
+    k_pages = pages[0]
+    page_tokens = k_pages.shape[1 + len(lx)]
+    kv = k_pages.shape[2 + len(lx)]
+    n_pages = table.shape[1]
+    groups = h // kv
+    scale = dh ** -0.5
+    scratch_page = k_pages.shape[len(lx)] - 1
+    width = _window_tile(cur)
+    q_tiles = cur // width
+    rows = kv * width * groups
+
+    tbl = jnp.asarray(table, jnp.int32).reshape(-1)
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+
+    def page_map(s, t, m, tbl_ref, pos_ref):
+        pg = tbl_ref[s * n_pages + m]
+        return (*lx, jnp.where(pg >= 0, pg, scratch_page), 0, 0, 0)
+
+    def scale_map(s, t, m, tbl_ref, pos_ref):
+        pg = tbl_ref[s * n_pages + m]
+        return (*lx, jnp.where(pg >= 0, pg, scratch_page), 0, 0)
+
+    kernel = functools.partial(
+        _window_kernel, kv=kv, groups=groups, width=width,
+        page_tokens=page_tokens, n_pages=n_pages, scale=scale, int8=int8)
+    ins = (pages[0], pages[1]) + ((pages[2], pages[3]) if int8 else ())
+    in_specs = [
+        pl.BlockSpec((1, width, h, dh),
+                     lambda s, t, m, tb, p: (s, t, 0, 0)),
+        pl.BlockSpec((*pb, 1, page_tokens, kv, dh), page_map),
+        pl.BlockSpec((*pb, 1, page_tokens, kv, dh), page_map),
+    ]
+    if int8:
+        in_specs += [pl.BlockSpec((*pb, 1, page_tokens, kv), scale_map),
+                     pl.BlockSpec((*pb, 1, page_tokens, kv), scale_map)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, q_tiles, n_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, width, h, dh),
+                               lambda s, t, m, tb, p: (s, t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, dh), jnp.float32),
+            pltpu.VMEM((rows, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((rows, _STAT_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, cur, h, dh), dtype),
+        interpret=interpret,
+    )(tbl, pos, q, *ins)
+
+
+def _tree_kernel(tbl_ref, pos_ref, anc_ref, q_ref, k_ref, v_ref,
+                 wk_ref, wv_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                 kv: int, groups: int, t1: int, page_tokens: int,
+                 n_pages: int, scale: float):
+    """One ``(slot, page-or-window)`` grid step of the tree-verify
+    kernel.  Steps ``m < n_pages`` stream the slot's CACHE pages with
+    strict visibility ``k_pos < pos0[slot]`` (tree nodes occupy
+    ``pos0..``, so committed state is everything strictly before); the
+    extra final step ``m == n_pages`` folds the T+1 in-flight window
+    keys into the same online softmax under the ancestor-or-self mask,
+    which rides as a scalar-prefetched per-shape constant (the parents
+    tuple is static engine config, part of the compile key)."""
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    m = pl.program_id(1)
+    rows = kv * t1 * groups
+    dh = q_ref.shape[-1]
+
+    @pl.when(m == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _scores(k_src):
+        q = q_ref[0].astype(jnp.float32) * scale  # (t1, h, dh)
+        blocks = []
+        for ki in range(kv):
+            qk = q[:, ki * groups:(ki + 1) * groups, :].reshape(
+                t1 * groups, dh)
+            blocks.append(jnp.dot(qk, k_src[:, ki, :].T,
+                                  preferred_element_type=jnp.float32))
+        return jnp.concatenate(blocks, axis=0)  # (rows, n_keys)
+
+    def _update(s_blk, v_src):
+        m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)
+        l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_blk - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        pv = []
+        for ki in range(kv):
+            pv.append(jnp.dot(
+                p[ki * t1 * groups:(ki + 1) * t1 * groups],
+                v_src[:, ki, :], preferred_element_type=jnp.float32))
+        acc_ref[...] = acc_ref[...] * alpha + jnp.concatenate(pv, axis=0)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    mi = jnp.minimum(m, n_pages - 1)  # keep the SMEM read in bounds
+    mapped = (m < n_pages) & (tbl_ref[s * n_pages + mi] >= 0)
+
+    @pl.when(mapped)
+    def _cache_page():
+        k_blk = k_ref[0].astype(jnp.float32)  # (T, kv, dh)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s_blk = _scores(k_blk)
+        k_pos = mi * page_tokens + lax.broadcasted_iota(
+            jnp.int32, (rows, page_tokens), 1)
+        s_blk = jnp.where(k_pos < pos_ref[s], s_blk, _NEG_INF)
+        _update(s_blk, v_blk)
+
+    @pl.when(m == n_pages)
+    def _window_block():
+        wk = wk_ref[0].astype(jnp.float32)  # (t1, kv, dh)
+        wv = wv_ref[0].astype(jnp.float32)
+        s_blk = _scores(wk)  # (rows, t1)
+        anc = jnp.array([[anc_ref[j * t1 + c] for c in range(t1)]
+                         for j in range(t1)])  # (t1, t1) from SMEM
+        per_node = jnp.broadcast_to(
+            anc[:, None, :], (t1, groups, t1)).reshape(t1 * groups, t1)
+        mask = jnp.broadcast_to(
+            per_node[None], (kv, t1 * groups, t1)).reshape(rows, t1)
+        s_blk = jnp.where(mask > 0, s_blk, _NEG_INF)
+        _update(s_blk, wv)
+        l_safe = jnp.maximum(jnp.max(l_ref[...], axis=-1, keepdims=True),
+                             1e-30)
+        out = acc_ref[...] / l_safe
+        for ki in range(kv):
+            blk = out[ki * t1 * groups:(ki + 1) * t1 * groups]
+            o_ref[0, :, ki * groups:(ki + 1) * groups, :] = (
+                blk.reshape(t1, groups, dh).astype(o_ref.dtype))
+
+
+# tpudp: kernel-program(serve.tree_verify_paged_kernel)
+def _tree_paged(q, pages, table, pos0, wk, wv, anc, *, dtype, interpret):
+    """Dispatch the static tree-verify forward through the tree kernel:
+    grid ``(b, M + 1)`` — the cache pages plus ONE extra grid step for
+    the in-flight window keys (never written to pages; rejected
+    branches must leave zero pool bytes, so the window rides as its own
+    VMEM block).  fp pools only — int8 pools fall back to the einsum
+    tree path at the engine layer."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if len(pages) == 4:
+        raise NotImplementedError(
+            "the tree-verify kernel reads fp pages only; int8 pools take "
+            "the einsum fallback (Engine records the dispatch)")
+    b, t1, h, dh = q.shape
+    k_pages = pages[0]
+    page_tokens, kv = k_pages.shape[1], k_pages.shape[2]
+    n_pages = table.shape[1]
+    groups = h // kv
+    scale = dh ** -0.5
+    scratch_page = k_pages.shape[0] - 1
+
+    tbl = jnp.asarray(table, jnp.int32).reshape(-1)
+    pos0 = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (b,))
+    anc_flat = jnp.asarray(anc, jnp.int32).reshape(-1)
+
+    def page_map(s, m, tbl_ref, pos_ref, anc_ref):
+        mi = jnp.minimum(m, n_pages - 1)
+        pg = tbl_ref[s * n_pages + mi]
+        pg = jnp.where((m < n_pages) & (pg >= 0), pg, scratch_page)
+        return (pg, 0, 0, 0)
+
+    def slot_map(s, m, tbl_ref, pos_ref, anc_ref):
+        return (s, 0, 0, 0)
+
+    kernel = functools.partial(
+        _tree_kernel, kv=kv, groups=groups, t1=t1,
+        page_tokens=page_tokens, n_pages=n_pages, scale=scale)
+    rows = kv * t1 * groups
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_pages + 1),
+        in_specs=[
+            pl.BlockSpec((1, t1, h, dh), slot_map),
+            pl.BlockSpec((1, page_tokens, kv, dh), page_map),
+            pl.BlockSpec((1, page_tokens, kv, dh), page_map),
+            pl.BlockSpec((1, t1, kv, dh), slot_map),
+            pl.BlockSpec((1, t1, kv, dh), slot_map),
+        ],
+        out_specs=pl.BlockSpec((1, t1, h, dh), slot_map),
+        scratch_shapes=[
+            pltpu.VMEM((rows, dh), jnp.float32),
+            pltpu.VMEM((rows, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((rows, _STAT_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, t1, h, dh), dtype),
+        interpret=interpret,
+    )(tbl, pos0, anc_flat, q, *pages, wk, wv)
+
+
 # ----------------------------------------------------------- public op
 
 
 def paged_attention(q, pages, table, pos, *, dtype, grouped: bool = False,
-                    impl: str = "einsum",
-                    interpret: bool | None = None) -> jnp.ndarray:
+                    impl: str = "einsum", interpret: bool | None = None,
+                    layer: int | None = None) -> jnp.ndarray:
     """Attention for already-projected queries over table-indirected
     K/V pages — the ONE paged-attention op behind the serve engine's
     gather-free step programs.
@@ -313,19 +647,51 @@ def paged_attention(q, pages, table, pos, *, dtype, grouped: bool = False,
     identical to whichever dense twin the caller mirrors.
 
     ``impl='einsum'`` is bit-exact vs the dense math on the gathered
-    view; ``impl='kernel'`` routes single-token vector-position calls
-    through the Pallas paged-decode kernel (tolerance-bounded like
-    flash; wider windows and prefill fall back to the exact einsum
-    path, which writes the same KV a dense prefill would)."""
+    view; ``impl='kernel'`` routes the whole serving hot path through
+    Pallas: single-token vector-position calls hit the paged-decode
+    kernel, multi-token windows (the k+1 verify window) and scalar-
+    position prefill chunks hit the flash-window kernel.  Both are
+    tolerance-bounded like flash (online softmax rounds differently
+    from the XLA chain); the einsum path stays the bit-exact fallback
+    the engine selects per-program when a feature lacks kernel
+    support.
+
+    ``layer`` (kernel impl only) is whole-pool mode: ``pages`` carry
+    the FULL stacked pool and the kernels' BlockSpecs pick the stratum
+    — the per-layer slice never exists as an XLA value."""
     if impl not in ("einsum", "kernel"):
         raise ValueError(
             f"unknown paged-attention impl {impl!r}; choose from "
             f"'einsum' (bit-exact blockwise) or 'kernel' (Pallas decode)")
+    if layer is not None and impl != "kernel":
+        raise ValueError("whole-pool layer indexing is kernel-impl only")
     pos = jnp.asarray(pos)
-    if impl == "kernel" and pos.ndim and q.shape[1] == 1:
+    if impl == "kernel":
         if interpret is None:
             interpret = _interpret_default()
-        return _kernel_paged(q, pages, table, pos, dtype=dtype,
-                             interpret=interpret)
+        if pos.ndim and q.shape[1] == 1:
+            return _kernel_paged(q, pages, table, pos, dtype=dtype,
+                                 interpret=interpret, layer=layer)
+        return _window_paged(q, pages, table, pos, dtype=dtype,
+                             interpret=interpret, layer=layer)
     return _einsum_paged(q, pages, table, pos, dtype=dtype,
                          grouped=grouped)
+
+
+def tree_paged_attention(q, pages, table, pos0, wk, wv, anc, *, dtype,
+                         interpret: bool | None = None) -> jnp.ndarray:
+    """Tree-structured attention over table-indirected cache pages plus
+    an in-flight node window — the kernel half of ``tree_verify_paged``.
+
+    ``q``: ``(b, T+1, heads, dh)`` node queries; ``wk``/``wv``:
+    ``(b, T+1, kv, dh)`` window K/V (computed this forward, NEVER
+    written to pages — rejected branches must leave zero pool bytes);
+    ``anc``: the static ``(T+1, T+1)`` ancestor-or-self mask (row j
+    sees column c iff c is an ancestor of j or j itself), entering the
+    kernel as a scalar-prefetched per-shape constant.  Cache visibility
+    is strict ``k_pos < pos0`` — the committed prefix only.  fp pools
+    only; the engine keeps int8 tree traffic on the einsum fallback."""
+    if interpret is None:
+        interpret = _interpret_default()
+    return _tree_paged(q, pages, table, pos0, wk, wv, anc, dtype=dtype,
+                       interpret=interpret)

@@ -72,8 +72,12 @@ Design (static shapes everywhere — the TPU rule that shapes are compile
     each new token's K/V directly into the one page containing its
     position — the per-step full-view gather/scatter of the original
     paged engine is gone (``paged_attn='gather'`` keeps that baseline
-    for comparison; ``paged_attn='kernel'`` opts single-token decode
-    into a Pallas paged-decode kernel, tolerance-bounded like flash).
+    for comparison; ``paged_attn='kernel'`` — the default on TPU —
+    runs the whole hot path through Pallas kernels: paged decode, the
+    flash-window verify/prefill kernel, kernels dispatched inside the
+    fused loop bodies, and the tree-verify kernel, tolerance-bounded
+    like flash with per-program einsum fall-back recorded in
+    ``metrics()``).
     A prefix-cache hit becomes a TABLE
     WRITE (refcount bump on the radix tree's pages — zero
     ``copy_block_in`` copies) with copy-on-write at the divergence
@@ -226,10 +230,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tpudp.models.generate import (KVCache, _forward_cached,
+from tpudp.models.generate import (Int8Pages, KVCache, _forward_cached,
                                    _forward_paged, _forward_tree,
-                                   _layer_pages, _stack_pages,
-                                   gather_pages, update_cache_rows,
+                                   _forward_tree_paged, _layer_pages,
+                                   _stack_pages, gather_pages,
+                                   update_cache_rows,
                                    validate_decode_config,
                                    write_token_pages)
 from tpudp.obs import FlightRecorder, Recorder
@@ -450,7 +455,8 @@ def _fused_decode_math(forward, state, last_tokens, lengths, active,
 def _fused_spec_math(forward, draft_cfg, draft_params, state, hist,
                      last_tokens, lengths, active, temps, top_k, top_p,
                      keys, budgets, eos_ids, ring_id, counts, *,
-                     n_draft_k, n_steps, stream):
+                     n_draft_k, n_steps, stream,
+                     chunk_draft_prefill=False):
     """The ONE fused speculative-decode ``lax.while_loop`` shared by the
     dense and paged programs: each iteration drafts ``n_draft_k`` greedy
     tokens per running slot WITH THE DRAFT MODEL ON DEVICE, scores the
@@ -480,6 +486,14 @@ def _fused_spec_math(forward, draft_cfg, draft_params, state, hist,
     chain freeze when it stops, and the loop exits early once no row
     runs — the returned carry equals having run ``n_windows[s]`` verify
     steps per slot, which is the fall-back seam to ``_run_verify``.
+
+    ``chunk_draft_prefill`` (the kernel builds set it) re-prefills the
+    draft history in causal q-chunks instead of one ``hist_w``-wide
+    forward: each row's attention sees the same padded cache width with
+    the same mask, so per-row logits are BITWISE identical — only the
+    peak score-tile footprint inside the loop body shrinks from
+    ``(slots, heads, hist_w, hist_w + k)`` to one chunk's rows (the
+    committed budget-ledger delta the kernel twin pins).
     """
     n_slots, hist_w = hist.shape
     W = n_draft_k + 1
@@ -498,10 +512,35 @@ def _fused_spec_math(forward, draft_cfg, draft_params, state, hist,
         # -- draft: k greedy tokens per slot from the draft model (the
         # batched _draft_greedy), re-prefilled from hist each window.
         dcache = KVCache.zeros(draft_cfg, n_slots, hist_w + n_draft_k)
-        dlogits, dcache = _forward_cached(draft_cfg, draft_params, hist,
-                                          dcache, 0)
-        dlast = jax.vmap(lambda l, n: lax.dynamic_index_in_dim(
-            l, n, axis=0, keepdims=False))(dlogits, lens)
+        if chunk_draft_prefill:
+            ch = next(c for c in range(min(hist_w, 8), 0, -1)
+                      if hist_w % c == 0)
+            lg0, dcache = _forward_cached(draft_cfg, draft_params,
+                                          hist[:, :ch], dcache, 0)
+            dlast = jnp.take_along_axis(
+                lg0, jnp.clip(lens, 0, ch - 1)[:, None, None],
+                axis=1)[:, 0]
+
+            def pchunk(dc, c):
+                dcache, dlast = dc
+                toks = lax.dynamic_slice_in_dim(hist, c * ch, ch, axis=1)
+                lg, dcache = _forward_cached(draft_cfg, draft_params,
+                                             toks, dcache, c * ch)
+                rel = lens - c * ch
+                pick = jnp.take_along_axis(
+                    lg, jnp.clip(rel, 0, ch - 1)[:, None, None],
+                    axis=1)[:, 0]
+                dlast = jnp.where(((rel >= 0) & (rel < ch))[:, None],
+                                  pick, dlast)
+                return (dcache, dlast), None
+
+            (dcache, dlast), _ = lax.scan(pchunk, (dcache, dlast),
+                                          jnp.arange(1, hist_w // ch))
+        else:
+            dlogits, dcache = _forward_cached(draft_cfg, draft_params,
+                                              hist, dcache, 0)
+            dlast = jax.vmap(lambda l, n: lax.dynamic_index_in_dim(
+                l, n, axis=0, keepdims=False))(dlogits, lens)
 
         def dstep(dc, j):
             dcache, dlast = dc
@@ -646,17 +685,21 @@ def _build_steps(cfg, params, paged_attn: str = "einsum", draft=None):
     ``None`` in those positions and the step cache key never grows.
 
     ``paged_attn`` selects the PAGED programs' KV indirection (the
-    dense programs never change): ``'einsum'`` — the default — is the
-    GATHER-FREE bit-exact path (K/V read through the block table inside
-    the attention contraction, single-token page writes; see
+    dense programs never change): ``'einsum'`` is the GATHER-FREE
+    bit-exact path (K/V read through the block table inside the
+    attention contraction, single-token page writes; see
     ``tpudp.ops.paged_attention``); ``'gather'`` is PR 13's
     gather→dense-math→scatter baseline, kept for the bench comparison
-    and as the kernel tests' oracle; ``'kernel'`` routes the
-    single-token decode program through the Pallas paged-decode kernel
-    (tolerance-bounded — its own TRACE_COUNTS key and pinned trace),
-    while the wider windows (verify/fused/prefill) stay on the exact
-    einsum path so their KV writes remain bit-identical to a dense
-    prefill's.
+    and as the kernel tests' oracle; ``'kernel'`` — the TPU default —
+    runs the WHOLE hot path through the Pallas kernels: single-token
+    decode through the paged-decode kernel, the k+1 verify window and
+    chunked prefill through the flash-window kernel, the fused
+    ``lax.while_loop`` programs dispatching those kernels per
+    iteration, and tree verify through the tree kernel (fp pools; an
+    int8 pool's tree program auto-falls-back to the einsum/gather tree
+    path at trace time — the one feature the tree kernel declines).
+    Every kernel program is tolerance-bounded like flash, hence its
+    own TRACE_COUNTS key, pinned trace, and budget-ledger row.
 
     An engine's params are immutable for its lifetime, and freezing them
     lets XLA pre-pack the weight matrices for the step gemms at compile
@@ -859,7 +902,9 @@ def _build_steps(cfg, params, paged_attn: str = "einsum", draft=None):
     # PR 13's gather→dense→scatter baseline.  The pool (KVCache or
     # Int8Pages pytree) is donated like the dense arena; the TABLE is
     # host-authoritative and read-only on device.
-    win_impl = "gather" if paged_attn == "gather" else "einsum"
+    kernel_build = paged_attn == "kernel"
+    win_impl = "gather" if paged_attn == "gather" else (
+        "kernel" if kernel_build else "einsum")
 
     def _paged_fwd(table, impl):
         """The paged indirection for the shared step bodies —
@@ -876,7 +921,7 @@ def _build_steps(cfg, params, paged_attn: str = "einsum", draft=None):
         def decode_step_paged(pool, table, last_tokens, lengths, active,
                               temps, top_k, top_p, keys, counts):
             """Paged decode through the PALLAS paged-decode kernel
-            (``Engine(paged_attn='kernel')`` opt-in): same sampling/
+            (``Engine(paged_attn='kernel')`` — the TPU default): same sampling/
             PRNG contract and shared ``_decode_math`` body as the
             einsum twin, but the attention contraction runs the
             online-softmax kernel with the block table as scalar
@@ -900,58 +945,131 @@ def _build_steps(cfg, params, paged_attn: str = "einsum", draft=None):
                                 last_tokens, lengths, active, temps,
                                 top_k, top_p, keys, counts)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 10))
-    def verify_step_paged(pool, table, tokens, lengths, active, n_draft,
-                          temps, top_k, top_p, keys, counts):
-        """Paged speculative verify (the shared ``_verify_math`` body):
-        the k+1 window's writes may cross one page boundary — each
-        window position commits into its own page-containing row (the
-        host preallocates the table entries)."""
-        TRACE_COUNTS["verify_paged"] += 1
-        return _verify_math(_paged_fwd(table, win_impl), pool, tokens,
-                            lengths, active, n_draft, temps, top_k,
-                            top_p, keys, counts)
+    if kernel_build:
+        @functools.partial(jax.jit, donate_argnums=(0, 10))
+        def verify_step_paged(pool, table, tokens, lengths, active,
+                              n_draft, temps, top_k, top_p, keys, counts):
+            """Paged speculative verify through the flash-window kernel:
+            the k+1 window attends its own in-window prefix and the
+            cache in ONE kernel launch per layer (per-row visibility
+            ``k_pos <= pos + j`` — the window K/V are already in pages
+            by write-before-attend).  Same shared ``_verify_math`` body
+            and commit contract as the einsum twin; tolerance-bounded,
+            own TRACE_COUNTS key and pinned trace."""
+            TRACE_COUNTS["verify_paged_kernel"] += 1
+            return _verify_math(_paged_fwd(table, "kernel"), pool,
+                                tokens, lengths, active, n_draft, temps,
+                                top_k, top_p, keys, counts)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def prefill_step_paged(pool, row_table, tokens, pos, last):
-        """Paged prompt chunk for one slot: the same scalar-pos cached
-        forward the dense prefill runs, read/written through the
-        slot's table row.  Chunk starts are page-aligned (pages are
-        sized to ``prefill_chunk``), so exactly one real page is
-        written per chunk — on the gather-free path as per-token
-        commits into that page, never a view scatter."""
-        TRACE_COUNTS["prefill_paged"] += 1
-        logits, new_pool = _forward_paged(
-            cfg, params, tokens, pool, row_table[None], pos,
-            jnp.ones((1,), bool), impl=win_impl)
-        last_logits = lax.dynamic_index_in_dim(
-            logits, last, axis=1, keepdims=False)  # (1, vocab)
-        return last_logits, new_pool
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prefill_step_paged(pool, row_table, tokens, pos, last):
+            """Paged prompt chunk through the flash-prefill kernel
+            (grid ``chunk_tiles × kv_pages``, causal in-chunk mask,
+            online-softmax carry in VMEM): the chunk's KV commits as
+            one whole-page write first, then attention streams pages —
+            the max_pages-wide score tiles of the einsum path are
+            never materialized."""
+            TRACE_COUNTS["prefill_paged_kernel"] += 1
+            logits, new_pool = _forward_paged(
+                cfg, params, tokens, pool, row_table[None], pos,
+                jnp.ones((1,), bool), impl="kernel")
+            last_logits = lax.dynamic_index_in_dim(
+                logits, last, axis=1, keepdims=False)  # (1, vocab)
+            return last_logits, new_pool
 
-    @functools.partial(jax.jit, donate_argnums=(0, 12),
-                       static_argnames=("n_steps", "stream"))
-    def fused_decode_step_paged(pool, table, last_tokens, lengths, active,
-                                temps, top_k, top_p, keys, budgets,
-                                eos_ids, ring_id, counts, *, n_steps,
-                                stream=False):
-        """Paged fused decode window: the dense fused loop —
-        ``_fused_decode_math``, the one shared copy of carry,
-        early-exit predicate, PRNG discipline, commits, and the
-        optional stream tap — with the paged indirection inside the
-        ``lax.while_loop`` (the table is loop-invariant; the host
-        preallocates pages covering the window before dispatch, so an
-        in-window page-boundary crossing is always backed).  On the
-        gather-free default each loop iteration writes ONE token row
-        per running slot and reads through the table — the per-step
-        full-view gather/scatter stream is gone."""
-        TRACE_COUNTS["fused_decode_paged"] += 1
-        return _fused_decode_math(
-            _paged_fwd(table, win_impl), pool, last_tokens, lengths,
-            active, temps, top_k, top_p, keys, budgets, eos_ids, ring_id,
-            counts, n_steps=n_steps, stream=stream)
+        @functools.partial(jax.jit, donate_argnums=(0, 12),
+                           static_argnames=("n_steps", "stream"))
+        def fused_decode_step_paged(pool, table, last_tokens, lengths,
+                                    active, temps, top_k, top_p, keys,
+                                    budgets, eos_ids, ring_id, counts, *,
+                                    n_steps, stream=False):
+            """Paged fused decode with the decode KERNEL inside the
+            ``lax.while_loop`` body: every iteration's attention is one
+            paged-decode kernel launch per layer (table as scalar
+            prefetch, loop-invariant), so the fully-fused path runs
+            kernels end-to-end.  Same shared ``_fused_decode_math``
+            carry/predicate/PRNG/stream contract as the einsum twin."""
+            TRACE_COUNTS["fused_decode_paged_kernel"] += 1
+            return _fused_decode_math(
+                _paged_fwd(table, "kernel"), pool, last_tokens, lengths,
+                active, temps, top_k, top_p, keys, budgets, eos_ids,
+                ring_id, counts, n_steps=n_steps, stream=stream)
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 10))
+        def verify_step_paged(pool, table, tokens, lengths, active,
+                              n_draft, temps, top_k, top_p, keys, counts):
+            """Paged speculative verify (the shared ``_verify_math``
+            body): the k+1 window's writes may cross one page boundary
+            — each window position commits into its own page-containing
+            row (the host preallocates the table entries)."""
+            TRACE_COUNTS["verify_paged"] += 1
+            return _verify_math(_paged_fwd(table, win_impl), pool,
+                                tokens, lengths, active, n_draft, temps,
+                                top_k, top_p, keys, counts)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prefill_step_paged(pool, row_table, tokens, pos, last):
+            """Paged prompt chunk for one slot: the same scalar-pos
+            cached forward the dense prefill runs, read/written through
+            the slot's table row.  Chunk starts are page-aligned (pages
+            are sized to ``prefill_chunk``), so exactly one real page
+            is written per chunk — on the gather-free path as per-token
+            commits into that page, never a view scatter."""
+            TRACE_COUNTS["prefill_paged"] += 1
+            logits, new_pool = _forward_paged(
+                cfg, params, tokens, pool, row_table[None], pos,
+                jnp.ones((1,), bool), impl=win_impl)
+            last_logits = lax.dynamic_index_in_dim(
+                logits, last, axis=1, keepdims=False)  # (1, vocab)
+            return last_logits, new_pool
+
+        @functools.partial(jax.jit, donate_argnums=(0, 12),
+                           static_argnames=("n_steps", "stream"))
+        def fused_decode_step_paged(pool, table, last_tokens, lengths,
+                                    active, temps, top_k, top_p, keys,
+                                    budgets, eos_ids, ring_id, counts, *,
+                                    n_steps, stream=False):
+            """Paged fused decode window: the dense fused loop —
+            ``_fused_decode_math``, the one shared copy of carry,
+            early-exit predicate, PRNG discipline, commits, and the
+            optional stream tap — with the paged indirection inside the
+            ``lax.while_loop`` (the table is loop-invariant; the host
+            preallocates pages covering the window before dispatch, so
+            an in-window page-boundary crossing is always backed).  On
+            the gather-free default each loop iteration writes ONE
+            token row per running slot and reads through the table —
+            the per-step full-view gather/scatter stream is gone."""
+            TRACE_COUNTS["fused_decode_paged"] += 1
+            return _fused_decode_math(
+                _paged_fwd(table, win_impl), pool, last_tokens, lengths,
+                active, temps, top_k, top_p, keys, budgets, eos_ids,
+                ring_id, counts, n_steps=n_steps, stream=stream)
 
     if draft is None:
         fused_spec_paged = None
+    elif kernel_build:
+        @functools.partial(jax.jit, donate_argnums=(0, 13),
+                           static_argnames=("n_draft_k", "n_steps",
+                                            "stream"))
+        def fused_spec_paged(pool, table, hist, last_tokens, lengths,
+                             active, temps, top_k, top_p, keys, budgets,
+                             eos_ids, ring_id, counts, *, n_draft_k,
+                             n_steps, stream=False):
+            """Paged fused speculation with KERNELS inside the loop
+            body: each iteration's k+1 verify window runs the
+            flash-window kernel (per-row window visibility through the
+            table) while the draft model keeps its dense carry-local
+            arena — ``_fused_spec_math``, the one shared copy of the
+            draft/verify/accept carry, with the draft re-prefill
+            q-chunked (bitwise-identical logits, one chunk's score
+            tiles live instead of the full history's)."""
+            TRACE_COUNTS["fused_spec_paged_kernel"] += 1
+            return _fused_spec_math(
+                _paged_fwd(table, "kernel"), draft_cfg, draft_params,
+                pool, hist, last_tokens, lengths, active, temps, top_k,
+                top_p, keys, budgets, eos_ids, ring_id, counts,
+                n_draft_k=n_draft_k, n_steps=n_steps, stream=stream,
+                chunk_draft_prefill=True)
     else:
         @functools.partial(jax.jit, donate_argnums=(0, 13),
                            static_argnames=("n_draft_k", "n_steps",
@@ -1008,19 +1126,56 @@ def _build_steps(cfg, params, paged_attn: str = "einsum", draft=None):
             return _stack_pages(pool, layers)
         return commit
 
-    @functools.partial(jax.jit, donate_argnums=(0, 10),
-                       static_argnames=("parents",))
-    def tree_verify_paged(pool, table, tokens, lengths, active, n_cand,
-                          temps, top_k, top_p, keys, counts, *, parents):
-        """Paged speculative tree window (the shared
-        ``_tree_verify_math`` body): tree-masked scoring over the
-        gathered view, then accepted-path-only single-page commits —
-        rejected branches write nothing into the pool."""
-        TRACE_COUNTS["tree_verify_paged"] += 1
-        return _tree_verify_math(
-            _tree_paged_fwd(table), _tree_paged_commit(table), pool,
-            tokens, lengths, active, n_cand, temps, top_k, top_p, keys,
-            counts, parents=parents)
+    def _tree_kernel_fwd(table):
+        """Kernelized paged tree-verify indirection: node queries read
+        the cache THROUGH the table inside the tree kernel (strict
+        ``< pos0`` visibility + in-window ancestor mask as a
+        scalar-prefetched constant) — the gathered dense view never
+        exists.  fp pools only."""
+        def fwd(pool, tokens, lengths, depths, anc):
+            return _forward_tree_paged(cfg, params, tokens, pool, table,
+                                       lengths, depths, anc)
+        return fwd
+
+    if kernel_build:
+        @functools.partial(jax.jit, donate_argnums=(0, 10),
+                           static_argnames=("parents",))
+        def tree_verify_paged(pool, table, tokens, lengths, active,
+                              n_cand, temps, top_k, top_p, keys, counts,
+                              *, parents):
+            """Paged tree window on the kernel build: fp pools run the
+            TREE KERNEL (cache pages streamed through the table, the
+            in-flight window folded in under the ancestor mask — no
+            gather); int8 pools are the one feature the tree kernel
+            declines, so they fall back AT TRACE TIME to the exact
+            einsum/gather tree path and bump ITS counter — the
+            per-program fallback ``Engine.metrics()`` reports."""
+            if isinstance(pool, Int8Pages):
+                TRACE_COUNTS["tree_verify_paged"] += 1
+                return _tree_verify_math(
+                    _tree_paged_fwd(table), _tree_paged_commit(table),
+                    pool, tokens, lengths, active, n_cand, temps, top_k,
+                    top_p, keys, counts, parents=parents)
+            TRACE_COUNTS["tree_verify_paged_kernel"] += 1
+            return _tree_verify_math(
+                _tree_kernel_fwd(table), _tree_paged_commit(table), pool,
+                tokens, lengths, active, n_cand, temps, top_k, top_p,
+                keys, counts, parents=parents)
+    else:
+        @functools.partial(jax.jit, donate_argnums=(0, 10),
+                           static_argnames=("parents",))
+        def tree_verify_paged(pool, table, tokens, lengths, active,
+                              n_cand, temps, top_k, top_p, keys, counts,
+                              *, parents):
+            """Paged speculative tree window (the shared
+            ``_tree_verify_math`` body): tree-masked scoring over the
+            gathered view, then accepted-path-only single-page commits —
+            rejected branches write nothing into the pool."""
+            TRACE_COUNTS["tree_verify_paged"] += 1
+            return _tree_verify_math(
+                _tree_paged_fwd(table), _tree_paged_commit(table), pool,
+                tokens, lengths, active, n_cand, temps, top_k, top_p,
+                keys, counts, parents=parents)
 
     return (decode_step, verify_step, prefill_step, fused_decode_step,
             fused_spec_step, tree_verify_step,
@@ -1279,13 +1434,20 @@ class Engine:
     transfer.  Outputs stay bit-identical to the dense engine and to
     ``generate()``; ``kv_dtype="int8"`` additionally quantizes page
     payloads (tolerance-bounded outputs, double capacity).
-    ``paged_attn`` picks the attention backend: ``'einsum'`` (default)
-    reads K/V through the table inside the contraction — gather-free,
-    bit-exact; ``'gather'`` is the PR 13 gather→dense→scatter
-    baseline; ``'kernel'`` runs single-token decode through the
-    Pallas paged-decode kernel (tolerance-bounded like flash, so it
-    requires ``speculate_k=0`` and ``decode_fuse=1`` — those paths
-    lean on bit-exact single-step fall-back).  Public handles:
+    ``paged_attn`` picks the attention backend.  ``None`` — the
+    default — resolves to ``'kernel'`` on TPU backends and
+    ``'einsum'`` everywhere else (the dispatch decision is recorded in
+    :meth:`metrics`).  ``'einsum'`` reads K/V through the table inside
+    the contraction — gather-free, bit-exact; ``'gather'`` is the
+    PR 13 gather→dense→scatter baseline; ``'kernel'`` runs the WHOLE
+    hot path through the Pallas kernels — paged-decode, the k+1
+    verify window and chunked prefill through the flash-window
+    kernel, the fused ``lax.while_loop`` programs dispatching kernels
+    per iteration, and tree verify through the tree kernel — with the
+    einsum path auto-selected per-program wherever a feature lacks
+    kernel support (today: tree verify over an int8 pool; the
+    fallback is visible in ``metrics()["paged_attn"]``).  Kernel
+    programs are tolerance-bounded like flash.  Public handles:
     :attr:`page_pool` / :attr:`page_index`; mutually exclusive with
     ``prefix_cache_blocks`` (the dense COPY cache, which stays
     byte-for-byte unchanged when paging is off).
@@ -1333,7 +1495,7 @@ class Engine:
                  speculate_tree=None,
                  prefix_cache_blocks: int = 0,
                  kv_pages: int = 0, kv_dtype: str | None = None,
-                 paged_attn: str = "einsum",
+                 paged_attn: str | None = None,
                  decode_fuse: int = 1, fuse_stream: bool = False,
                  queue_limit: int | None = None,
                  drafter_timeout_s: float | None = None,
@@ -1380,25 +1542,29 @@ class Engine:
             raise ValueError(
                 "kv_dtype requires kv_pages > 0 — quantized KV lives in "
                 "page-pool payloads behind the table indirection")
-        if paged_attn not in ("einsum", "gather", "kernel"):
+        if paged_attn not in (None, "einsum", "gather", "kernel"):
             raise ValueError(
-                f"paged_attn must be 'einsum' (gather-free bit-exact "
-                f"blockwise attention — the default), 'gather' (PR 13's "
-                f"gather→dense→scatter baseline), or 'kernel' (Pallas "
-                f"paged-decode kernel, tolerance-bounded); got "
+                f"paged_attn must be None (auto: 'kernel' on TPU, "
+                f"'einsum' elsewhere), 'einsum' (gather-free bit-exact "
+                f"blockwise attention), 'gather' (PR 13's "
+                f"gather→dense→scatter baseline), or 'kernel' (the "
+                f"Pallas hot-path kernels, tolerance-bounded); got "
                 f"{paged_attn!r}")
-        if paged_attn != "einsum" and not kv_pages:
+        if paged_attn is not None and paged_attn != "einsum" \
+                and not kv_pages:
             raise ValueError(
                 f"paged_attn={paged_attn!r} requires kv_pages > 0 — the "
                 f"paged-attention backend choice only exists behind the "
                 f"block-table indirection")
-        if paged_attn == "kernel" and (speculate_k or decode_fuse > 1):
-            raise ValueError(
-                "paged_attn='kernel' supports plain single-step decode "
-                "only (speculate_k=0, decode_fuse=1): the kernel is "
-                "tolerance-bounded like flash, and the speculative/"
-                "fused paths rely on bit-exact fall-back to the "
-                "single-step program")
+        # The TPU-default resolution: unset paged_attn means "kernels
+        # where the hardware wants them".  On TPU the Pallas kernels ARE
+        # the paged hot path; CPU hosts (every tier-1 test) silently
+        # resolve to the bit-exact einsum path — an explicit 'kernel'
+        # still runs (interpret mode) for parity testing.
+        self.paged_attn_requested = paged_attn
+        if paged_attn is None:
+            paged_attn = ("kernel" if kv_pages
+                          and jax.default_backend() == "tpu" else "einsum")
         if drafter is not None and speculate_k == 0:
             raise ValueError("drafter requires speculate_k >= 1 "
                              "(speculation is off at k=0)")
@@ -1504,10 +1670,25 @@ class Engine:
         self.kv_dtype = kv_dtype
         # Paged-attention backend (only meaningful with kv_pages > 0):
         # "einsum" — gather-free blockwise attention through the table,
-        # bit-exact vs dense (the default); "gather" — the PR 13
-        # gather/scatter baseline; "kernel" — Pallas paged-decode
-        # kernel (tolerance-bounded opt-in).
+        # bit-exact vs dense; "gather" — the PR 13 gather/scatter
+        # baseline; "kernel" — the Pallas hot-path kernels
+        # (tolerance-bounded, TPU default).  paged_attn_requested keeps
+        # the constructor value (None = auto) for metrics().
         self.paged_attn = paged_attn
+        # Static per-program dispatch table: which impl each paged
+        # program family actually traces with.  The decision is made
+        # here, once, at build time — a kernel engine falls back to the
+        # bit-exact einsum program wherever a feature lacks kernel
+        # support (today: tree verify over an int8 pool).  metrics()
+        # exposes this table so every fall-back dispatch is visible.
+        self.paged_attn_dispatch: dict[str, str] = {}
+        if self._paged:
+            fams = ("decode_paged", "verify_paged", "prefill_paged",
+                    "fused_decode_paged", "fused_spec_paged",
+                    "tree_verify_paged")
+            self.paged_attn_dispatch = {f: paged_attn for f in fams}
+            if paged_attn == "kernel" and kv_dtype == "int8":
+                self.paged_attn_dispatch["tree_verify_paged"] = "einsum"
         self._max_pages = self.max_len // prefill_chunk  # table width
         # Fused decode windows (module docstring "Fused decode windows"):
         # decode_fuse=1 — the default — never touches the fused program
@@ -2081,6 +2262,18 @@ class Engine:
                 {"num_pages": p.num_pages, "used_pages": p.used_pages,
                  "free_pages": p.free_pages,
                  "page_bytes": p.page_bytes()} for p in pools]
+            # The backend dispatch record: what was asked for, what it
+            # resolved to, and the per-program-family impl actually
+            # traced — a kernel engine's einsum fall-backs (features
+            # the kernels don't cover) show up here, not silently.
+            out["paged_attn"] = {
+                "requested": self.paged_attn_requested,
+                "resolved": self.paged_attn,
+                "dispatch": dict(self.paged_attn_dispatch),
+                "fallbacks": sorted(
+                    f for f, impl in self.paged_attn_dispatch.items()
+                    if self.paged_attn == "kernel" and impl != "kernel"),
+            }
         if self.stats.get("draft_tokens"):
             out["acceptance_rate"] = self.acceptance_rate
         return out
