@@ -126,6 +126,31 @@ baseline p99 x TENANCY_P99_BOUND — the SLO priority scheduling exists
 to defend), ``parity_ok`` (every completed request, preempted or not,
 bit-identical to ``generate()``), and ``no_leak``.
 
+With ``--disagg SEED1,SEED2`` (or SERVE_DISAGG) the bench instead runs
+the DISAGGREGATED serving stage (one ``serve_disagg`` row per seed):
+two OS processes — rank 0 the prefill host, rank 1 the decode host —
+rendezvous over ``jax.distributed`` and drive the real
+:class:`tpudp.serve.disagg.DisaggHost` four-phase handshake, while the
+SAME deterministic per-seed workload (Poisson arrivals in the
+``default`` tenant class plus a same-instant ``urgent`` burst that
+preempts) also runs through one colocated engine for the baseline.
+Every request must prefill on rank 0 and decode on rank 1
+(``split_ok``), with outputs bit-identical to the colocated run —
+greedy and sampled (``parity_ok``), both processes ending empty with
+leak-free pools (``no_leak``), TTFT p99 and decode-gap p99 within
+DISAGG_TTFT_BOUND / DISAGG_P99_BOUND x the colocated percentiles
+(``ttft_ok`` / ``p99_ok``), and the headline ``value`` = the migration
+cost, transfer-span microseconds per adopted page.  Like the
+train_soak_multihost stage there is no real-TPU device gate: the two
+ranks are co-located CPU processes by construction (two processes
+cannot share one host's libtpu), and what the row certifies — the
+handoff protocol and its cost — is platform-independent.  The soak
+stage (``--soak``) additionally replays each seed's workload through a
+3-host in-process ``DisaggCluster`` under the four WIRE fault
+injectors (dropped / corrupt / slow / sender-killed-mid-offer): no
+wedge, no page leak, bit-exact survivor parity, folded into the soak
+row's gates.
+
 Runs on whatever device is attached; SERVE_PLATFORM=cpu pins the CPU
 smoke mode (tier-1 runs it at a trimmed geometry).  Knobs: SERVE_CONCURRENCY
 (comma-separated subset of the registered levels — the watcher's
@@ -142,6 +167,9 @@ SERVE_PREFIX_USERS, SERVE_PREFIX_TURNS,
 SOAK_REQUESTS, SOAK_LAYERS, SOAK_DMODEL, SOAK_VOCAB,
 SERVE_TENANCY (seed subset), TENANCY_STEPS, TENANCY_HIGH, TENANCY_QL,
 TENANCY_P99_BOUND, TENANCY_LAYERS, TENANCY_DMODEL, TENANCY_VOCAB,
+SERVE_DISAGG (seed subset), DISAGG_REQUESTS, DISAGG_BURST,
+DISAGG_MAX_NEW, DISAGG_MEAN_GAP_S, DISAGG_LAYERS, DISAGG_DMODEL,
+DISAGG_VOCAB, DISAGG_TTFT_BOUND, DISAGG_P99_BOUND,
 SERVE_STRICT_LEVELS=1 (reject unregistered levels/seeds).
 """
 
@@ -155,13 +183,15 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.bench_gaps import (SERVE_CONCURRENCIES,  # noqa: E402 (stdlib-only)
-                              SERVE_FUSED_NS, SERVE_PAGED_TRAFFIC,
+                              SERVE_DISAGG_SEEDS, SERVE_FUSED_NS,
+                              SERVE_PAGED_TRAFFIC,
                               SERVE_PAGED_WORKLOADS,
                               SERVE_PREFIX_WORKLOADS, SERVE_SOAK_SEEDS,
                               SERVE_SPEC_FUSED_CONFIGS, SERVE_SPEC_KS,
                               SERVE_TENANCY_SEEDS)
 
 METRIC = "serve_tokens_per_sec"
+DISAGG_METRIC = "serve_disagg"
 SPEC_METRIC = "serve_spec_tokens_per_sec"
 SOAK_METRIC = "serve_soak"
 PREFIX_METRIC = "serve_prefix"
@@ -193,6 +223,246 @@ def _percentile(xs, q):
 
 def _parse_levels(value):
     return [int(x) for x in value.split(",") if x]
+
+
+def _disagg_workload(seed: int) -> list[dict]:
+    """Deterministic per-seed arrival plan shared by the colocated
+    baseline worker and the two disagg ranks (all three reconstruct it
+    from the seed, so no workload bytes cross the process boundary):
+    Poisson inter-arrivals in the ``default`` tenant class, alternating
+    greedy and sampled, plus a same-instant ``urgent`` BURST landing at
+    the median arrival — the burst preempts default slots through the
+    tenancy layer, so the handoff path is exercised under admission
+    churn, not a quiet queue."""
+    import numpy as np
+
+    n = int(os.environ.get("DISAGG_REQUESTS", 6))
+    burst = int(os.environ.get("DISAGG_BURST", 3))
+    max_new = int(os.environ.get("DISAGG_MAX_NEW", 8))
+    vocab = int(os.environ.get("DISAGG_VOCAB", 128))
+    mean_gap = float(os.environ.get("DISAGG_MEAN_GAP_S", 0.02))
+    rng = np.random.default_rng(77_000 + seed)
+    gaps = rng.exponential(mean_gap, size=n)
+    offsets = np.cumsum(gaps) - gaps[0]
+    jobs = []
+    for i in range(n):
+        kw = {} if i % 2 == 0 else dict(temperature=0.8, top_k=7,
+                                        seed=100 + seed + i)
+        jobs.append(dict(
+            offset=float(offsets[i]), tenant="default",
+            prompt=rng.integers(0, vocab, size=8 + 2 * (i % 3))
+            .astype(np.int32),
+            max_new=max_new - (i % 3), kw=kw))
+    burst_at = float(offsets[n // 2])
+    for _ in range(burst):
+        jobs.append(dict(
+            offset=burst_at, tenant="urgent",
+            prompt=rng.integers(0, vocab, size=8).astype(np.int32),
+            max_new=max_new, kw={}))
+    jobs.sort(key=lambda j: j["offset"])
+    return jobs
+
+
+def _disagg_build(seed: int):
+    """(model, params, engine) at the disagg smoke geometry — tiny like
+    the soak's (the stage measures the HANDOFF, not FLOPs), tenant-aware
+    (the burst needs a priority tier to preempt through), paged (the
+    transfer ships pages)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudp.models.gpt2 import GPT2, GPT2Config
+    from tpudp.serve import Engine, TenantClass
+
+    cfg = GPT2Config(
+        vocab_size=int(os.environ.get("DISAGG_VOCAB", 128)),
+        max_seq_len=64,
+        num_layers=int(os.environ.get("DISAGG_LAYERS", 2)),
+        num_heads=2,
+        d_model=int(os.environ.get("DISAGG_DMODEL", 64)))
+    model = GPT2(cfg)
+    # Same seed, same platform -> bit-identical params on every rank
+    # and in the colocated baseline, no weight broadcast needed.
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = Engine(model, params, num_slots=4, max_len=32,
+                 prefill_chunk=8, kv_pages=24,
+                 tenants={"default": TenantClass(priority=0),
+                          "urgent": TenantClass(priority=1)})
+    return model, params, eng
+
+
+def _disagg_worker_main(spec: str) -> None:
+    """Subprocess body for the serve_disagg stage (not a bench row
+    emitter itself — it writes one JSON result file the parent joins).
+    ``spec`` is ``mode:nproc:port:out_path:seed`` where mode is ``c``
+    (colocated baseline, no distributed init) or a rank digit.  Always
+    CPU: two processes cannot share one host's libtpu, and the protocol
+    the stage certifies is platform-independent."""
+    mode, nproc, port, out_path, seed = spec.split(":", 4)
+    seed = int(seed)
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jobs = _disagg_workload(seed)
+    result: dict = {"mode": mode, "seed": seed}
+
+    def _submit_due(eng, handles, nxt, start):
+        now = time.perf_counter() - start
+        while nxt < len(jobs) and now >= jobs[nxt]["offset"]:
+            j = jobs[nxt]
+            handles[nxt] = eng.submit(j["prompt"], j["max_new"],
+                                      tenant=j["tenant"], **j["kw"])
+            nxt += 1
+        return nxt
+
+    import numpy as np
+
+    warm_prompt = np.zeros(8, np.int32)
+    if mode == "c":
+        _model, _params, eng = _disagg_build(seed)
+        # Warmup off the clock: compile prefill/decode/sample before the
+        # timed arrivals (the disagg ranks warm up symmetrically, so the
+        # latency ratio the parent gates on compares compiled-vs-
+        # compiled, not compile luck).
+        wh = eng.submit(warm_prompt, 6, tenant="default")
+        while not wh.done:
+            eng.step()
+        handles: list = [None] * len(jobs)
+        nxt = 0
+        start = time.perf_counter()
+        while nxt < len(jobs) or eng.slots_in_use or eng.queue_depth:
+            nxt = _submit_due(eng, handles, nxt, start)
+            eng.step()
+        eng.check_paged()
+        result.update(
+            tokens={str(i): list(h.tokens)
+                    for i, h in enumerate(handles)},
+            ttfts=[h.token_times[0] - h.submit_time for h in handles
+                   if h.token_times],
+            gaps=[b - a for h in handles
+                  for a, b in zip(h.token_times, h.token_times[1:])],
+            no_leak=(eng.slots_in_use == 0 and eng.queue_depth == 0),
+            stats={k: int(v) for k, v in eng.stats.items()})
+    else:
+        rank = int(mode)
+        from tpudp.mesh import initialize_distributed
+
+        initialize_distributed("127.0.0.1", int(nproc), rank,
+                               port=int(port))
+        from tpudp.serve.disagg import DisaggHost
+
+        _model, _params, eng = _disagg_build(seed)
+        host = DisaggHost(eng, rank=rank, n_hosts=int(nproc),
+                          role=("prefill" if rank == 0 else "decode"),
+                          retries=2)
+        admitted: list = []   # (sender rid, tokens carried at admit, req)
+        host.on_admit = lambda src, t, r: admitted.append(
+            (t.rid, len(r.tokens), r))
+        # Warmup off the clock: one dummy request travels the WHOLE
+        # handoff (prefill on rank 0, pages over the wire, decode on
+        # rank 1), compiling both engines' programs AND the handshake
+        # collectives at a representative blob width before the timed
+        # workload.  Its stats/spans are snapshotted out below.
+        wwh = (eng.submit(warm_prompt, 6, tenant="default")
+               if rank == 0 else None)
+        wstaged = False
+        for _ in range(200):
+            eng.step()
+            if (rank == 0 and not wstaged and wwh.tokens
+                    and not wwh.done and wwh._nfill == wwh._fill.size
+                    and wwh._slot is not None):
+                host.stage(1, wwh)
+                wstaged = True
+            w_done = (eng.slots_in_use == 0 and eng.queue_depth == 0
+                      and host.pending == 0
+                      and (rank != 0 or wstaged))
+            if os.environ.get("DISAGG_DEBUG"):
+                print(f"[warm r{rank}] slots={eng.slots_in_use} "
+                      f"q={eng.queue_depth} pend={host.pending} "
+                      f"staged={wstaged} done={w_done} "
+                      f"toks={wwh.tokens if wwh else None} "
+                      f"wdone={wwh.done if wwh else None}",
+                      file=sys.stderr, flush=True)
+            if host.round(done=w_done):
+                break
+        else:
+            raise RuntimeError("disagg warmup never completed")
+        base_stats = dict(eng.stats)
+        base_spans = {k: dict(v)
+                      for k, v in eng.metrics()["spans"].items()}
+        admitted.clear()
+        handles = [None] * len(jobs)
+        staged: set = set()
+        nxt = 0
+        # Handshake cadence: a full round costs a handful of host-wide
+        # collectives, so running one EVERY engine step taxes each
+        # decode token with round latency.  Both ranks key the cadence
+        # off the same iteration counter (their loops advance in
+        # lockstep between rounds), so the collective sequence stays
+        # host-uniform — the property the protocol verifier proves.
+        round_every = int(os.environ.get("DISAGG_ROUND_EVERY", 4))
+        start = time.perf_counter()
+        for it in range(5000):
+            if rank == 0:
+                nxt = _submit_due(eng, handles, nxt, start)
+            eng.step()
+            if rank == 0:
+                for h in handles:
+                    if (h is not None and h.id not in staged
+                            and h.tokens and not h.done
+                            and h._nfill == h._fill.size
+                            and h._slot is not None):
+                        host.stage(1, h)
+                        staged.add(h.id)
+            if (it + 1) % round_every:
+                continue
+            my_done = (eng.slots_in_use == 0 and eng.queue_depth == 0
+                       and host.pending == 0
+                       and (rank != 0 or (nxt == len(jobs)
+                                          and len(staged) == len(jobs))))
+            if host.round(done=my_done):
+                break
+        else:
+            raise RuntimeError("disagg round loop never reached "
+                               "joint done")
+        eng.check_paged()
+        # Report the timed workload's deltas, not the warmup's: the
+        # headline us/page divides the transfer span by migrated pages,
+        # and the warmup transfer carries the one-off compile cost.
+        spans = {}
+        for k, v in eng.metrics()["spans"].items():
+            b = base_spans.get(k, {})
+            spans[k] = {
+                "count": int(v["count"]) - int(b.get("count", 0)),
+                "total_s": float(v["total_s"])
+                - float(b.get("total_s", 0.0))}
+        result.update(
+            no_leak=(eng.slots_in_use == 0 and eng.queue_depth == 0
+                     and host.pending == 0),
+            stats={k: int(v) - int(base_stats.get(k, 0))
+                   for k, v in eng.stats.items()},
+            spans=spans)
+        if rank == 0:
+            result.update(
+                ttfts=[h.token_times[0] - h.submit_time for h in handles
+                       if h is not None and h.token_times],
+                rid_map={str(i): h.id for i, h in enumerate(handles)
+                         if h is not None},
+                staged=len(staged), n_jobs=len(jobs))
+        else:
+            toks, gaps = {}, []
+            for rid, carried, r in admitted:
+                toks[str(rid)] = list(r.tokens)
+                tt = r.token_times[carried:]
+                gaps.extend(b - a for a, b in zip(tt, tt[1:]))
+            result.update(tokens_by_rid=toks, gaps=gaps)
+    with open(out_path, "w") as f:
+        json.dump(result, f, default=str)
+    if mode != "c":
+        jax.distributed.shutdown()
 
 
 def main() -> None:
@@ -230,6 +500,16 @@ def main() -> None:
                          "capacity + TTFT row — Engine(kv_pages=N) vs "
                          "the dense copy-cache engine at the same KV "
                          "byte budget (env: SERVE_PAGED)")
+    ap.add_argument("--disagg", default=None,
+                    help="comma-separated disagg seeds; runs the "
+                         "two-process prefill/decode split (rank 0 "
+                         "prefills and ships pages, rank 1 adopts and "
+                         "decodes) against a colocated baseline on the "
+                         "same Poisson+burst mixed-tenant workload "
+                         "(env: SERVE_DISAGG)")
+    ap.add_argument("--disagg-worker", default=None,
+                    help="internal: subprocess body for the --disagg "
+                         "stage (mode:nproc:port:out_path:seed)")
     ap.add_argument("--tenants", default=None,
                     help="comma-separated multi-tenant seeds; runs the "
                          "mixed-priority tenancy workload (per-tier "
@@ -247,6 +527,11 @@ def main() -> None:
                          "row (the acceptance bar is within 3%% on the "
                          "CPU smoke host; env: SERVE_OBS_CHECK=1)")
     args = ap.parse_args()
+
+    if args.disagg_worker:
+        # Before the jax import: the worker pins its own platform/env.
+        _disagg_worker_main(args.disagg_worker)
+        return
 
     import jax
 
@@ -286,6 +571,8 @@ def main() -> None:
     soak_seeds = _parse_levels(soak_env) if soak_env else []
     tenancy_env = args.tenants or os.environ.get("SERVE_TENANCY")
     tenancy_seeds = _parse_levels(tenancy_env) if tenancy_env else []
+    disagg_env = args.disagg or os.environ.get("SERVE_DISAGG")
+    disagg_seeds = _parse_levels(disagg_env) if disagg_env else []
     prefix_env = args.prefix_cache or os.environ.get("SERVE_PREFIX")
     prefix_workloads = ([w for w in prefix_env.split(",") if w]
                         if prefix_env else [])
@@ -310,6 +597,7 @@ def main() -> None:
         bad = [c for c in levels if c not in SERVE_CONCURRENCIES]
         if (not spec_ks and not soak_seeds and not prefix_workloads
                 and not paged_workloads and not tenancy_seeds
+                and not disagg_seeds
                 and not fused_ns and not sf_pairs and bad):
             raise SystemExit(f"error: unregistered concurrency levels {bad} "
                              f"(registry: {list(SERVE_CONCURRENCIES)})")
@@ -330,6 +618,10 @@ def main() -> None:
         if bad_t:
             raise SystemExit(f"error: unregistered tenancy seeds {bad_t} "
                              f"(registry: {list(SERVE_TENANCY_SEEDS)})")
+        bad_d = [s for s in disagg_seeds if s not in SERVE_DISAGG_SEEDS]
+        if bad_d:
+            raise SystemExit(f"error: unregistered disagg seeds {bad_d} "
+                             f"(registry: {list(SERVE_DISAGG_SEEDS)})")
     n_requests = int(os.environ.get("SERVE_REQUESTS", 24))
     prompt_len = int(os.environ.get("SERVE_PROMPT_LEN", 16))
     max_new = int(os.environ.get("SERVE_MAX_NEW", 32))
@@ -394,10 +686,10 @@ def main() -> None:
         d_model=dm,
     )
     model = GPT2(cfg)
-    # Soak and tenancy modes build their own tiny models (they measure
-    # scheduling under faults/priorities, not FLOPs) — don't pay the
-    # ~93 MB default init for them.
-    params = (None if soak_seeds or tenancy_seeds else
+    # Soak, tenancy, and disagg modes build their own tiny models (they
+    # measure scheduling/handoff under faults/priorities, not FLOPs) —
+    # don't pay the ~93 MB default init for them.
+    params = (None if soak_seeds or tenancy_seeds or disagg_seeds else
               model.init(jax.random.PRNGKey(seed),
                          jnp.zeros((1, 8), jnp.int32))["params"])
     kind = jax.devices()[0].device_kind
@@ -564,7 +856,7 @@ def main() -> None:
     seq_latencies = []
     if (not spec_ks and not soak_seeds and not prefix_workloads
             and not paged_workloads and not tenancy_seeds
-            and not fused_ns and not sf_pairs):
+            and not disagg_seeds and not fused_ns and not sf_pairs):
         np.asarray(generate(model, params, jnp.asarray(prompts[0][None]),
                             max_new))
         t0 = time.perf_counter()
@@ -1064,6 +1356,80 @@ def main() -> None:
                                       storm_new))[0, p_len:]
             if h.tokens != ref.tolist():
                 parity_ok = False
+        # Disaggregated transfer-fault sub-phase: the same seed replays
+        # a small mixed greedy/sampled job set through a 3-host
+        # in-process DisaggCluster once per WIRE injector — dropped
+        # transfers (retries exhaust -> typed local fallback), corrupt
+        # payloads (receiver quarantine + clean retry), a slow link,
+        # and a sender SIGKILL'd mid-offer (survivor failover).  The
+        # bar folds into the row's gates: no wedge (bounded ticks), no
+        # page leak on any surviving host, survivors bit-identical to
+        # one colocated engine.
+        from tpudp.serve import DisaggCluster
+        from tpudp.serve.faults import (CorruptPagePayload,
+                                        DroppedTransfer,
+                                        SenderKilledMidOffer, SlowLink)
+
+        d_rng = np.random.default_rng(20_000 + soak_seed)
+        d_jobs = []
+        for i in range(4):
+            kw = {} if i % 2 == 0 else dict(
+                temperature=0.8, top_k=7, seed=300 + soak_seed + i)
+            d_jobs.append((d_rng.integers(0, s_cfg.vocab_size,
+                                          size=8 + 2 * (i % 2))
+                           .astype(np.int32), 5 + i % 3, kw))
+
+        def _d_engine():
+            return Engine(s_model, s_params, num_slots=4, max_len=32,
+                          prefill_chunk=8, kv_pages=24)
+
+        d_ref = _d_engine()
+        d_handles = [d_ref.submit(p, m, **kw) for p, m, kw in d_jobs]
+        d_ref.run_until_complete()
+        d_ref.check_paged()
+        d_want = [list(h.tokens) for h in d_handles]
+        transfer_parity = True
+        transfer_no_leak = True
+        transfer_wedged = False
+        transfer_quarantined = 0
+        transfer_retries = 0
+        transfer_failovers = 0
+        d_faults = (
+            DroppedTransfer(rank=0, at_seqs=range(0, 40)),
+            CorruptPagePayload(rank=0,
+                               at_seqs=range(0, 2 + soak_seed % 2)),
+            SlowLink(delay_s=0.001, rank=0),
+            # at_seq=4: late enough that a handoff has landed on rank 2
+            # by the kill, so the death orphans a journaled request and
+            # the failover vote actually redistributes it (at seq 2 the
+            # host still owns nothing and failover is a no-op).
+            SenderKilledMidOffer(rank=2, at_seq=4),
+        )
+        for d_fault in d_faults:
+            cl = DisaggCluster([_d_engine() for _ in range(3)],
+                               prefill=0, retries=1, faults=(d_fault,))
+            d_creqs = [cl.submit(p, m, **kw) for p, m, kw in d_jobs]
+            try:
+                cl.run_until_complete(max_ticks=3000)
+            except RuntimeError:
+                transfer_wedged = True
+                continue
+            if [c.tokens for c in d_creqs] != d_want:
+                transfer_parity = False
+            try:
+                cl.check()
+            except Exception:  # noqa: BLE001
+                transfer_no_leak = False
+            st = cl.stats()
+            transfer_quarantined += sum(
+                s.get("quarantined_transfers", 0) for s in st.values())
+            transfer_retries += sum(
+                s.get("migration_retries", 0) for s in st.values())
+            transfer_failovers += sum(
+                1 for e in cl.events if e["kind"] == "failover")
+        parity_ok = parity_ok and transfer_parity
+        no_leak = no_leak and transfer_no_leak
+        wedged = wedged or transfer_wedged
         emit({
             "metric": SOAK_METRIC,
             "seed": soak_seed,
@@ -1083,6 +1449,10 @@ def main() -> None:
             "preempted": int(eng.stats["preempted"]),
             "step_failures": int(eng.stats["step_failures"]),
             "drafter_quarantined": int(eng.stats["drafter_quarantined"]),
+            "transfer_faults": len(d_faults),
+            "transfer_quarantined": int(transfer_quarantined),
+            "transfer_retries": int(transfer_retries),
+            "transfer_failovers": int(transfer_failovers),
             "num_layers": s_cfg.num_layers,
             "d_model": s_cfg.d_model,
             "vocab_size": s_cfg.vocab_size,
@@ -1771,8 +2141,133 @@ def main() -> None:
         bank_metrics("serve_paged_kernel", f"{workload}:{traffic}",
                      engines[2].metrics())
 
+    def run_disagg(d_seed: int) -> None:
+        """Two-process prefill/decode split vs the colocated engine on
+        the identical Poisson+burst mixed-tenant workload.  All three
+        measurement bodies run as SUBPROCESSES (``--disagg-worker``)
+        pinned to CPU, so the baseline and the split are always the
+        same platform regardless of what this parent attached — the
+        latency ratio the row gates on compares like with like."""
+        import socket
+        import subprocess
+        import tempfile
+
+        # Both latency bounds are generous on purpose.  At the CPU smoke
+        # geometry a colocated decode step costs ~2ms and colocated TTFT
+        # p99 ~9ms, so every disagg number is dominated by
+        # collective-dispatch latency: TTFT pays a full handoff (offer
+        # round + page transfer + adopt + first decode, ~100ms of
+        # collectives) and every rank-1 token that lands next to a
+        # handshake round absorbs tens of ms, putting the ratios around
+        # 10-17x (TTFT) and 30-60x (decode gap) no matter how small the
+        # model is.  The gates exist to catch order-of-magnitude
+        # regressions — a handoff that blocks decode outright, a retry
+        # storm stretching gaps to seconds — not to price round latency,
+        # which amortizes away at real decode-step costs.
+        bound_ttft = float(os.environ.get("DISAGG_TTFT_BOUND", 30.0))
+        bound_p99 = float(os.environ.get("DISAGG_P99_BOUND", 100.0))
+        script = os.path.abspath(__file__)
+        wenv = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+
+        def spawn(mode, nproc, port, out):
+            return subprocess.Popen(
+                [sys.executable, script, "--disagg-worker",
+                 f"{mode}:{nproc}:{port}:{out}:{d_seed}"],
+                env=wenv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        with tempfile.TemporaryDirectory() as td:
+            co_out = os.path.join(td, "colocated.json")
+            p = spawn("c", 1, 0, co_out)
+            text, _ = p.communicate(timeout=600)
+            if p.returncode != 0:
+                raise RuntimeError(f"colocated worker rc="
+                                   f"{p.returncode}:\n{text[-1500:]}")
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            outs = [os.path.join(td, f"rank{r}.json") for r in range(2)]
+            procs = [spawn(str(r), 2, port, outs[r]) for r in range(2)]
+            texts = [pr.communicate(timeout=600)[0] for pr in procs]
+            for pr, t in zip(procs, texts):
+                if pr.returncode != 0:
+                    raise RuntimeError(f"disagg worker rc="
+                                       f"{pr.returncode}:\n{t[-1500:]}")
+            with open(co_out) as f:
+                co = json.load(f)
+            with open(outs[0]) as f:
+                r0 = json.load(f)
+            with open(outs[1]) as f:
+                r1 = json.load(f)
+        # Join on the sender's request id: rank 0 maps workload index ->
+        # rid, rank 1 keys adopted outputs by the ticket's rid.
+        parity_ok = True
+        for i, want in co["tokens"].items():
+            rid = r0["rid_map"].get(i)
+            if r1["tokens_by_rid"].get(str(rid)) != want:
+                parity_ok = False
+        split_ok = (r0["staged"] == r0["n_jobs"]
+                    and len(r1["tokens_by_rid"]) == r0["n_jobs"])
+        no_leak = bool(co["no_leak"] and r0["no_leak"] and r1["no_leak"])
+        c_ttft_p99 = _percentile(co["ttfts"], 99)
+        d_ttft_p99 = _percentile(r0["ttfts"], 99)
+        c_gap_p99 = _percentile(co["gaps"], 99)
+        d_gap_p99 = _percentile(r1["gaps"], 99)
+        ttft_ok = bool(c_ttft_p99 and d_ttft_p99 is not None
+                       and d_ttft_p99 <= bound_ttft * c_ttft_p99)
+        p99_ok = bool(c_gap_p99 and d_gap_p99 is not None
+                      and d_gap_p99 <= bound_p99 * c_gap_p99)
+        pages = int(r1["stats"].get("migrated_in_pages", 0))
+        xfer_s = float(r0["spans"].get("migrate_transfer", {})
+                       .get("total_s", 0.0))
+        emit({
+            "metric": DISAGG_METRIC,
+            "seed": d_seed,
+            "value": (round(xfer_s * 1e6 / pages, 1) if pages else None),
+            "unit": "migration_us_per_page",
+            "parity_ok": parity_ok,
+            "no_leak": no_leak,
+            "split_ok": split_ok,
+            "ttft_ok": ttft_ok,
+            "p99_ok": p99_ok,
+            "migrated": int(r1["stats"].get("migrated_in", 0)),
+            "migrated_pages": pages,
+            "migration_retries": int(
+                r0["stats"].get("migration_retries", 0)),
+            "quarantined": int(
+                r1["stats"].get("quarantined_transfers", 0)),
+            "preempted": int(r0["stats"].get("preempted", 0)),
+            "ttft_p50_ms": round(
+                (_percentile(r0["ttfts"], 50) or 0) * 1e3, 3),
+            "ttft_p99_ms": round((d_ttft_p99 or 0) * 1e3, 3),
+            "colocated_ttft_p50_ms": round(
+                (_percentile(co["ttfts"], 50) or 0) * 1e3, 3),
+            "colocated_ttft_p99_ms": round((c_ttft_p99 or 0) * 1e3, 3),
+            "decode_gap_p99_ms": round((d_gap_p99 or 0) * 1e3, 3),
+            "colocated_decode_gap_p99_ms": round(
+                (c_gap_p99 or 0) * 1e3, 3),
+            "ttft_bound": bound_ttft,
+            "p99_bound": bound_p99,
+            "requests": int(os.environ.get("DISAGG_REQUESTS", 6)),
+            "burst": int(os.environ.get("DISAGG_BURST", 3)),
+            "device_kind": kind,
+        })
+        bank_metrics("serve_disagg", d_seed, {
+            "rank0": {"stats": r0["stats"], "spans": r0["spans"]},
+            "rank1": {"stats": r1["stats"], "spans": r1["spans"]}})
+
     # One level crashing (OOM, transient backend fault) must not cost
     # the remaining rows — same isolation contract as matrix_bench.
+    if disagg_seeds:
+        for s in disagg_seeds:
+            try:
+                run_disagg(s)
+            except Exception as exc:  # noqa: BLE001
+                emit({"metric": DISAGG_METRIC, "seed": s,
+                      "error": f"{type(exc).__name__}: {exc}"[:500]})
+        write_sidecar()
+        print(json.dumps({"serve_disagg": results}))
+        return
     if tenancy_seeds:
         for s in tenancy_seeds:
             try:

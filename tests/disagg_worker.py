@@ -1,0 +1,135 @@
+"""Subprocess worker for the two-process disaggregated-serving test
+(tests/test_disagg.py, slow tier; not itself a test module).
+
+Two OS processes rendezvous via ``jax.distributed.initialize`` on CPU:
+rank 0 is the PREFILL host, rank 1 the DECODE host.  Rank 0 admits and
+chunk-prefills the prompts, stages each request after its first token,
+and both ranks drive :meth:`DisaggHost.round` in lockstep — the REAL
+four-phase handshake over ``gather_host_values``/``gather_host_blobs``,
+the exact code path the protocol verifier proves host-uniform.  With
+``FAULT=corrupt``, rank 0's first transfer is bit-flipped on the wire:
+rank 1 must QUARANTINE it (flight dump under its flight dir, no early
+exit from the round) and the retry must deliver, bit-exactly.
+
+Usage: python disagg_worker.py RANK NPROC PORT OUT_JSON FLIGHT_DIR FAULT
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = int(sys.argv[3])
+    out_path = sys.argv[4]
+    flight_dir = sys.argv[5]
+    fault = sys.argv[6] if len(sys.argv) > 6 else "none"
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpudp.mesh import initialize_distributed
+
+    initialize_distributed("127.0.0.1", nproc, rank, port=port)
+
+    import numpy as np
+
+    from tpudp.models.generate import generate
+    from tpudp.models.gpt2 import gpt2_small
+    from tpudp.serve import Engine
+    from tpudp.serve.disagg import DisaggHost
+    from tpudp.serve.faults import CorruptPagePayload
+    from tpudp.train import init_state, make_optimizer
+
+    assert jax.process_count() == nproc
+    model = gpt2_small(vocab_size=61, max_seq_len=96, num_layers=2,
+                       num_heads=2, d_model=32)
+    state = init_state(model, make_optimizer(), input_shape=(1, 8))
+    params = state.params   # same seed everywhere -> identical params
+    eng = Engine(model, params, num_slots=2, max_len=64,
+                 prefill_chunk=8, kv_pages=16, flight_dir=flight_dir)
+    class _FirstTransferCorrupt:
+        """One-shot: bit-flip the FIRST non-empty outgoing transfer
+        (whatever round it lands on), leave every retry clean."""
+
+        def __init__(self):
+            self.inner = CorruptPagePayload(rank=0, at_seqs=range(999))
+            self.fired = []
+
+        def on_send(self, rank_, seq, blob):
+            if self.fired:
+                return blob
+            out = self.inner.on_send(rank_, seq, blob)
+            self.fired = list(self.inner.fired)
+            return out
+
+    faults = ()
+    if fault == "corrupt" and rank == 0:
+        faults = (_FirstTransferCorrupt(),)
+    host = DisaggHost(eng, rank=rank, n_hosts=nproc,
+                      role=("prefill" if rank == 0 else "decode"),
+                      faults=faults, retries=2)
+
+    rng = np.random.default_rng(41)
+    jobs = [(rng.integers(0, 61, size=9 + 2 * i).astype(np.int32),
+             6 + i) for i in range(2)]
+    admitted = []
+    host.on_admit = lambda src, t, r: admitted.append(r)
+    staged = set()
+    if rank == 0:
+        handles = [eng.submit(p, n) for p, n in jobs]
+
+    for _ in range(200):
+        eng.step()
+        if rank == 0:
+            for h in handles:
+                if (h.id not in staged and h.tokens and not h.done
+                        and h._nfill == h._fill.size
+                        and h._slot is not None):
+                    host.stage(1, h)
+                    staged.add(h.id)
+        my_done = (eng.slots_in_use == 0 and eng.queue_depth == 0
+                   and host.pending == 0
+                   and (rank != 0 or len(staged) == len(jobs)))
+        if host.round(done=my_done):
+            break
+    else:
+        raise RuntimeError("round loop never reached joint done")
+
+    eng.check_paged()
+    spans = eng.metrics()["spans"]
+    result = {
+        "rank": rank,
+        "stats": dict(eng.stats),
+        "spans": sorted(spans),
+        "flight_dumps": eng.flight.dumps,
+        "parity_ok": True,
+        "n_admitted": len(admitted),
+    }
+    if rank == 1:
+        # the receiver proves bit-exactness locally: same params, so
+        # generate() here is the uninterrupted colocated reference
+        for r in admitted:
+            want = np.asarray(generate(
+                model, params,
+                np.asarray(r.prompt, np.int32)[None],
+                r.max_new_tokens))[0, r.prompt.size:]
+            if list(want) != list(r.tokens) or not r.ok:
+                result["parity_ok"] = False
+        result["quarantined"] = int(
+            eng.stats.get("quarantined_transfers", 0))
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

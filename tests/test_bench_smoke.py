@@ -859,6 +859,75 @@ def test_serve_tenancy_gap_gate(tmp_path):
     assert serve_tenancy_missing(d) == [1]  # banked history row counts
 
 
+def test_serve_disagg_bench_row_parses():
+    """The serve_disagg stage's CPU smoke (tier-1's guard on the
+    two-process prefill/decode split the TPU watcher resumes): rank 0
+    must prefill and ship every request's pages, rank 1 must adopt and
+    decode them bit-identically to the colocated baseline (parity_ok +
+    split_ok), both processes must end empty and leak-free, and the
+    TTFT/decode-gap gates vs the colocated percentiles must hold at
+    their documented CPU-smoke bounds.  Trimmed workload: the contract
+    under test is the handoff protocol, not throughput."""
+    proc = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu",
+        "SERVE_DISAGG": "0",
+        "DISAGG_REQUESTS": "4", "DISAGG_BURST": "2",
+    })
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    byseed = {r["seed"]: r for r in rows
+              if r.get("metric") == "serve_disagg" and "seed" in r}
+    assert set(byseed) == {0}, proc.stderr[-800:]
+    r = byseed[0]
+    assert "error" not in r, r
+    assert r["value"] > 0                      # pages actually moved
+    assert r["parity_ok"] is True              # bit-exact vs colocated
+    assert r["split_ok"] is True               # all jobs crossed hosts
+    assert r["no_leak"] is True
+    assert r["ttft_ok"] is True and r["p99_ok"] is True
+    assert r["migrated"] == r["requests"] + r["burst"] == 6
+    assert r["migrated_pages"] >= r["migrated"]
+    # unregistered seeds fail fast, like the soak's seed registry
+    bad = _run("benchmarks/serve_bench.py", {
+        "SERVE_PLATFORM": "cpu", "SERVE_DISAGG": "9",
+        "SERVE_STRICT_LEVELS": "1"}, timeout=300)
+    assert bad.returncode != 0
+    assert "disagg seeds" in (bad.stderr + bad.stdout)
+
+
+def test_serve_disagg_gap_gate(tmp_path):
+    """tools/bench_gaps serve_disagg stage: error rows, split-incomplete
+    rows, parity-broken rows, leaking rows, and latency-blown rows never
+    close a seed; passing rows do — INCLUDING on device_kind=cpu,
+    because unlike every other serve stage the two ranks are CPU
+    processes by construction (two processes cannot share one libtpu)
+    and the handoff protocol is platform-independent."""
+    from tools.bench_gaps import SERVE_DISAGG_SEEDS, serve_disagg_missing
+
+    d = str(tmp_path)
+    assert serve_disagg_missing(d) == list(SERVE_DISAGG_SEEDS)
+    ok = {"metric": "serve_disagg", "value": 9043.2, "split_ok": True,
+          "parity_ok": True, "no_leak": True, "ttft_ok": True,
+          "p99_ok": True, "device_kind": "cpu"}
+    rows = [
+        {"metric": "serve_disagg", "seed": 0,
+         "error": "worker died"},                    # error: no
+        {**ok, "seed": 1, "split_ok": False},        # split short: no
+        {**ok, "seed": 1, "parity_ok": False},       # parity broken: no
+        {**ok, "seed": 2, "no_leak": False},         # leak: no
+        {**ok, "seed": 2, "ttft_ok": False},         # ttft blown: no
+        {**ok, "seed": 2, "p99_ok": False},          # p99 blown: no
+        {**ok, "seed": 0},                           # cpu pass: YES
+    ]
+    with open(os.path.join(d, "serve_disagg.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert serve_disagg_missing(d) == [1, 2]
+    with open(os.path.join(d, "serve_disagg.history.jsonl"), "w") as f:
+        f.write(json.dumps({**ok, "seed": 1}) + "\n")
+    assert serve_disagg_missing(d) == [2]  # banked history row counts
+
+
 def test_train_soak_bench_row_parses():
     """The train_soak stage's CPU smoke (tier-1's guard on the kill/
     resume soak the TPU watcher resumes): a reduced 1-kill plan (loader

@@ -1,0 +1,795 @@
+"""Disaggregated serving (tpudp/serve/disagg.py): cross-host KV page
+migration, decode-host failover, rebalancing, and the verified
+transfer protocol.
+
+The contract, layer by layer:
+
+  1. WIRE — ``pack_batch``/``unpack_batch`` round-trip every ticket
+     field bit-exactly; torn framing and flipped payload bytes both
+     raise :class:`TransferCorrupt` (never a silent wrong array).
+  2. BIT-IDENTITY — a migrated request's continuation is bit-identical
+     to never migrating: ``export_ticket``/``admit_ticket`` carry the
+     vacate/resume state (tokens + per-slot PRNG chain + prefix
+     pages), so greedy AND sampled outputs match ``generate()`` and a
+     colocated run, through double migrations, fused decode windows,
+     speculation, failover, and wire faults.
+  3. ACCOUNTING — migrations are distinct from preemptions and from
+     page-pressure vacates at the engine-stats, tenant-stats and
+     handle levels; ``FinishReason`` never grows a user-visible
+     MIGRATED value.
+  4. NO LEAKS, NO WEDGES — ``check_paged()`` holds on every surviving
+     host after every scenario; every fault injector run completes
+     within the tick bound.
+  5. VERIFIED PROTOCOL — disagg.py is in ``PROTOCOL_MODULES`` and
+     verifies with zero findings; re-introducing an early exit in the
+     quarantine arm of :meth:`DisaggHost.round` fails the verifier BY
+     RULE NAME; the migration model checker proves the extracted
+     quarantine/release/fallback discipline orphan-, wedge- and
+     leak-free, and catches each property's deletion.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpudp.analysis.protocol import (MigrationSpec, PROTOCOL_MODULES,
+                                     explore_migration_machine,
+                                     extract_migration_spec,
+                                     verify_paths)
+from tpudp.models.generate import generate
+from tpudp.models.gpt2 import gpt2_small
+from tpudp.serve import (DisaggCluster, Engine, FinishReason,
+                         MigrationFailed, NgramDrafter, TenantClass,
+                         TransferCorrupt)
+from tpudp.serve.disagg import (MigrationTicket, corrupt_page_bytes,
+                                pack_batch, unpack_batch)
+from tpudp.serve.faults import (CorruptPagePayload, DroppedTransfer,
+                                SenderKilledMidOffer, SlowLink)
+from tpudp.train import init_state, make_optimizer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(vocab_size=61, max_seq_len=96, num_layers=2, num_heads=2,
+            d_model=32)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = gpt2_small(**TINY)
+    state = init_state(model, make_optimizer(), input_shape=(1, 8))
+    return model, state.params
+
+
+def _reference(model, params, prompt, n):
+    import jax.numpy as jnp
+
+    return np.asarray(generate(model, params, jnp.asarray(prompt[None]),
+                               n))[0, prompt.size:]
+
+
+def _assert_parity(model, params, prompt, n, handle):
+    np.testing.assert_array_equal(_reference(model, params, prompt, n),
+                                  np.asarray(handle.tokens))
+
+
+# ---------------------------------------------------------------------------
+# Wire format (no engine, no device work)
+# ---------------------------------------------------------------------------
+
+
+def _ticket(rid=7, pages=(), resume=True):
+    rng = np.random.default_rng(rid)
+    return MigrationTicket(
+        rid=rid, model=None,
+        prompt=rng.integers(0, 61, size=11).astype(np.int32),
+        tokens=(3, 1, 4), max_new_tokens=8, temperature=0.8, top_k=5,
+        top_p=0.9, seed=42, eos_id=None, deadline_s=None, tenant=None,
+        migrations=1, preemptions=2, draft_proposed=3, draft_accepted=1,
+        resume_key=(rng.integers(0, 2**31, size=2).astype(np.uint32)
+                    if resume else None),
+        page_tokens=8, pages=tuple(pages))
+
+
+def test_pack_unpack_roundtrip_bit_exact():
+    page = {"k": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+            "v": np.ones((2, 3, 4), np.float32) * 0.5}
+    t = _ticket(pages=[page, page])
+    blob = pack_batch([(2, t)], seq=5, src=1)
+    seq, src, out = unpack_batch(blob)
+    assert (seq, src) == (5, 1)
+    [(dest, t2)] = out
+    assert dest == 2 and t2.rid == t.rid
+    np.testing.assert_array_equal(t2.prompt, t.prompt)
+    np.testing.assert_array_equal(t2.resume_key, t.resume_key)
+    assert t2.tokens == t.tokens
+    assert (t2.migrations, t2.preemptions) == (1, 2)
+    assert (t2.draft_proposed, t2.draft_accepted) == (3, 1)
+    assert len(t2.pages) == 2
+    for name in ("k", "v"):
+        np.testing.assert_array_equal(t2.pages[0][name], page[name])
+    # a pageless, keyless ticket (the failover shape) round-trips too
+    blob2 = pack_batch([(0, _ticket(rid=9, resume=False))], seq=0, src=2)
+    _, _, [(_, t3)] = unpack_batch(blob2)
+    assert t3.resume_key is None and t3.pages == ()
+
+
+def test_unpack_rejects_torn_and_corrupt():
+    blob = pack_batch([(1, _ticket())], seq=0, src=0)
+    for bad in (blob[: len(blob) // 2],        # truncated mid-body
+                b"XXXX" + blob[4:],            # wrong magic
+                blob[:4] + (99).to_bytes(2, "big") + blob[6:],  # version
+                blob[:-1] + bytes([blob[-1] ^ 0xFF]),  # flipped byte
+                b""):
+        with pytest.raises(TransferCorrupt):
+            unpack_batch(bad)
+
+
+def test_corrupt_page_bytes_passes_framing_fails_page_crc():
+    page = {"k": np.zeros((2, 2), np.float32)}
+    blob = pack_batch([(1, _ticket(pages=[page]))], seq=0, src=0)
+    evil = corrupt_page_bytes(blob)
+    # the outer framing was re-stamped: the failure is a PAGE crc, the
+    # localized "bit flip on the wire" the receiver must quarantine
+    with pytest.raises(TransferCorrupt, match="payload crc"):
+        unpack_batch(evil)
+    with pytest.raises(ValueError, match="no payload"):
+        # a blob with no arrays staged has nothing to corrupt
+        corrupt_page_bytes(pack_batch([], seq=0, src=0))
+
+
+# ---------------------------------------------------------------------------
+# Engine-level export/admit: bit-exact cross-engine continuation
+# ---------------------------------------------------------------------------
+
+
+def _paged(model, params, **kw):
+    base = dict(num_slots=2, max_len=64, prefill_chunk=8, kv_pages=16)
+    base.update(kw)
+    return Engine(model, params, **base)
+
+
+def test_export_admit_midstream_parity_and_accounting(model_and_params):
+    """The tentpole oracle at engine level: export a mid-decode paged
+    request (pages + PRNG chain in the ticket), admit it on a second
+    engine, and the continuation is bit-identical to generate();
+    pages adopted, both pools leak-free, accounting on both sides."""
+    model, params = model_and_params
+    rng = np.random.default_rng(21)
+    prompt = rng.integers(0, 61, size=19).astype(np.int32)
+    a = _paged(model, params)
+    b = _paged(model, params)
+    h = a.submit(prompt, 8)
+    for _ in range(4):
+        a.step()
+    assert h.tokens and not h.done   # genuinely mid-stream
+    ticket = a.export_ticket(h)
+    assert ticket.pages, "a mid-decode slot must export prefix pages"
+    assert h.finish_reason is None   # detached, NOT finished
+    h2 = b.admit_ticket(ticket)
+    b.run_until_complete()
+    _assert_parity(model, params, prompt, 8, h2)
+    assert h2.migrations == 1 and h2.preemptions == 0
+    assert a.stats["migrated_out"] == 1 and "migrated_in" not in a.stats
+    assert b.stats["migrated_in"] == 1
+    assert b.stats["migrated_in_pages"] == len(ticket.pages)
+    a.run_until_complete()
+    a.check_paged()
+    b.check_paged()
+
+
+def test_export_admit_sampled_parity(model_and_params):
+    """Sampled continuation: the per-slot PRNG chain rides the ticket,
+    so the migrated request emits the exact token sequence the
+    colocated run emits — same seed, same chain, different host."""
+    model, params = model_and_params
+    rng = np.random.default_rng(22)
+    prompt = rng.integers(0, 61, size=13).astype(np.int32)
+    kw = dict(temperature=0.8, top_k=7, seed=123)
+    ref = _paged(model, params)
+    hr = ref.submit(prompt, 8, **kw)
+    ref.run_until_complete()
+    a, b = _paged(model, params), _paged(model, params)
+    h = a.submit(prompt, 8, **kw)
+    for _ in range(4):
+        a.step()
+    h2 = b.admit_ticket(a.export_ticket(h))
+    b.run_until_complete()
+    assert h2.tokens == hr.tokens
+    a.check_paged()
+    b.check_paged()
+
+
+def test_export_queued_request_is_tokens_only(model_and_params):
+    """A request exported before admission carries no pages and no
+    chain — nothing prefilled yet — and still continues bit-exactly."""
+    model, params = model_and_params
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, 61, size=9).astype(np.int32)
+    a, b = _paged(model, params, num_slots=1), _paged(model, params)
+    a.submit(rng.integers(0, 61, size=9).astype(np.int32), 4)
+    h = a.submit(prompt, 6)          # queued behind the only slot
+    ticket = a.export_ticket(h)
+    assert ticket.pages == () and ticket.resume_key is None
+    assert ticket.tokens == ()
+    h2 = b.admit_ticket(ticket)
+    b.run_until_complete()
+    _assert_parity(model, params, prompt, 6, h2)
+    a.run_until_complete()
+    a.check_paged()
+    b.check_paged()
+
+
+def test_export_finished_and_geometry_mismatch_raise(model_and_params):
+    model, params = model_and_params
+    rng = np.random.default_rng(24)
+    prompt = rng.integers(0, 61, size=9).astype(np.int32)
+    a = _paged(model, params)
+    h = a.submit(prompt, 4)
+    a.run_until_complete()
+    with pytest.raises(ValueError, match="already finished"):
+        a.export_ticket(h)
+    h2 = a.submit(prompt, 6)
+    a.step()
+    ticket = a.export_ticket(h2)
+    # receiver with a DIFFERENT chunk size must refuse the pages
+    c = _paged(model, params, prefill_chunk=4, max_len=48, kv_pages=12)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        c.admit_ticket(ticket)
+    # ...and an over-long continuation must refuse outright
+    small = _paged(model, params, max_len=12, kv_pages=4)
+    with pytest.raises(ValueError, match="max_len"):
+        small.admit_ticket(ticket)
+    a.check_paged()
+
+
+def test_finish_reason_never_grows_migrated(model_and_params):
+    """Pin the USER-VISIBLE failure vocabulary: migration is carried in
+    stats and ``Request.migrations``, never as a finish reason — a
+    migrated request's handle stays unfinished until a terminal reason
+    lands on the destination host."""
+    assert {m.value for m in FinishReason} == {
+        "complete", "eos", "cancelled", "deadline", "error", "shed",
+        "preempted"}
+    model, params = model_and_params
+    rng = np.random.default_rng(25)
+    a, b = _paged(model, params), _paged(model, params)
+    h = a.submit(rng.integers(0, 61, size=9).astype(np.int32), 6)
+    a.step()
+    t = a.export_ticket(h)
+    assert h.finish_reason is None and not h.done
+    h2 = b.admit_ticket(t)
+    b.run_until_complete()
+    assert h2.finish_reason is FinishReason.COMPLETE
+
+
+def test_migration_distinct_from_pressure_and_preemption(
+        model_and_params):
+    """The three slot-leaving paths stay separately accounted at the
+    engine, tenant and handle levels: a run with page-pressure vacates
+    has zero migrations; a migration bumps neither ``preemptions`` nor
+    ``page_pressure_vacates``; tenant counters mirror both."""
+    model, params = model_and_params
+    rng = np.random.default_rng(26)
+    # pressure-only run (test_paged's geometry: pool fits one request)
+    prompts = [rng.integers(0, 61, size=9 + 3 * i).astype(np.int32)
+               for i in range(5)]
+    eng = Engine(model, params, num_slots=3, max_len=48,
+                 prefill_chunk=8, kv_pages=6)
+    handles = [eng.submit(p, 6) for p in prompts]
+    eng.run_until_complete()
+    assert eng.stats["page_pressure_vacates"] > 0
+    assert "migrated_out" not in eng.stats
+    assert "migrated_in" not in eng.stats
+    assert all(h.migrations == 0 for h in handles)
+    eng.check_paged()
+    # migration run, tenant-aware on both ends
+    tenants = {"default": TenantClass(priority=0)}
+    a = _paged(model, params, tenants=tenants)
+    b = _paged(model, params, tenants=tenants)
+    h = a.submit(rng.integers(0, 61, size=11).astype(np.int32), 6)
+    a.step()
+    h2 = b.admit_ticket(a.export_ticket(h))
+    b.run_until_complete()
+    assert h2.migrations == 1 and h2.preemptions == 0
+    assert a.stats["migrated_out"] == 1
+    assert a.stats.get("page_pressure_vacates", 0) == 0
+    assert a.stats.get("preempted", 0) == 0
+    assert a.tenant_stats["default"]["migrated_out"] == 1
+    assert b.tenant_stats["default"]["migrated_in"] == 1
+    assert "page_pressure_vacates" not in b.tenant_stats["default"]
+
+
+# ---------------------------------------------------------------------------
+# Edge races
+# ---------------------------------------------------------------------------
+
+
+def test_migrate_vs_cancel_race(model_and_params):
+    """Cancel of a migrated-out handle is NOT the old crash/mis-remove:
+    the source engine declines it (returns False — the handle is a
+    ticket's now), and the cluster-level cancel wins the race whenever
+    it lands: applied locally if the request is resident, applied at
+    admission if the ticket is mid-flight."""
+    model, params = model_and_params
+    rng = np.random.default_rng(27)
+    a, b = _paged(model, params), _paged(model, params)
+    h = a.submit(rng.integers(0, 61, size=11).astype(np.int32), 6)
+    a.step()
+    t = a.export_ticket(h)
+    assert a.cancel(h) is False      # detached: not this engine's
+    assert h.finish_reason is None
+    h2 = b.admit_ticket(t)
+    assert b.cancel(h2) is True      # the receiver owns it now
+    assert h2.finish_reason is FinishReason.CANCELLED
+    b.run_until_complete()
+    a.run_until_complete()
+    a.check_paged()
+    b.check_paged()
+    # cluster level: cancel fired while the ticket is in flight lands
+    # at admission — the request finishes CANCELLED, never completes
+    engines = [_paged(model, params) for _ in range(2)]
+    cl = DisaggCluster(engines, prefill=0)
+    creq = cl.submit(rng.integers(0, 61, size=9).astype(np.int32), 16)
+    while creq.host == 0 and not creq.done:
+        cl.tick()                    # wait out the automatic handoff
+    assert creq.host == 1 and not creq.done
+    t = cl.hosts[1].stage(0, creq.handle)   # send it back, manually
+    cl._by_key[(1, t.rid)] = creq
+    assert creq.cancel() is True     # mid-flight: recorded
+    assert creq.cancel_pending
+    cl.run_until_complete()
+    assert creq.finish_reason is FinishReason.CANCELLED
+    assert len(creq.tokens) < 16
+    cl.check()
+
+
+def test_migrate_mid_fused_window_parity(model_and_params):
+    """With ``decode_fuse > 1`` the export lands on a window edge by
+    construction (the scheduler only yields between committed windows);
+    the carried chain is the post-window chain, so the continuation
+    stays bit-exact through a fused receiver too."""
+    model, params = model_and_params
+    rng = np.random.default_rng(28)
+    prompt = rng.integers(0, 61, size=9).astype(np.int32)
+    a = _paged(model, params, max_len=48, decode_fuse=4, kv_pages=12)
+    b = _paged(model, params, max_len=48, decode_fuse=4, kv_pages=12)
+    h = a.submit(prompt, 6)
+    for _ in range(2):
+        a.step()
+    assert a.stats.get("fused_windows", 0) > 0
+    h2 = b.admit_ticket(a.export_ticket(h))
+    b.run_until_complete()
+    _assert_parity(model, params, prompt, 6, h2)
+    a.check_paged()
+    b.check_paged()
+
+
+def test_migrate_speculating_slot_parity(model_and_params):
+    """A speculating slot migrates mid-stream with its draft counters
+    in the ticket; draft KV never needs to travel (unaccepted draft
+    state is scratch by design) and the greedy continuation matches
+    generate() on a speculating receiver."""
+    model, params = model_and_params
+    rng = np.random.default_rng(29)
+    prompt = np.tile(rng.integers(0, 61, size=4), 8)[:26].astype(
+        np.int32)   # repetitive: the n-gram drafter locks on
+    mk = lambda: _paged(model, params, speculate_k=2,  # noqa: E731
+                        drafter=NgramDrafter())
+    a, b = mk(), mk()
+    h = a.submit(prompt, 8)
+    for _ in range(4):
+        a.step()
+    assert h.tokens and not h.done
+    t = a.export_ticket(h)
+    assert t.draft_proposed >= 0
+    h2 = b.admit_ticket(t)
+    b.run_until_complete()
+    _assert_parity(model, params, prompt, 8, h2)
+    assert h2.draft_proposed >= t.draft_proposed
+    a.check_paged()
+    b.check_paged()
+
+
+def test_double_migration_parity(model_and_params):
+    """A -> B -> C: two hops, each mid-stream, still bit-exact; the
+    handle's ``migrations`` counts both."""
+    model, params = model_and_params
+    rng = np.random.default_rng(30)
+    prompt = rng.integers(0, 61, size=17).astype(np.int32)
+    a, b, c = (_paged(model, params) for _ in range(3))
+    h = a.submit(prompt, 9)
+    for _ in range(4):
+        a.step()
+    hb = b.admit_ticket(a.export_ticket(h))
+    for _ in range(2):
+        b.step()
+    hc = c.admit_ticket(b.export_ticket(hb))
+    c.run_until_complete()
+    _assert_parity(model, params, prompt, 9, hc)
+    assert hc.migrations == 2
+    assert (a.stats["migrated_out"], b.stats["migrated_out"]) == (1, 1)
+    assert (b.stats["migrated_in"], c.stats["migrated_in"]) == (1, 1)
+    for e in (a, b, c):
+        e.run_until_complete()
+        e.check_paged()
+
+
+def test_migrate_with_shared_prefix_refs(model_and_params):
+    """Export while the prefix tree and a SIBLING slot still hold refs
+    on the departing request's prefix pages: the export reads page
+    payloads without touching refcounts, the vacate releases only the
+    leaver's refs, the sibling finishes bit-exactly, and both pools
+    pass check_paged()."""
+    model, params = model_and_params
+    rng = np.random.default_rng(31)
+    shared = rng.integers(0, 61, size=24).astype(np.int32)
+    pa = np.concatenate([shared,
+                         rng.integers(0, 61, size=3).astype(np.int32)])
+    pb = np.concatenate([shared,
+                         rng.integers(0, 61, size=5).astype(np.int32)])
+    a = _paged(model, params, kv_pages=24)
+    b = _paged(model, params, kv_pages=24)
+    warm = a.submit(np.concatenate(
+        [shared, rng.integers(0, 61, size=1).astype(np.int32)]), 2)
+    a.run_until_complete()          # prefix now cached in the tree
+    ha = a.submit(pa, 8)
+    hb = a.submit(pb, 8)
+    for _ in range(4):
+        a.step()
+    a.check_paged()
+    h2 = b.admit_ticket(a.export_ticket(ha))   # leave while shared
+    a.check_paged()                 # sibling + tree refs intact
+    a.run_until_complete()
+    b.run_until_complete()
+    _assert_parity(model, params, pa, 8, h2)
+    _assert_parity(model, params, pb, 8, hb)
+    _assert_parity(model, params, warm.prompt, 2, warm)
+    a.check_paged()
+    b.check_paged()
+
+
+# ---------------------------------------------------------------------------
+# Cluster: handoff, failover, rebalance, wire faults
+# ---------------------------------------------------------------------------
+
+
+def _colocated(model, params, jobs):
+    eng = _paged(model, params, num_slots=8, kv_pages=24)
+    handles = [eng.submit(p, n, **kw) for p, n, kw in jobs]
+    eng.run_until_complete()
+    eng.check_paged()
+    return [list(h.tokens) for h in handles]
+
+
+def _cluster_jobs(rng, n=4):
+    jobs = []
+    for i in range(n):
+        kw = {} if i % 2 == 0 else dict(temperature=0.8, top_k=7,
+                                        seed=100 + i)
+        jobs.append((rng.integers(0, 61, size=9 + 2 * i)
+                     .astype(np.int32), 6 + i % 3, kw))
+    return jobs
+
+
+def test_cluster_handoff_bit_exact_vs_colocated(model_and_params):
+    """The disaggregated arena's baseline oracle: prefill-host
+    admission, first token, handoff to a decode host, completion —
+    outputs bit-identical to one colocated engine, greedy and
+    sampled, with every handoff accounted."""
+    model, params = model_and_params
+    rng = np.random.default_rng(32)
+    jobs = _cluster_jobs(rng)
+    want = _colocated(model, params, jobs)
+    engines = [_paged(model, params, num_slots=4, kv_pages=24)
+               for _ in range(3)]
+    cl = DisaggCluster(engines, prefill=0)
+    creqs = [cl.submit(p, n, **kw) for p, n, kw in jobs]
+    cl.run_until_complete()
+    assert [c.tokens for c in creqs] == want
+    handoffs = [e for e in cl.events if e["kind"] == "handoff"]
+    assert len(handoffs) == len(jobs)
+    assert all(c.host != cl.prefill for c in creqs)
+    assert cl.hosts[0].engine.stats["migrated_out"] == len(jobs)
+    cl.check()
+
+
+def test_cluster_failover_kill_decode_host_bit_exact(model_and_params):
+    """THE acceptance soak at tier-1 scale: SIGKILL a decode host
+    mid-stream; the survivors vote, redistribute its journaled slots,
+    and every request — greedy and sampled — finishes BIT-IDENTICAL
+    to the uninterrupted colocated run; every failover is accounted;
+    surviving pools leak-free."""
+    model, params = model_and_params
+    rng = np.random.default_rng(33)
+    jobs = _cluster_jobs(rng, n=4)
+    want = _colocated(model, params, jobs)
+    engines = [_paged(model, params, num_slots=4, kv_pages=24)
+               for _ in range(3)]
+    cl = DisaggCluster(engines, prefill=0)
+    creqs = [cl.submit(p, n, **kw) for p, n, kw in jobs]
+    while not any(c.host == 2 and c.tokens and not c.done
+                  for c in creqs):
+        cl.tick()                    # host 2 owns live mid-stream work
+    victims = [c for c in creqs if c.host == 2 and not c.done]
+    moved = cl.kill_host(2)
+    assert set(moved) == set(victims) and all(
+        c.failovers == 1 and c.host != 2 for c in victims)
+    cl.run_until_complete()
+    assert [c.tokens for c in creqs] == want
+    fo = [e for e in cl.events if e["kind"] == "failover"]
+    assert {e["rid"] for e in fo} == {c.handle.id for c in victims} or \
+        len(fo) == len(victims)
+    assert sum(h.engine.stats.get("failover_resumes", 0)
+               for h in cl.live_hosts()) == len(victims)
+    cl.check()
+
+
+@pytest.mark.parametrize("fault_name", ["dropped", "corrupt", "slow",
+                                        "sender_killed"])
+def test_cluster_transfer_faults_no_wedge_no_leak(model_and_params,
+                                                  fault_name):
+    """The satellite fault matrix: each wire fault fires at least
+    once, nothing wedges (bounded ticks), outputs stay bit-identical
+    to the colocated run, pools on every SURVIVING host pass
+    check_paged(), and the fault's signature lands in stats."""
+    model, params = model_and_params
+    rng = np.random.default_rng(34)
+    jobs = _cluster_jobs(rng, n=3)
+    want = _colocated(model, params, jobs)
+    faults = {
+        "dropped": DroppedTransfer(rank=0, at_seqs=range(0, 40)),
+        "corrupt": CorruptPagePayload(rank=0, at_seqs=range(0, 3)),
+        "slow": SlowLink(delay_s=0.001, rank=0),
+        "sender_killed": SenderKilledMidOffer(rank=2, at_seq=2),
+    }
+    fault = faults[fault_name]
+    engines = [_paged(model, params, num_slots=4, kv_pages=24)
+               for _ in range(3)]
+    # dropped: every handoff transfer from the prefill host is eaten
+    # for 40 rounds -> retries exhaust -> LOCAL fallback completes the
+    # work on host 0 (decode hosts idle).  The others migrate.
+    cl = DisaggCluster(engines, prefill=0, retries=1,
+                       faults=(fault,))
+    creqs = [cl.submit(p, n, **kw) for p, n, kw in jobs]
+    cl.run_until_complete(max_ticks=3000)
+    assert [c.tokens for c in creqs] == want
+    assert fault.fired
+    stats = {h.rank: h.engine.stats for h in cl.hosts}
+    if fault_name == "dropped":
+        assert stats[0]["migration_retries"] > 0
+        assert stats[0]["migration_failed"] > 0
+        assert cl.hosts[0].failures and isinstance(
+            cl.hosts[0].failures[0], MigrationFailed)
+    if fault_name == "corrupt":
+        assert sum(s.get("quarantined_transfers", 0)
+                   for s in stats.values()) > 0
+    if fault_name == "sender_killed":
+        assert cl.dead == {2}
+        assert any(e["kind"] == "failover" for e in cl.events) or not [
+            c for c in creqs if c.failovers]
+    cl.check()
+
+
+def test_cluster_rebalance_drains_hot_host(model_and_params):
+    """Cross-host rebalancing: a pressure-hot decode host migrates its
+    most-recently-admitted slots to the freest peer; moves are
+    recorded, outputs stay bit-exact, and accounting distinguishes
+    these migrations from local pressure vacates."""
+    model, params = model_and_params
+    rng = np.random.default_rng(35)
+    jobs = _cluster_jobs(rng, n=4)
+    want = _colocated(model, params, jobs)
+    engines = [_paged(model, params, num_slots=4, kv_pages=24)
+               for _ in range(3)]
+    cl = DisaggCluster(engines, prefill=0)
+    creqs = [cl.submit(p, n, **kw) for p, n, kw in jobs]
+    while not any(c.host in (1, 2) and not c.done
+                  and c.handle._slot is not None for c in creqs):
+        cl.tick()                    # a victim is SLOTTED on a decode
+    moves = cl.rebalance(free_page_frac=1.1, max_moves=1)
+    assert moves and all(m["ok"] for m in moves)
+    assert {m["kind"] for m in moves} == {"rebalance"}
+    assert all(m["from"] != m["to"] and m["from"] != cl.prefill
+               for m in moves)
+    cl.run_until_complete()
+    assert [c.tokens for c in creqs] == want
+    # a rebalanced request migrated at least twice: handoff + drain
+    assert any(c.migrations >= 2 for c in creqs)
+    assert all(e["kind"] != "failover" for e in cl.events)
+    cl.check()
+
+
+def test_cluster_migrate_failure_typed_and_falls_back(model_and_params):
+    """A dead link: every transfer dropped.  ``migrate`` raises the
+    TYPED MigrationFailed only after the request is safely re-admitted
+    locally; ``rebalance`` absorbs the same failure as an ok=False
+    move; the request completes bit-exactly either way."""
+    model, params = model_and_params
+    rng = np.random.default_rng(36)
+    prompt = rng.integers(0, 61, size=11).astype(np.int32)
+    want = _colocated(model, params, [(prompt, 6, {})])[0]
+    engines = [_paged(model, params, num_slots=4, kv_pages=24)
+               for _ in range(3)]
+    cl = DisaggCluster(engines, prefill=0, retries=1,
+                       faults=(DroppedTransfer(rank=1,
+                                               at_seqs=range(0, 200)),))
+    creq = cl.submit(prompt, 6)
+    while creq.host != 1 or creq.done:
+        cl.tick()                    # handoff 0->1 is NOT rank-1-sent
+        if creq.done:
+            break
+    assert creq.host == 1 and not creq.done
+    with pytest.raises(MigrationFailed) as ei:
+        cl.migrate(creq, 2)          # rank 1's sends all drop
+    assert ei.value.dest == 2 and ei.value.attempts >= 2
+    assert creq.host == 1            # local fallback re-admitted it
+    stats = cl.hosts[1].engine.stats
+    assert stats["migration_failed"] >= 1
+    # handoff admit + the local-fallback re-admit, one failed export
+    assert stats["migrated_in"] == 2 and stats["migrated_out"] == 1
+    cl.run_until_complete()
+    assert creq.tokens == want
+    cl.check()
+    with pytest.raises(ValueError, match="already lives"):
+        cl.migrate(creq if not creq.done else creq, creq.host)
+
+
+def test_cluster_guards(model_and_params):
+    model, params = model_and_params
+    engines = [_paged(model, params) for _ in range(2)]
+    cl = DisaggCluster(engines, prefill=0)
+    with pytest.raises(ValueError, match="prefill host"):
+        cl.kill_host(0)
+    with pytest.raises(ValueError, match=">= 2 engines"):
+        DisaggCluster([engines[0]])
+    cl.kill_host(1)
+    with pytest.raises(ValueError, match="dead"):
+        cl.migrate(cl.submit(np.arange(5, dtype=np.int32), 2), 1)
+
+
+# ---------------------------------------------------------------------------
+# Verified protocol: scope, zero findings, mutation, model checker
+# ---------------------------------------------------------------------------
+
+MARKER = "# tpudp: protocol-module\n"
+DISAGG = os.path.join("tpudp", "serve", "disagg.py")
+SEAM = os.path.join("tpudp", "utils", "checkpoint.py")
+
+
+def test_disagg_is_a_protocol_module_and_verifies_clean():
+    assert DISAGG.replace(os.sep, "/") in PROTOCOL_MODULES
+    findings, errors = verify_paths([DISAGG, SEAM], ROOT)
+    assert not errors, errors
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_mutation_quarantine_early_exit_fails_by_rule_name(tmp_path):
+    """THE acceptance mutation: re-introduce an early exit in the
+    adopt-ack quarantine arm of ``DisaggHost.round`` — the receiver
+    bails out of the round on a corrupt transfer, stranding the sender
+    at the ack gather.  The verifier must fail naming
+    protocol-early-exit at the mutated line."""
+    src = open(os.path.join(ROOT, DISAGG)).read()
+    old = "self._quarantine(src, b, exc)"
+    assert old in src, "quarantine spelling drifted — update the test"
+    mutated = MARKER + src.replace(old, "return False", 1)
+    p = tmp_path / "disagg_mutant.py"
+    p.write_text(mutated)
+    findings, errors = verify_paths([str(p), SEAM], ROOT)
+    assert not errors, errors
+    rules = {f.rule for f in findings}
+    assert "protocol-early-exit" in rules, \
+        [f.render() for f in findings]
+    want_line = next(i + 1 for i, line in
+                     enumerate(mutated.splitlines())
+                     if line.strip() == "return False")
+    hits = [f for f in findings if f.rule == "protocol-early-exit"]
+    assert any(f.line == want_line for f in hits), \
+        [(f.rule, f.line) for f in findings]
+    # control: the unmutated copy is clean
+    q = tmp_path / "disagg_ctl.py"
+    q.write_text(MARKER + src)
+    findings2, errors2 = verify_paths([str(q), SEAM], ROOT)
+    assert not errors2 and findings2 == [], \
+        [f.render() for f in findings2]
+
+
+def test_migration_model_checker_live_source_clean():
+    """The spec extracted from the LIVE disagg source has all three
+    load-bearing properties and explores orphan/wedge/leak-free."""
+    src = open(os.path.join(ROOT, DISAGG)).read()
+    spec = extract_migration_spec(src)
+    assert spec.quarantine_acks and spec.release_on_ack
+    assert spec.fallback_local
+    result = explore_migration_machine(spec)
+    assert result["violations"] == [], result["violations"][:3]
+    assert result["states"] > 5
+
+
+def test_migration_model_checker_catches_each_deletion():
+    """Deleting any one property from the spec produces its NAMED
+    violation — and the quarantine deletion is caught END TO END from
+    mutated source (extraction sees the raise, exploration reports the
+    orphaned rendezvous)."""
+    src = open(os.path.join(ROOT, DISAGG)).read()
+    mutated = src.replace("self._quarantine(src, b, exc)", "raise", 1)
+    spec = extract_migration_spec(mutated)
+    assert spec.quarantine_acks is False
+    kinds = {v["kind"]
+             for v in explore_migration_machine(spec)["violations"]}
+    assert "orphaned-rendezvous" in kinds
+    base = extract_migration_spec(src)
+    for flip, want in (("release_on_ack", "page-leak"),
+                       ("fallback_local", "wedge")):
+        bad = MigrationSpec(**{**base.__dict__, flip: False})
+        kinds = {v["kind"]
+                 for v in explore_migration_machine(bad)["violations"]}
+        assert want in kinds, (flip, kinds)
+    # and dropping the fallback is visible from source too
+    no_fb = src.replace("r = self.engine.admit_ticket(p.ticket)",
+                        "r = None", 1)
+    assert extract_migration_spec(no_fb).fallback_local is False
+
+
+# ---------------------------------------------------------------------------
+# Two real OS processes: DisaggHost.round over jax.distributed (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_two_process_round_quarantine_and_parity(tmp_path):
+    """The handshake over the REAL collective seam: two processes
+    rendezvous via jax.distributed; rank 0 prefills and stages, rank 1
+    decodes.  Rank 0's first transfer is bit-flipped on the wire —
+    rank 1 quarantines it (fault-triggered flight dump on the
+    RECEIVER, offer/transfer/adopt spans recorded) without leaving the
+    round, the retry delivers, and the migrated continuations are
+    bit-identical to the local generate() reference."""
+    import glob
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "disagg_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    outs = [str(tmp_path / f"out{r}.json") for r in range(2)]
+    flights = [str(tmp_path / f"flight{r}") for r in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(r), "2", str(port), outs[r],
+         flights[r], "corrupt"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for r in range(2)]
+    texts = []
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=600)
+            texts.append(stdout)
+    finally:
+        for p in procs:
+            p.kill()
+    for p, text in zip(procs, texts):
+        assert p.returncode == 0, \
+            f"worker rc={p.returncode}:\n{text[-3000:]}"
+    import json as _json
+
+    r0, r1 = (_json.load(open(o)) for o in outs)
+    assert r1["parity_ok"] and r1["n_admitted"] == 2
+    assert r1["quarantined"] >= 1
+    assert r0["stats"]["migrated_out"] == 2
+    assert r0["stats"]["migration_retries"] >= 1
+    assert r1["stats"]["migrated_in"] == 2
+    # spans of every handshake phase, on both sides of the wire
+    assert {"migrate_offer_phase", "migrate_transfer"} <= set(
+        r0["spans"]) & set(r1["spans"])
+    assert "migrate_adopt" in r1["spans"]
+    # the fault-triggered dump landed on the RECEIVER, named
+    dumps = glob.glob(os.path.join(
+        flights[1], "flightrec-*transfer_quarantined*.json"))
+    assert dumps, os.listdir(flights[1]) if os.path.isdir(
+        flights[1]) else "no flight dir"
+    assert r1["flight_dumps"] >= 1 and r0["flight_dumps"] == 0

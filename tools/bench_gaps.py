@@ -148,6 +148,19 @@ SERVE_SOAK_SEEDS = (0, 1, 2)
 # surviving output was bit-exact (parity_ok), and the engine ended
 # empty (no_leak); same registry contract.
 SERVE_TENANCY_SEEDS = (0, 1, 2)
+# Disaggregated-serving seeds (serve_bench.py --disagg: two OS
+# processes — prefill host and decode host — driving the real
+# DisaggHost handshake over jax.distributed against a colocated
+# baseline on the same Poisson+burst mixed-tenant workload).  A seed
+# closes only on a row where every request actually split (split_ok),
+# outputs were bit-exact vs colocated (parity_ok), both processes
+# ended leak-free (no_leak), and TTFT/decode-gap p99 held within
+# their bounds (ttft_ok/p99_ok).  Like TRAIN_SOAK_MULTIHOST_SEEDS
+# there is NO real-TPU device gate: the two ranks are co-located CPU
+# processes by construction (two processes cannot share one host's
+# libtpu), and what the row certifies — the handoff protocol and its
+# per-page cost — is platform-independent.
+SERVE_DISAGG_SEEDS = (0, 1, 2)
 # Kill/resume soak seeds for the TRAINING resilience layer
 # (benchmarks/resilience_bench.py: SIGKILL + relaunch, injected NaN/
 # spike/stall/step-raise/loader faults, checkpoint corruption against
@@ -458,6 +471,29 @@ def serve_soak_missing(d: str) -> list[int]:
     return [s for s in SERVE_SOAK_SEEDS if s not in done]
 
 
+def serve_disagg_missing(d: str) -> list[int]:
+    """Disagg seeds still lacking a PASSING run.  A row closes its seed
+    only when it measured something (``value`` = migration us/page > 0
+    — pages actually moved), every request prefilled on rank 0 and
+    decoded on rank 1 (``split_ok``), outputs matched the colocated
+    engine bit-exactly (``parity_ok``), both processes ended empty and
+    leak-free (``no_leak``), and the latency gates held
+    (``ttft_ok``/``p99_ok``).  No device gate — see
+    SERVE_DISAGG_SEEDS; error rows never close a seed."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "serve_disagg.jsonl")):
+        if (r.get("metric") == "serve_disagg"
+                and r.get("seed") in SERVE_DISAGG_SEEDS
+                and measured(r)
+                and r.get("split_ok") is True
+                and r.get("parity_ok") is True
+                and r.get("no_leak") is True
+                and r.get("ttft_ok") is True
+                and r.get("p99_ok") is True):
+            done.add(r["seed"])
+    return [s for s in SERVE_DISAGG_SEEDS if s not in done]
+
+
 def serve_tenancy_missing(d: str) -> list[int]:
     """Tenancy seeds still lacking a PASSING real-TPU run.  A row
     closes its seed only when it measured something (``value`` = the
@@ -719,7 +755,8 @@ def main() -> None:
                                      "collective", "lever", "serve",
                                      "serve_spec", "serve_fused",
                                      "serve_spec_fused",
-                                     "serve_soak", "serve_prefix",
+                                     "serve_soak", "serve_disagg",
+                                     "serve_prefix",
                                      "serve_paged", "serve_paged_kernel",
                                      "serve_paged_traffic",
                                      "serve_tenancy",
@@ -751,6 +788,9 @@ def main() -> None:
               end="")
     elif args.stage == "serve_tenancy":
         print(",".join(str(s) for s in serve_tenancy_missing(args.dir)),
+              end="")
+    elif args.stage == "serve_disagg":
+        print(",".join(str(s) for s in serve_disagg_missing(args.dir)),
               end="")
     elif args.stage == "train_soak":
         print(",".join(str(s) for s in train_soak_missing(args.dir)),

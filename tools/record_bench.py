@@ -452,6 +452,36 @@ def main() -> None:
                   f"{bool(r.get('drafter_quarantined'))}) | "
                   f"`serve_bench.py --soak` | |")
 
+    # Disaggregated-serving rows render pass/fail: a run where any
+    # request failed to split, diverged from the colocated baseline,
+    # leaked, or blew a latency bound is a FAILURE even if pages moved —
+    # the same criteria as bench_gaps.serve_disagg_missing, so recorder
+    # and gate can't disagree.
+    disagg = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "serve_disagg.jsonl"))
+         if "seed" in r and r.get("metric") == "serve_disagg"), "seed")
+    for r in sorted(disagg.values(), key=lambda r: r.get("seed", 0)):
+        if (not measured(r) or not r.get("split_ok")
+                or not r.get("parity_ok") or not r.get("no_leak")
+                or not r.get("ttft_ok") or not r.get("p99_ok")):
+            why = r.get("error") or ", ".join(
+                w for w, bad in (("split incomplete", not r.get("split_ok")),
+                                 ("parity broken", not r.get("parity_ok")),
+                                 ("page/slot leak", not r.get("no_leak")),
+                                 ("ttft blown", not r.get("ttft_ok")),
+                                 ("p99 blown", not r.get("p99_ok")))
+                if bad) or "no real measurement"
+            print(f"| serve_disagg seed={r.get('seed')} | FAILED: "
+                  f"{str(why)[:120]} | `serve_bench.py --disagg` | |")
+        else:
+            print(f"| serve disagg seed={r['seed']} (2-process "
+                  f"prefill/decode split) | PASS: {r['value']} us/page "
+                  f"over {r.get('migrated_pages')} pages, "
+                  f"{r.get('migrated')} handoffs bit-exact, TTFT p99 "
+                  f"{r.get('ttft_p99_ms')} ms vs colocated "
+                  f"{r.get('colocated_ttft_p99_ms')} ms | "
+                  f"`serve_bench.py --disagg` | |")
+
     # Training kill/resume soak rows render pass/fail: a soak whose final
     # params diverged from the uninterrupted run or whose recoveries are
     # not all accounted in the typed event log is a resilience FAILURE
@@ -546,6 +576,7 @@ STAGE_FILES = {
     "serve_prefix": "serve_prefix.jsonl",
     "serve_paged": "serve_paged.jsonl",
     "serve_soak": "serve_soak.jsonl",
+    "serve_disagg": "serve_disagg.jsonl",
     "serve_tenancy": "serve_tenancy.jsonl",
     "train_soak": "train_soak.jsonl",
     "train_soak_multihost": "train_soak_multihost.jsonl",

@@ -103,6 +103,10 @@ serve_soak_ok() {
   local out; out=$(python tools/bench_gaps.py serve_soak) || return 1
   [ -z "$out" ]
 }
+serve_disagg_ok() {
+  local out; out=$(python tools/bench_gaps.py serve_disagg) || return 1
+  [ -z "$out" ]
+}
 serve_prefix_ok() {
   local out; out=$(python tools/bench_gaps.py serve_prefix) || return 1
   [ -z "$out" ]
@@ -487,6 +491,24 @@ PYEOF
         > bench_results/serve_soak.jsonl 2> bench_results/serve_soak.err
       log "serve_soak rc=$? -> bench_results/serve_soak.jsonl"
     fi
+    if serve_disagg_ok; then
+      log "serve_disagg.jsonl already good; skipping disagg bench"
+    else
+      # Disaggregated serving (tpudp.serve.disagg): two OS processes —
+      # prefill host shipping crc-stamped pages to a decode host over
+      # the real DisaggHost handshake — vs a colocated engine on the
+      # same Poisson+burst mixed-tenant workload; a seed passes only
+      # with every request split, bit-exact parity, no leak, and
+      # TTFT/p99 within bounds — resumes at seed granularity via
+      # bench_gaps, like the serve_soak stage.  CPU by construction
+      # (two processes cannot share one libtpu).
+      bank bench_results/serve_disagg.jsonl
+      ensure_window
+      SERVE_DISAGG="$(python tools/bench_gaps.py serve_disagg)" \
+        timeout -k "$GRACE" "$(stage_t 900)" python benchmarks/serve_bench.py \
+        > bench_results/serve_disagg.jsonl 2> bench_results/serve_disagg.err
+      log "serve_disagg rc=$? -> bench_results/serve_disagg.jsonl"
+    fi
     if train_soak_ok; then
       log "train_soak.jsonl already good; skipping training soak"
     else
@@ -554,7 +576,8 @@ PYEOF
     if battery_ok && matrix_ok && flash_ok && epoch_ok && mfu_ok \
         && lever_ok && collective_ok && serve_ok && serve_spec_ok \
         && serve_fused_ok && serve_spec_fused_ok \
-        && serve_soak_ok && serve_prefix_ok && serve_paged_ok \
+        && serve_soak_ok && serve_disagg_ok && serve_prefix_ok \
+        && serve_paged_ok \
         && serve_tenancy_ok \
         && train_soak_ok && train_soak_multihost_ok; then
       log "battery done"

@@ -28,7 +28,8 @@ path without paying a jax import.
 # lint gate without importing the jax-heavy `tpudp` parent package.
 from .core import (PROTOCOL_RULE_NAMES, Finding, Module,  # noqa: F401
                    Rule, lint_paths)
-from .protocol import (VoteSpec, explore_vote_machine,  # noqa: F401
-                       extract_vote_spec)
+from .protocol import (MigrationSpec, VoteSpec,  # noqa: F401
+                       explore_migration_machine, explore_vote_machine,
+                       extract_migration_spec, extract_vote_spec)
 from .protocol import verify_paths as verify_protocol  # noqa: F401
 from .rules import RULES, RULES_BY_NAME  # noqa: F401

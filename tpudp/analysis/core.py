@@ -62,6 +62,7 @@ PROTOCOL_MODULES = (
     "tpudp/cli.py",
     "tpudp/train.py",
     "tpudp/serve/engine.py",
+    "tpudp/serve/disagg.py",
     "tpudp/obs/flight.py",
 )
 

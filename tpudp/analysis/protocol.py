@@ -72,6 +72,7 @@ from .rules import COLLECTIVE_CALLS, COLLECTIVE_HELPERS
 #: into a collective decision.  Classification stops descending here.
 UNIFORM_RESULT_CALLS = {
     "all_hosts_ok", "coordinated_any", "gather_host_values",
+    "gather_host_blobs",
     "broadcast_one_to_all", "process_allgather", "reduce_outcomes",
     "_vote", "_coordinated_recover", "_coverage_union_uncovered",
     "restore_emergency_voted", "restore_latest_verified",
@@ -757,3 +758,169 @@ def extract_vote_spec(source: str, *, n_hosts: int = 2,
                     max_crashes=max_crashes,
                     completion_park=completion_park,
                     bounded_timeout=bounded_timeout)
+
+
+# -- the migration-handshake state-machine model checker ----------------
+
+# Phases of one tpudp/serve/disagg.py migration round, in rendezvous
+# order.  OFFER/TRANSFER/ACK/SEAL are collective barriers every live
+# host joins; ADOPT is the receiver-local work between TRANSFER and
+# ACK where a corrupt payload is discovered.
+OFFER, TRANSFER, ADOPT, ACK, SEAL = ("offer", "transfer", "adopt",
+                                     "ack", "seal")
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationSpec:
+    """The offer → transfer → adopt-ack → release handshake as a
+    checkable spec.
+
+    ``quarantine_acks``: a receiver that unpacks a corrupt or torn
+    transfer quarantines it and STILL joins the ack gather (nacking
+    the ticket) instead of leaving the round — without it the sender
+    parks alone at phase 3.  ``release_on_ack``: the sender resolves
+    its pending tickets only after the ack gather, so staged state is
+    released exactly once per outcome.  ``fallback_local``: a ticket
+    that exhausts its retries is re-admitted LOCALLY, so a dead link
+    degrades to a pressure-vacate resume instead of wedging the
+    request and leaking its staged pages.  All three are extracted
+    from the live ``tpudp/serve/disagg.py`` source by
+    :func:`extract_migration_spec`."""
+
+    n_transfers: int = 2
+    max_faults: int = 2
+    max_retries: int = 1
+    quarantine_acks: bool = True
+    release_on_ack: bool = True
+    fallback_local: bool = True
+
+
+def explore_migration_machine(spec: MigrationSpec) -> dict:
+    """Exhaustive BFS over one sender/receiver pair driving
+    ``n_transfers`` tickets through migration rounds, with up to
+    ``max_faults`` adversarial transfer corruptions injected at any
+    round.  Returns ``{"states": n, "violations": [...]}`` where each
+    violation is one of:
+
+      * ``orphaned-rendezvous`` — one host leaves a round while its
+        peer is still committed to a later barrier of the SAME round
+        (the sender parks alone at the ack gather forever);
+      * ``wedge`` — a ticket that can never resolve: retries
+        exhausted, no local fallback, so the round loop never reaches
+        the joint ``done`` decision;
+      * ``page-leak`` — the run completes but staged sender state was
+        never released.
+
+    State: (tickets_left, attempts, faults_left, staged).  Rounds are
+    lock-step (every barrier is a collective), so the only
+    nondeterminism is the adversary's corrupt/clean choice per round —
+    the bounded space is explored exhaustively."""
+    init = (spec.n_transfers, 0, spec.max_faults, 0)
+    queue = deque([init])
+    seen = {init}
+    violations = []
+
+    def viol(kind, state, detail):
+        violations.append({"kind": kind, "state": state,
+                           "detail": detail})
+
+    while queue:
+        state = queue.popleft()
+        tickets, attempts, faults, staged = state
+        if tickets == 0:
+            if staged:
+                viol("page-leak", state,
+                     f"{staged} staged page(s) never released after "
+                     f"the final round — export leaked on the sender")
+            continue
+        nexts = []
+        # adversary choice per round: deliver clean, or corrupt the
+        # payload (while it still has faults in budget)
+        for corrupt in ((False, True) if faults > 0 else (False,)):
+            if not corrupt:
+                # clean delivery: receiver adopts, acks ok; sender
+                # releases on the ack (or keeps the staged state
+                # forever if release_on_ack was deleted)
+                new_staged = 0 if spec.release_on_ack else staged + 1
+                nexts.append((tickets - 1, 0, faults, new_staged))
+                continue
+            nfaults = faults - 1
+            if not spec.quarantine_acks:
+                # receiver bails out of the round between TRANSFER and
+                # ACK; the sender is already committed to the ack
+                # gather and parks alone — terminal
+                viol("orphaned-rendezvous", state,
+                     "receiver exits the round on a corrupt transfer; "
+                     "sender parks alone at the ack gather (phase "
+                     f"{ACK!r} of the same round)")
+                continue
+            # quarantined: nack comes back on the ack gather
+            if attempts < spec.max_retries:
+                nexts.append((tickets, attempts + 1, nfaults, staged))
+            elif spec.fallback_local:
+                # retries exhausted: local re-admission resolves the
+                # ticket (as failed) and releases the staged state
+                nexts.append((tickets - 1, 0, nfaults,
+                              0 if spec.release_on_ack else staged + 1))
+            else:
+                # no retry budget, no fallback: the ticket re-enters
+                # the outbox forever and the joint done vote never
+                # fires — terminal
+                viol("wedge", state,
+                     f"ticket out of retries with no local fallback — "
+                     f"the round loop never reaches the joint "
+                     f"{SEAL!r} with done=1")
+        for n in nexts:
+            if n not in seen:
+                seen.add(n)
+                queue.append(n)
+    return {"states": len(seen), "violations": violations}
+
+
+def extract_migration_spec(source: str, *, n_transfers: int = 2,
+                           max_faults: int = 2,
+                           max_retries: int = 1) -> MigrationSpec:
+    """Extract the handshake's three load-bearing properties from the
+    live ``tpudp/serve/disagg.py`` source: does ``DisaggHost.round``'s
+    ``TransferCorrupt`` handler stay in the round (no ``return`` /
+    ``raise`` — it must still reach the ack gather), does ``round``
+    resolve pending tickets via ``release_acks`` only AFTER the ack
+    gather (the last ``gather_host_blobs``), and does ``release_acks``
+    fall back to local ``admit_ticket`` when a ticket dies?  The
+    returned spec is what :func:`explore_migration_machine` proves
+    orphan/wedge/leak-free — deleting any property from the source is
+    caught by the model checker, not just by review."""
+    tree = ast.parse(source)
+    quarantine_acks = False
+    release_on_ack = False
+    fallback_local = False
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name == "round":
+            for handler in (h for n in ast.walk(node)
+                            if isinstance(n, ast.Try)
+                            for h in n.handlers):
+                if (_terminal_name(handler.type) != "TransferCorrupt"):
+                    continue
+                leaves = any(isinstance(n, (ast.Return, ast.Raise))
+                             for b in handler.body for n in ast.walk(b))
+                quarantine_acks = not leaves
+            gathers = [n.lineno for n in ast.walk(node)
+                       if isinstance(n, ast.Call)
+                       and _terminal_name(n.func) == "gather_host_blobs"]
+            releases = [n.lineno for n in ast.walk(node)
+                        if isinstance(n, ast.Call)
+                        and _terminal_name(n.func) == "release_acks"]
+            release_on_ack = bool(gathers and releases
+                                  and min(releases) > max(gathers))
+        if node.name == "release_acks":
+            fallback_local = any(
+                isinstance(n, ast.Call)
+                and _terminal_name(n.func) == "admit_ticket"
+                for n in ast.walk(node))
+    return MigrationSpec(n_transfers=n_transfers, max_faults=max_faults,
+                         max_retries=max_retries,
+                         quarantine_acks=quarantine_acks,
+                         release_on_ack=release_on_ack,
+                         fallback_local=fallback_local)

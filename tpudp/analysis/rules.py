@@ -122,7 +122,8 @@ COLLECTIVE_CALLS = {
 #: Repo/runtime cross-process protocol helpers — every host must reach
 #: these together (matched by terminal name).
 COLLECTIVE_HELPERS = {
-    "gather_host_values", "all_hosts_ok", "coordinated_any",
+    "gather_host_values", "gather_host_blobs", "all_hosts_ok",
+    "coordinated_any",
     "commit_after_all_hosts", "broadcast_one_to_all",
     "verify_across_processes", "process_allgather",
     "sync_global_devices", "_vote", "_coordinated_recover",

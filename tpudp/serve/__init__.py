@@ -1,10 +1,14 @@
 """tpudp.serve — continuous-batching inference (slot scheduler, chunked
 prefill, streaming decode, speculative decoding, prefix caching,
 multi-tenant priority tiers with bit-exact preemption and co-resident
-models, robustness layer: bounded admission, deadlines, fault isolation,
-graceful drain).  See docs/SERVING.md; deterministic fault injectors
-live in ``tpudp.serve.faults``."""
+models, disaggregated prefill/decode across hosts with live KV page
+migration, robustness layer: bounded admission, deadlines, fault
+isolation, graceful drain).  See docs/SERVING.md; deterministic fault
+injectors live in ``tpudp.serve.faults``."""
 
+from tpudp.serve.disagg import (ClusterRequest, DisaggCluster, DisaggHost,
+                                MigrationFailed, MigrationTicket,
+                                TransferCorrupt)
 from tpudp.serve.engine import (TRACE_COUNTS, Engine, EngineClosed,
                                 FinishReason, QueueFull, Request,
                                 RequestFailed)
@@ -17,4 +21,6 @@ __all__ = ["Engine", "Request", "TRACE_COUNTS", "Drafter",
            "DraftModelDrafter", "NgramDrafter", "FinishReason",
            "PageIndex", "PagePool", "PrefixCache", "QueueFull",
            "EngineClosed", "RequestFailed", "TenantClass",
-           "TenantScheduler", "TreeShape", "TREE_SHAPES", "tree_shape"]
+           "TenantScheduler", "TreeShape", "TREE_SHAPES", "tree_shape",
+           "ClusterRequest", "DisaggCluster", "DisaggHost",
+           "MigrationFailed", "MigrationTicket", "TransferCorrupt"]

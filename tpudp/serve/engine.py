@@ -1332,6 +1332,12 @@ class Request:
         #                            higher-priority work (each resume is
         #                            bit-identical, so this is latency
         #                            accounting, never a correctness flag)
+        self.migrations = 0        # times this request moved host-to-host
+        #                            (tpudp/serve/disagg.py) — distinct
+        #                            from preemptions and from page
+        #                            pressure at every level: a migration
+        #                            is also a bit-exact resume, just on
+        #                            a different engine
         self._ms = None            # _ModelState this request decodes with
         self.tokens: list[int] = []
         self.token_times: list[float] = []
@@ -2141,23 +2147,188 @@ class Engine:
         Tokens already emitted stay on the handle; the freed slot's stale
         KV needs no scrubbing (the arena's overwrite-before-visible rule
         covers recycled slots).  Returns False if the request already
-        finished (completed or previously cancelled), True otherwise."""
+        finished (completed or previously cancelled) or no longer
+        belongs to this engine (``export_ticket`` detached it — the
+        migrate-vs-cancel race: the request now lives in a ticket or on
+        another host, so the caller cancels through its cluster-level
+        handle instead), True otherwise."""
         if request.done:
             return False
         if request._slot is not None:
             self._retire(request._slot, FinishReason.CANCELLED)
-        elif self._sched is not None:
-            self._sched.remove(request)
-            self._finish(request, FinishReason.CANCELLED)
-        else:
-            self._queue.remove(request)
-            self._finish(request, FinishReason.CANCELLED)
+            return True
+        try:
+            if self._sched is not None:
+                self._sched.remove(request)
+            else:
+                self._queue.remove(request)
+        except ValueError:
+            return False  # migrated out: not this engine's to cancel
+        self._finish(request, FinishReason.CANCELLED)
         return True
 
     def run_until_complete(self) -> None:
         """Drive the engine until every queue and every slot is empty."""
         while self.queue_depth or any(r is not None for r in self._slots):
             self.step()
+
+    # -- cross-host migration hooks (tpudp/serve/disagg.py) ------------
+
+    def export_ticket(self, request: Request):
+        """Detach a live request into a :class:`tpudp.serve.disagg.
+        MigrationTicket` — the sender half of cross-host KV migration.
+
+        An in-flight slot exports its chunk-prefilled prefix pages as
+        host payloads (read BEFORE vacate, so tree nodes and other
+        slots sharing those pages are untouched — their refs release
+        symmetrically through the normal vacate path), publishes the
+        prefix locally (the pages stay resident as evictable cache on
+        the sender), then vacates through the one bit-exact carry-over
+        path: emitted tokens and the per-slot PRNG chain ride the
+        ticket, so the receiver continues the exact sampled sequence.
+        A QUEUED request exports tokens-only (nothing prefilled yet).
+        The source handle is left detached (not done — ``FinishReason``
+        never grows a user-visible MIGRATED value; the disagg layer
+        tracks the request through the ticket and the receiver's new
+        handle).  Raises :class:`ValueError` for a finished request."""
+        from tpudp.serve import disagg as _dg
+
+        r = request
+        if r.done:
+            raise ValueError(f"request {r.id} already finished "
+                             f"({r.finish_reason}); nothing to migrate")
+        s = r._slot
+        pages: list[dict] = []
+        if s is None:
+            if self._sched is not None:
+                self._sched.remove(r)
+            else:
+                self._queue.remove(r)
+            r._fill = np.concatenate([r.prompt,
+                                      np.asarray(r.tokens, np.int32)])
+            r._nfill = 0
+        else:
+            ms = r._ms
+            if self._paged:
+                n_blocks = (min(r._nfill, r._fill.size)
+                            // self.prefill_chunk)
+                for i in range(n_blocks):
+                    page = int(ms.table[s, i])
+                    if page >= 0:
+                        pages.append(ms.pool.read_page(page))
+            if ((self._paged or ms.prefix_cache is not None)
+                    and self._accepting):
+                self._publish_prefix(ms, s, r)
+            self._vacate_slot(s)
+        r.migrations += 1
+        self.stats["migrated_out"] += 1
+        self.obs.event("migrate_out", rid=r.id, slot=s, tenant=r.tenant,
+                       tokens=len(r.tokens), pages=len(pages))
+        if r.tenant is not None:
+            self._sched.stats(r.tenant)["migrated_out"] += 1
+        key = r._resume_key
+        return _dg.MigrationTicket(
+            rid=r.id, model=r._ms.name,
+            prompt=np.asarray(r.prompt, np.int32),
+            tokens=tuple(int(t) for t in r.tokens),
+            max_new_tokens=r.max_new_tokens,
+            temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
+            seed=r.seed, eos_id=r.eos_id, deadline_s=r.deadline_s,
+            tenant=r.tenant, migrations=r.migrations,
+            preemptions=r.preemptions,
+            draft_proposed=r.draft_proposed,
+            draft_accepted=r.draft_accepted,
+            resume_key=(None if key is None else np.asarray(key)),
+            page_tokens=self.prefill_chunk, pages=tuple(pages))
+
+    def admit_ticket(self, ticket) -> Request:
+        """Admit a migrated request — the receiver half of cross-host
+        KV migration.  Page payloads are written into freshly allocated
+        pages of THIS host's pool and adopted into the prefix tree
+        (``PageIndex.adopt`` — the tree takes ownership; a chunk some
+        local request already published keeps the tree's page and the
+        incoming duplicate is freed), so the resume's re-prefill
+        collapses to table mappings plus the final chunk, exactly like
+        a local pressure-vacate resume.  The request re-enters at the
+        FRONT of its class (a migration is a resume, not a fresh
+        arrival) carrying tokens + PRNG chain, which is what makes the
+        continuation bit-identical to an unmigrated run.  The crc /
+        wire-format checks live one layer up in
+        ``tpudp.serve.disagg`` — this method trusts its arrays but
+        re-validates geometry (model, vocab, lengths, chunk size) and
+        raises :class:`ValueError` on mismatch."""
+        if not self._accepting:
+            raise EngineClosed(
+                "Engine.drain()/close() was called; the engine no "
+                "longer accepts work")
+        if ticket.model not in self._mstates:
+            raise ValueError(
+                f"ticket for model {ticket.model!r} but this engine "
+                f"serves {sorted(k or 'default' for k in self._mstates)}")
+        tname = None
+        if self._sched is not None:
+            tname = self._sched.resolve(ticket.tenant)
+        elif ticket.tenant is not None:
+            raise ValueError(
+                f"ticket carries tenant {ticket.tenant!r} but this "
+                f"engine has no tenant classes configured")
+        ms = self._mstates[ticket.model]
+        prompt = np.asarray(ticket.prompt, np.int32).reshape(-1)
+        vocab = ms.config.vocab_size
+        if prompt.size == 0 or prompt.min() < 0 or prompt.max() >= vocab:
+            raise ValueError(f"ticket prompt ids must be in [0, {vocab})")
+        total = prompt.size + ticket.max_new_tokens + self.speculate_k
+        if total > self.max_len:
+            raise ValueError(
+                f"ticket prompt ({prompt.size}) + max_new_tokens "
+                f"({ticket.max_new_tokens}) exceeds the arena max_len "
+                f"({self.max_len})")
+        if ticket.pages and ticket.page_tokens != self.prefill_chunk:
+            raise ValueError(
+                f"ticket pages hold {ticket.page_tokens} tokens but this "
+                f"engine's prefill_chunk is {self.prefill_chunk}")
+        r = Request(self, self._next_id, prompt, ticket.max_new_tokens,
+                    float(ticket.temperature), int(ticket.top_k),
+                    float(ticket.top_p), ticket.seed, ticket.eos_id,
+                    deadline_s=ticket.deadline_s, tenant=tname)
+        self._next_id += 1
+        r._ms = ms
+        r.tokens = [int(t) for t in ticket.tokens]
+        r.token_times = [r.submit_time] * len(r.tokens)
+        r.migrations = ticket.migrations
+        r.preemptions = ticket.preemptions
+        r.draft_proposed = ticket.draft_proposed
+        r.draft_accepted = ticket.draft_accepted
+        r._fill = np.concatenate([prompt,
+                                  np.asarray(r.tokens, np.int32)])
+        r._nfill = 0
+        if ticket.resume_key is not None:
+            r._resume_key = np.asarray(ticket.resume_key)
+        adopted = []
+        if self._paged and ticket.pages:
+            for payload in ticket.pages:
+                page = self._alloc_page(ms, protect=-1)
+                if page is None:
+                    break
+                ms.pool.write_page(page, payload)
+                adopted.append(page)
+            if adopted:
+                ms.index.adopt(r._fill, adopted)
+                for page in adopted:
+                    ms.pool.release(page)
+        self.stats["migrated_in"] += 1
+        self.stats["migrated_in_pages"] += len(adopted)
+        self.obs.event("migrate_in", rid=ticket.rid, new_rid=r.id,
+                       tenant=tname, tokens=len(r.tokens),
+                       pages=len(adopted),
+                       resumed=ticket.resume_key is not None)
+        if tname is not None:
+            self._sched.stats(tname)["migrated_in"] += 1
+        if self._sched is not None:
+            self._sched.requeue_front(r)
+        else:
+            self._queue.appendleft(r)
+        return r
 
     def drain(self) -> None:
         """Graceful shutdown: stop admission (``submit()`` raises

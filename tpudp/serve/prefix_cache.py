@@ -392,6 +392,43 @@ class PagePool:
         self._rc = {}
         self._free = list(range(self.num_pages - 1, -1, -1))
 
+    def read_page(self, page: int) -> dict:
+        """Host copies of one allocated page's slice of every pool
+        buffer (k, v, and the int8 scales when present), keyed by
+        field name — the unit of cross-host KV migration
+        (``tpudp/serve/disagg.py``).  Read-only: shared pages (radix
+        tree, other slots) are untouched."""
+        import numpy as np
+
+        if page not in self._rc:
+            raise ValueError(f"read_page of unallocated page {page}")
+        return {name: np.asarray(buf[:, page])
+                for name, buf in zip(self.pages._fields, self.pages)}
+
+    def write_page(self, page: int, arrays: dict) -> None:
+        """Write one page's payload (as produced by :meth:`read_page`,
+        typically on another host with an identical KV geometry) into
+        an allocated page of THIS pool.  The caller must hold the page
+        exclusively (rc=1, fresh from ``alloc()``) — writing a shared
+        page would clobber a peer holder's bytes."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        if self._rc.get(page) != 1:
+            raise ValueError(
+                f"write_page needs exclusive page, got rc="
+                f"{self._rc.get(page)} for page {page}")
+        new = {}
+        for name, buf in zip(self.pages._fields, self.pages):
+            arr = np.asarray(arrays[name])
+            want = buf.shape[:1] + buf.shape[2:]
+            if arr.shape != want or arr.dtype != buf.dtype:
+                raise ValueError(
+                    f"page payload {name}: got {arr.shape}/{arr.dtype}, "
+                    f"pool expects {want}/{buf.dtype}")
+            new[name] = buf.at[:, page].set(jnp.asarray(arr))
+        self.pages = self.pages._replace(**new)
+
     def check(self, expected_refs: dict[int, int] | None = None) -> None:
         """Pool consistency; with ``expected_refs`` (page -> reference
         count derived from the live tables and radix trees) also the

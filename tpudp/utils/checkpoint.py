@@ -149,6 +149,38 @@ def coordinated_any(flag: bool) -> bool:
     return max(gather_host_values(1 if flag else 0)) == 1
 
 
+def gather_host_blobs(blob: bytes) -> list[bytes]:
+    """Allgather one variable-length byte payload per host, in rank
+    order (identity list on a single process — no collective
+    dispatched).  The bulk-transfer primitive under KV page migration
+    (``tpudp/serve/disagg.py``): every host contributes its packed
+    ticket batch (possibly empty) and receives every peer's, over
+    exactly TWO fixed collectives — a length gather, then ONE
+    max-length-padded uint8 allgather — so the rendezvous sequence is
+    identical on every host no matter who has bytes to send (an idle
+    host rides along with a zero-length payload rather than skipping
+    the exchange and wedging its peers)."""
+    if jax.process_count() == 1:
+        return [bytes(blob)]
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    lengths = gather_host_values(len(blob))
+    # Pad width quantized to the next power of two: process_allgather
+    # compiles one program per distinct width, and migration blob sizes
+    # vary round to round — exact widths would recompile the transfer
+    # collective on nearly every handoff, a pause that lands mid-decode
+    # on the receiving host.  The exact lengths still slice each
+    # payload, so the extra pad bytes never reach a caller.
+    width = 1 << (max(max(lengths), 1) - 1).bit_length()
+    buf = np.zeros(width, np.uint8)
+    buf[: len(blob)] = np.frombuffer(bytes(blob), np.uint8)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(buf)))
+    return [gathered[i, :n].tobytes() for i, n in enumerate(lengths)]
+
+
 def invalidate_commit(path: str | os.PathLike) -> None:
     """Remove a previous save's COMMITTED marker and per-host shard
     manifests BEFORE a multi-host save rewrites ``path`` (``force=True``
