@@ -144,6 +144,32 @@ def main() -> None:
     sec_full, _ = timed(full, lambda s: s.params)
     emit("full", sec_full)
 
+    # Pipeline bubble attribution (analytic, free): the 1F1B schedule's
+    # idle fraction (P-1)/(V*M + P-1) for every geometry registered in
+    # tools/bench_gaps.PIPELINE_CONFIGS, reported alongside MFU so the
+    # pipeline rung's measured throughput gap to PP=1 can be attributed
+    # — a geometry whose measured gap exceeds its bubble is losing time
+    # to transport or the sharded update, not the schedule.  Always
+    # emitted (no timing involved); `ideal_mfu_scale` is the factor the
+    # bubble alone would take off the full step's MFU.
+    from benchmarks.pipeline_bench import _cfg as _pipe_cfg
+    from benchmarks.pipeline_bench import parse_config
+    from tools.bench_gaps import PIPELINE_CONFIGS
+    from tpudp.utils.flops import pipeline_bubble_fraction
+
+    micro = _pipe_cfg()["micro"]
+    print(json.dumps({
+        "kind": "pipeline_bubble", "n_microbatches": micro,
+        "geometries": [
+            {"config": name, "stages": pp, "dp": dp, "interleave": v,
+             "bubble_fraction": round(
+                 pipeline_bubble_fraction(pp, micro, v), 4),
+             "ideal_mfu_scale": round(
+                 1.0 - pipeline_bubble_fraction(pp, micro, v), 4)}
+            for name, (pp, dp, v) in
+            ((n, parse_config(n)) for n in PIPELINE_CONFIGS)],
+    }), flush=True)
+
     if {"fwd_bwd", "fwd_only"} & selected:
         state2 = init_state(model, tx)
 

@@ -22,6 +22,12 @@ def _run(script, env_extra, timeout=900, args=()):
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
 
 
+@pytest.mark.slow  # ~27s subprocess VGG compile; the headline-line
+# CONTRACT this guards (parent always prints one parseable JSON row) is
+# pinned on the fast tier by
+# test_bench_headline_parses_even_when_child_crashes — same parent emit
+# path, crash branch included — and the success-path row fields ride
+# every real TPU capture; only the smoke-host success VALUES are extra.
 def test_bench_emits_headline_json():
     # BENCH_COST/BENCH_COLLECTIVE off: each side-measurement recompiles a
     # program and this smoke test guards the headline-line CONTRACT, not
@@ -195,6 +201,36 @@ def test_registry_configs_all_gated():
         assert _re.fullmatch(r"k\d+n\d+", c), c
 
 
+def test_train_pipeline_gap_gate(tmp_path):
+    """tools/bench_gaps `train_pipeline` stage: a geometry closes only
+    on a measured TPU row with parity AND fault accounting intact — a
+    fast-but-diverged row, an unaccounted recovery, or a CPU smoke row
+    all leave the config in the gap list (same philosophy as the
+    train_soak gate)."""
+    from tools.bench_gaps import PIPELINE_CONFIGS, train_pipeline_missing
+
+    d = str(tmp_path)
+    assert train_pipeline_missing(d) == list(PIPELINE_CONFIGS)
+    good = {"metric": "train_pipeline", "config": "pp2dp4",
+            "value": 1.0e5, "parity_ok": True, "accounted": True,
+            "device_kind": "TPU v5 lite"}
+    rows = [good,
+            {**good, "config": "pp4dp2", "parity_ok": False},
+            {**good, "config": "pp2dp4v2", "device_kind": "cpu"},
+            {**good, "config": "unregistered"},
+            {**good, "config": "pp4dp2", "accounted": False}]
+    with open(os.path.join(d, "train_pipeline.jsonl"), "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in rows)
+    assert train_pipeline_missing(d) == ["pp4dp2", "pp2dp4v2"]
+    # the bench's config-name parser agrees with the registry format
+    from benchmarks.pipeline_bench import parse_config
+
+    assert [parse_config(c) for c in PIPELINE_CONFIGS] == [
+        (2, 4, 1), (4, 2, 1), (2, 4, 2)]
+    with pytest.raises(ValueError, match="bad pipeline config"):
+        parse_config("pp2xdp4")
+
+
 def test_stale_tpu_row_gap(tmp_path):
     """tools/bench_gaps `stale` stage: a result file whose current
     artifact is a last-known-good re-emission reports a NAMED
@@ -329,8 +365,15 @@ def test_serve_prefix_gap_gate(tmp_path):
     assert serve_prefix_missing(d) == []  # banked history row counts
 
 
+@pytest.mark.slow  # ~33s (L4/d128 deep geometry x two engines); the
+# serve_bench paged row path, schema, and bit-exact parity stay fast-tier
+# via test_serve_paged_traffic_rows_parse (three engines, same emit/gap
+# machinery at tiny geometry) — this row's unique deltas, the >=1.5x
+# capacity margin and the gather-free >= gather timing margin, are
+# timing-margin gates the bench referees for real on TPU rows only
+# (the ISSUE 17 demotion pattern).
 def test_serve_paged_bench_rows_parse():
-    """The serve_paged stage's CPU smoke (tier-1's guard on the
+    """The serve_paged stage's CPU smoke (the guard on the
     paged-attention bench the TPU watcher resumes): the registered
     workload emits a parseable row where the paged engine sustained
     >= 1.5x the dense copy engine's co-resident contexts at the same
@@ -786,8 +829,13 @@ def test_serve_spec_fused_gap_gate(tmp_path):
     assert serve_spec_fused_missing(d) == []  # banked history row counts
 
 
+@pytest.mark.slow  # ~10s; every property this row asserts is pinned
+# fast-tier in-process by tests/test_tenancy.py (preemption storm
+# no-leak/parity, stride fair shares, per-tier shedding) — the bench
+# subprocess re-derives them through serve_bench's emit path, whose row
+# schema and seed-closing rules test_serve_tenancy_gap_gate keeps fast.
 def test_serve_tenancy_bench_row_parses():
-    """The serve_tenancy stage's CPU smoke (tier-1's guard on the
+    """The serve_tenancy stage's CPU smoke (the guard on the
     multi-tenant bench the TPU watcher resumes): at a trimmed geometry
     the mixed-priority workload must emit a parseable row where the
     high tier's p99 held under low-tier overload (p99_ok), preemptions
@@ -859,8 +907,16 @@ def test_serve_tenancy_gap_gate(tmp_path):
     assert serve_tenancy_missing(d) == [1]  # banked history row counts
 
 
+@pytest.mark.slow  # ~27s (three subprocess workers each paying the full
+# jax import); the handoff protocol this drives is pinned fast-tier
+# in-process by tests/test_disagg.py (migration/failover/quarantine/
+# parity edge matrix) + the protocol verifier and migration model
+# checker in test_analysis_clean/test_protocol, and the row schema +
+# seed-closing rules by test_serve_disagg_gap_gate — the two-process
+# bench run itself is the watcher battery's job (CPU rows close this
+# stage's seeds, so the slow tier still runs it pre-battery).
 def test_serve_disagg_bench_row_parses():
-    """The serve_disagg stage's CPU smoke (tier-1's guard on the
+    """The serve_disagg stage's CPU smoke (the guard on the
     two-process prefill/decode split the TPU watcher resumes): rank 0
     must prefill and ship every request's pages, rank 1 must adopt and
     decode them bit-identically to the colocated baseline (parity_ok +

@@ -65,6 +65,40 @@ def test_sampler_batch_contiguous_is_geometry_invariant():
         ShardedSampler(42, 2, 0, batch_contiguous=8)
 
 
+def test_sampler_batch_contiguous_invariant_across_pp_dp_meshes():
+    """PP x DP meshes: the data shard rides the DATA axis only — every
+    pipeline stage of a DP column builds the identical sampler (shard
+    count = DP, shard id = the host's data coordinate; the stage rank
+    never enters the draw), so the assembled global batch stays a pure
+    function of (seed, epoch) no matter how a fixed host count splits
+    between pipeline and data.  This is the property the 1f1b_mpmd
+    rung's equal-global-batch parity oracle (tests/test_schedule.py)
+    rests on when the mesh spans hosts; wiring the shard to the flat
+    HOST rank instead would shrink each replica's draw as PP grows and
+    silently change the global batch with the pipeline degree."""
+    n, B = 48, 8
+    canonical = ShardedSampler(n, 1, 0, shuffle=True, seed=3,
+                               batch_contiguous=B).indices(epoch=2)
+    # 4 hosts as 1x4 / 2x2 / 4x1, 8 hosts as 2x4 / 4x2 / 1x8
+    for pp, dp in [(1, 4), (2, 2), (4, 1), (2, 4), (4, 2), (1, 8)]:
+        per = B // dp
+        cols = [ShardedSampler(n, dp, d, shuffle=True, seed=3,
+                               batch_contiguous=B).indices(epoch=2)
+                for d in range(dp)]
+        # reassembling the DP columns rebuilds the canonical sequence —
+        # identical for every PP degree sharing those columns
+        rebuilt = np.concatenate(
+            [np.concatenate([c[b * per:(b + 1) * per] for c in cols])
+             for b in range(n // B)])
+        np.testing.assert_array_equal(canonical, rebuilt, err_msg=f"pp{pp}dp{dp}")
+        # every pipeline stage of a column replays its column's rows
+        # exactly (same constructor args -> bit-identical draw)
+        for s in range(1, pp):
+            np.testing.assert_array_equal(
+                cols[0], ShardedSampler(n, dp, 0, shuffle=True, seed=3,
+                                        batch_contiguous=B).indices(epoch=2))
+
+
 def test_normalize_matches_reference_constants():
     img = np.full((1, 32, 32, 3), 255, np.uint8)
     out = normalize_batch(img)

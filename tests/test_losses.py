@@ -65,6 +65,12 @@ def test_metrics_match_dense_eval():
         np.testing.assert_allclose(float(a), float(b), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # ~12s (two 3-step GPT-2 trainings); value+grad
+# equality of the chunked loss is pinned fast-tier at the function level
+# by test_value_and_grads_match_dense (4 chunk sizes), and the
+# loss_chunk wiring through the step/Trainer by the slow
+# test_trainer_loss_chunk_end_to_end sibling — this mid-level
+# integration adds no coverage class between them.
 def test_train_path_loss_chunk_matches_dense(mesh4):
     """GPT-2 trained with loss_chunk follows the dense-loss trajectory."""
     from tpudp.models.gpt2 import gpt2_small

@@ -30,6 +30,12 @@ def test_resnet50_param_count_and_shape():
     assert logits_shape.shape == (2, 1000)
 
 
+@pytest.mark.slow  # ~11s; loss-actually-decreases is strictly weaker
+# than the step-by-step ResNet training parity vs the torch reference
+# (test_resnet_torch_parity.py::test_resnet_training_trajectory_parity,
+# fast tier), and the conv/BN model through the mesh-DP step is the VGG
+# suite's bread and butter (test_train.py) — same demotion shape as the
+# slow test_tiny_gpt2_trains_dp sibling below.
 def test_small_resnet_trains(mesh4):
     """A down-scaled ResNet runs through the DP train step on the mesh."""
     model = ResNet(stage_sizes=(1, 1), num_classes=10, width=8)

@@ -358,6 +358,12 @@ def test_program_donations_mirror_rules_tables():
         "train.step_single": "train_step",
         "train.step_dp_allreduce": "train_step",
         "train.step_dp_ring": "train_step",
+        # 1F1B MPMD pipeline programs (ISSUE 19): the Trainer drives the
+        # step through the same strategy seam as every other train_step,
+        # donating the TrainState at arg 0 (pp_eval reads params only
+        # and is donation-free).
+        "train.pp_1f1b": "train_step",
+        "train.pp_1f1b_int": "train_step",
     }
     for prog, callee in mirror.items():
         assert PROGRAM_DONATIONS[prog] == DONATING[callee], (

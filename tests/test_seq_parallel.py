@@ -28,6 +28,12 @@ def _data(batch=4, t=64, seed=0):
     return jnp.asarray(tokens), jnp.asarray(targets)
 
 
+@pytest.mark.slow  # ~12s (3 single-device + 3 sharded GPT-2 steps); the
+# ring-attention math itself is exact-match-pinned fast-tier in
+# tests/test_ring_attention.py, the global-position wiring by
+# test_sp_positions_are_global below, and the SP rung's full
+# fit/eval/checkpoint trajectory by the strategy suite
+# (tests/test_strategies.py) — this composition re-times the pieces.
 def test_dp_sp_matches_single_device(mesh2x4):
     tokens, targets = _data()
     tx = make_optimizer(learning_rate=0.01)

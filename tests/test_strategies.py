@@ -122,6 +122,15 @@ def test_trainer_sp_rung(tmp_path):
            tmp_path)
 
 
+def test_trainer_pp_mpmd_rung(tmp_path):
+    """The unrolled 1F1B MPMD schedule (tpudp/parallel/schedule.py) as a
+    first-class pp option: same Trainer loop, in-step sharded optimizer,
+    checkpoint round-trip on the flat-sharded state."""
+    mesh = make_mesh_nd({"data": 2, "pipe": 2}, devices=jax.devices()[:4])
+    _drive("pp", mesh, DENSE,
+           {"n_microbatches": 2, "schedule": "1f1b_mpmd"}, tmp_path)
+
+
 def test_trainer_rejects_bad_strategy_combos():
     mesh = make_mesh(4)
     with pytest.raises(ValueError, match="unknown strategy"):

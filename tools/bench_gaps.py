@@ -182,6 +182,23 @@ TRAIN_SOAK_SEEDS = (0, 1, 2)
 # what the soak certifies — the coordination protocol — is
 # platform-independent.
 TRAIN_SOAK_MULTIHOST_SEEDS = (0, 1, 2)
+# Pipeline-parallel training geometries (benchmarks/pipeline_bench.py:
+# the unrolled 1F1B MPMD schedule of tpudp/parallel/schedule.py over a
+# pp{P}dp{D}[v{V}] mesh — P stages x D replicas, V virtual stages per
+# device — with the in-step reduce-scattered optimizer) that must PASS
+# on the TPU.  A geometry is closed only by a row that measured real
+# throughput, whose loss trajectory tracked the single-stage PP=1
+# baseline at equal global batch within ~1 float32 ulp (parity_ok;
+# the bit-exact oracle lives in tests/test_schedule.py at the tier-1
+# dims — at bench dims the schedule.py docstring's compiler-owned
+# last ulp applies, and the row records the bit-exact prefix
+# explicitly), and whose injected stage fault took
+# the supervisor's voted recovery path with exactly one accounted
+# step_retry and bit-exact recovered params (accounted); CPU smoke
+# rows never close a geometry.  All three names need the full 8-chip
+# slice (P*D = 8); the interleaved v2 geometry additionally proves the
+# virtual-stage ring wrap at bench scale.
+PIPELINE_CONFIGS = ("pp2dp4", "pp4dp2", "pp2dp4v2")
 
 
 def history_path(path: str) -> str:
@@ -537,6 +554,30 @@ def train_soak_missing(d: str) -> list[int]:
     return [s for s in TRAIN_SOAK_SEEDS if s not in done]
 
 
+def train_pipeline_missing(d: str) -> list[str]:
+    """Pipeline-parallel geometries still lacking a PASSING real-TPU
+    row.  A row closes its config only when it measured real throughput
+    (``value`` > 0), the geometry's loss trajectory tracked the
+    single-stage baseline within ~1 float32 ulp (``parity_ok``; the
+    row also records its bit-exact leading prefix — see the
+    pipeline_bench.py docstring for the scoping), and the injected
+    stage fault was recovered through the
+    voted rollback path with bit-exact params (``accounted``) — a fast
+    row that diverged or lost its recovery is a FAILURE to retry,
+    exactly like an error row.  CPU smoke rows never close a config
+    (same rules as train_soak_missing)."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "train_pipeline.jsonl")):
+        if (r.get("metric") == "train_pipeline"
+                and r.get("config") in PIPELINE_CONFIGS
+                and measured(r)
+                and r.get("parity_ok") is True
+                and r.get("accounted") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["config"])
+    return [c for c in PIPELINE_CONFIGS if c not in done]
+
+
 def train_soak_multihost_missing(d: str) -> list[int]:
     """Pod-scale soak seeds still lacking a PASSING run.  Same rules as
     train_soak_missing, plus the row must prove the ELASTIC step — the
@@ -761,7 +802,8 @@ def main() -> None:
                                      "serve_paged_traffic",
                                      "serve_tenancy",
                                      "train_soak",
-                                     "train_soak_multihost", "analysis",
+                                     "train_soak_multihost",
+                                     "train_pipeline", "analysis",
                                      "obs", "stale"])
     p.add_argument("--dir", default="bench_results")
     args = p.parse_args()
@@ -799,6 +841,8 @@ def main() -> None:
         print(",".join(str(s)
                        for s in train_soak_multihost_missing(args.dir)),
               end="")
+    elif args.stage == "train_pipeline":
+        print(",".join(train_pipeline_missing(args.dir)), end="")
     elif args.stage == "serve_prefix":
         print(",".join(serve_prefix_missing(args.dir)), end="")
     elif args.stage == "serve_paged":

@@ -176,8 +176,16 @@ def main() -> None:
         trace_s = (f"; trace MXU-named share "
                    f"{trace['mxu_named_share']}" if trace
                    and trace.get("mxu_named_share") is not None else "")
+        # Analytic 1F1B bubble fractions next to the measured shares
+        # (the pipeline rung's attributable schedule overhead).
+        bubble = next((r for r in _rows(os.path.join(args.dir, "mfu.jsonl"))
+                       if r.get("kind") == "pipeline_bubble"), None)
+        bubble_s = ("; 1F1B bubble " + ", ".join(
+            f"{g['config']} {g['bubble_fraction']}"
+            for g in bubble["geometries"])
+            if bubble and bubble.get("geometries") else "")
         print(f"| MFU attribution (full step {full.get('mfu')}) | "
-              f"{', '.join(shares) or 'shares pending'}{trace_s} | "
+              f"{', '.join(shares) or 'shares pending'}{trace_s}{bubble_s} | "
               f"`mfu_attribution.py` | |")
 
     serve = _dedupe(
@@ -512,6 +520,42 @@ def main() -> None:
                   f"{r.get('loader_restarts')} loader restarts) | "
                   f"`resilience_bench.py` | |")
 
+    # Pipeline-parallel training rows render pass/fail on the rung's
+    # three-part referee: measured throughput, loss trajectory within
+    # ~1 float32 ulp of the single-stage baseline (bit-exact prefix
+    # recorded in the row), and the injected stage fault recovered
+    # through the voted rollback path with bit-exact params — the same
+    # criteria as bench_gaps.train_pipeline_missing, so recorder and
+    # gate can't disagree.
+    tpipe = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "train_pipeline.jsonl"))
+         if "config" in r and r.get("metric") == "train_pipeline"),
+        "config")
+    for r in sorted(tpipe.values(), key=lambda r: str(r.get("config"))):
+        if (not measured(r) or not r.get("parity_ok")
+                or not r.get("accounted")):
+            why = r.get("error") or ", ".join(
+                w for w, bad in (("loss trajectory diverged",
+                                  not r.get("parity_ok")),
+                                 ("stage fault unaccounted",
+                                  not r.get("accounted")))
+                if bad) or "no real measurement"
+            print(f"| train_pipeline {r.get('config')} | FAILED: "
+                  f"{str(why)[:120]} | `pipeline_bench.py` | |")
+        else:
+            sec = r.get("sec_per_step")
+            sec_s = f"{sec * 1e3:.2f} ms/step, " if sec is not None else ""
+            print(f"| 1F1B pipeline {r['config']} "
+                  f"({r.get('stages')} stages x {r.get('dp')} replicas, "
+                  f"interleave {r.get('interleave')}, "
+                  f"{r.get('n_microbatches')} microbatches) | "
+                  f"**{r['value']:,} tokens/sec** ({sec_s}bubble "
+                  f"{r.get('bubble_fraction')}, loss within 1 ulp of "
+                  f"PP=1 ({r.get('loss_bitexact_steps')}/{r.get('steps')}"
+                  f" steps bit-exact), {r.get('step_retries')} "
+                  f"stage-fault retry accounted) "
+                  f"| `pipeline_bench.py` | |")
+
     # Pod-scale kill-one-host soak rows: same pass/fail contract as
     # train_soak, plus the elastic rung — the row must have restored the
     # multi-host checkpoint at the reduced geometry (mirrors
@@ -580,6 +624,7 @@ STAGE_FILES = {
     "serve_tenancy": "serve_tenancy.jsonl",
     "train_soak": "train_soak.jsonl",
     "train_soak_multihost": "train_soak_multihost.jsonl",
+    "train_pipeline": "train_pipeline.jsonl",
 }
 
 

@@ -134,6 +134,10 @@ train_soak_multihost_ok() {
   local out; out=$(python tools/bench_gaps.py train_soak_multihost) || return 1
   [ -z "$out" ]
 }
+train_pipeline_ok() {
+  local out; out=$(python tools/bench_gaps.py train_pipeline) || return 1
+  [ -z "$out" ]
+}
 mfu_ok() {
   local out; out=$(python tools/bench_gaps.py mfu) || return 1
   [ -z "$out" ]
@@ -546,6 +550,28 @@ PYEOF
         > bench_results/train_soak_multihost.jsonl 2> bench_results/train_soak_multihost.err
       log "train_soak_multihost rc=$? -> bench_results/train_soak_multihost.jsonl"
     fi
+    if train_pipeline_ok; then
+      log "train_pipeline.jsonl already good; skipping pipeline bench"
+    else
+      # Pipeline-parallel training rung (tpudp/parallel/schedule.py):
+      # the unrolled 1F1B MPMD schedule over lax.ppermute at each
+      # registered pp{P}dp{D}[v{V}] geometry — tokens/sec with the
+      # analytic bubble fraction, loss trajectory refereed against a
+      # single-stage run at equal global batch (within ~1 float32 ulp;
+      # the bit-exact oracle is tests/test_schedule.py), and an
+      # injected stage fault recovered through the voted rollback path;
+      # a config closes only with all three intact — resumes at config
+      # granularity via bench_gaps, like the matrix stage.  Needs the
+      # full 8-chip slice (every registered geometry is P*D = 8); on a
+      # smaller relay the bench emits labeled error rows and the stage
+      # stays open.
+      bank bench_results/train_pipeline.jsonl
+      ensure_window
+      TRAIN_PIPELINE="$(python tools/bench_gaps.py train_pipeline)" \
+        timeout -k "$GRACE" "$(stage_t 1200)" python benchmarks/pipeline_bench.py \
+        > bench_results/train_pipeline.jsonl 2> bench_results/train_pipeline.err
+      log "pipeline_bench rc=$? -> bench_results/train_pipeline.jsonl"
+    fi
     if flash_ok; then
       log "flash.jsonl already good; skipping flash bench"
     else
@@ -579,7 +605,8 @@ PYEOF
         && serve_soak_ok && serve_disagg_ok && serve_prefix_ok \
         && serve_paged_ok \
         && serve_tenancy_ok \
-        && train_soak_ok && train_soak_multihost_ok; then
+        && train_soak_ok && train_soak_multihost_ok \
+        && train_pipeline_ok; then
       log "battery done"
       exit 0
     fi

@@ -43,6 +43,8 @@ AUDIT_SOURCES = (
     "tpudp/train.py",
     "tpudp/parallel/sync.py",
     "tpudp/parallel/ring.py",
+    "tpudp/parallel/pipeline.py",
+    "tpudp/parallel/schedule.py",
     "tpudp/analysis/programs.py",
 )
 
@@ -125,6 +127,13 @@ PROGRAM_DONATIONS = {
     "train.step_dp_allreduce": (0,),
     "train.step_dp_ring": (0,),
     "train.eval_step": (),
+    # MPMD pipeline steps (tpudp/parallel/schedule.py): the TrainState
+    # (params + flat-sharded optimizer shards) donates, like every train
+    # step; tokens/targets are host-fed each call.  The budget ledger
+    # pins each geometry's per-stage ppermute sequence and peak_live.
+    "train.pp_1f1b": (0,),
+    "train.pp_1f1b_int": (0,),
+    "train.pp_eval": (),
 }
 
 # Serve smoke geometry: 2 slots x 32 arena positions, chunk 8, k=3,
@@ -152,6 +161,14 @@ TREE_PARENTS = (-1, 0, 1, 0, 3)
 # Train smoke geometry: a tiny conv-free net over 8x8x3 inputs on the
 # 8-virtual-device CPU mesh the tier-1 suite runs on.
 TRAIN = dict(input=(8, 8, 3), classes=4, batch=8, devices=8)
+# Pipeline smoke geometry (tpudp/parallel/schedule.py): the tiny GPT-2
+# tests/test_schedule.py drives, on PP x DP sub-meshes of the same 8
+# virtual devices.  Each (pp, dp, interleave) triple is its own pinned
+# program — geometry is part of the unrolled schedule's compile key, so
+# each gets its own ppermute sequence and budget ledger in the lock.
+PIPELINE = dict(vocab=64, seq=32, layers=4, heads=2, d_model=32,
+                batch=8, t=16, micro=2,
+                geometries=((2, 2, 1), (4, 2, 1), (2, 2, 2)))
 
 
 def _tiny_lm():
@@ -445,4 +462,42 @@ def build_programs() -> dict:
             make_train_step(model, tx, mesh, sync), (state, images, labels))
     programs[f"train.eval_step@mesh{TRAIN['devices']}"] = (
         make_eval_step(model, mesh), (state, images, labels, weights))
+
+    # -- MPMD pipeline programs (parallel/schedule.py) ------------------
+    import jax
+
+    from tpudp.mesh import make_mesh_nd
+    from tpudp.models.gpt2 import gpt2_small
+    from tpudp.parallel.schedule import (make_pipeline_eval_step,
+                                         make_pipeline_train_step)
+
+    lm = gpt2_small(vocab_size=PIPELINE["vocab"],
+                    max_seq_len=PIPELINE["seq"],
+                    num_layers=PIPELINE["layers"],
+                    num_heads=PIPELINE["heads"],
+                    d_model=PIPELINE["d_model"])
+    lm_tx = make_optimizer(learning_rate=0.01)
+    lm_state = init_state(lm, lm_tx, input_shape=(1, 8))
+    toks = jnp.zeros((PIPELINE["batch"], PIPELINE["t"]), jnp.int32)
+    lm_w = jnp.ones((PIPELINE["batch"],), jnp.float32)
+    eval_geo = None
+    for pp, dp, il in PIPELINE["geometries"]:
+        pp_mesh = make_mesh_nd({"data": dp, "pipe": pp},
+                               devices=jax.devices()[: dp * pp])
+        pp_state, pp_step = make_pipeline_train_step(
+            lm, lm_tx, pp_mesh, lm_state,
+            n_microbatches=PIPELINE["micro"], interleave=il)
+        fam = "train.pp_1f1b_int" if il > 1 else "train.pp_1f1b"
+        geo = (f"pp{pp}dp{dp}m{PIPELINE['micro']}"
+               + (f"v{il}" if il > 1 else "")
+               + f"L{PIPELINE['layers']}")
+        programs[f"{fam}@{geo}"] = (pp_step, (pp_state, toks, toks))
+        if eval_geo is None:
+            # Eval twin once, at the first (smallest) geometry: the
+            # forward-only tick program shares its transport with the
+            # train program, so one pin covers the family.
+            eval_geo = (make_pipeline_eval_step(
+                lm, pp_mesh, pp_state, n_microbatches=PIPELINE["micro"],
+                interleave=il), (pp_state, toks, toks, lm_w))
+            programs[f"train.pp_eval@{geo}"] = eval_geo
     return programs

@@ -29,14 +29,21 @@ bounded by the STAGE count (``2S-1`` microbatch inputs) independent of M,
 recomputing each stage's forward at backward time.  Both match the
 single-device oracle exactly (tests/test_pipeline.py).
 
-Deliberate non-goal: Megatron-style INTERLEAVED 1F1B (virtual stages,
-round-robin chunk placement).  Its bubble win assumes ramp-phase time
-slots cost less than steady-state ones; under ``lax.scan`` every tick
-compiles to the same fixed program, so masked ramp ticks cost full price
-and the interleave would only lengthen the scan (``M + 2(SV-1)`` ticks vs
-``M + 2(S-1)``) without reducing wall time.  Harvesting the interleaved
-bubble on TPU requires a non-uniform (unrolled) schedule whose program
-size grows with M*V — the wrong trade under XLA's compile-once model.
+Deliberate non-goal FOR THE SCAN-BASED SCHEDULES HERE: Megatron-style
+INTERLEAVED 1F1B (virtual stages, round-robin chunk placement).  Its
+bubble win assumes ramp-phase time slots cost less than steady-state
+ones; under ``lax.scan`` every tick compiles to the same fixed program,
+so masked ramp ticks cost full price and the interleave would only
+lengthen the scan (``M + 2(SV-1)`` ticks vs ``M + 2(S-1)``) without
+reducing wall time.  That reasoning is specific to the uniform-tick
+constraint, not to TPU: ``tpudp/parallel/schedule.py`` harvests the
+interleaved bubble by emitting the schedule as an UNROLLED per-tick MPMD
+program (ramp ticks trace no backward, dead chunk slots trace nothing)
+and adds the in-step DP-sharded optimizer update — select it with
+``make_pp_train_step``'s strategy-level twin,
+``build_strategy('pp', ..., schedule='1f1b_mpmd')``.  The scan schedules
+remain the right choice when program size (compile time) matters more
+than the bubble.
 """
 
 from __future__ import annotations

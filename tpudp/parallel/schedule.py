@@ -1,0 +1,763 @@
+"""MPMD pipeline schedules: per-tick 1F1B (+ interleaved virtual stages)
+over ``lax.ppermute`` on a PP x DP mesh, with the optimizer update sharded
+across DP replicas in-step.
+
+Where ``tpudp/parallel/pipeline.py`` expresses its schedules as a uniform
+``lax.scan`` (every tick compiles to the same fixed program, so ramp and
+drain ticks pay full forward+backward price), this module takes the MPMD
+route of "Scaling Deep Learning Training with MPMD Pipeline Parallelism"
+(PAPERS.md, arXiv:2412.14374): the tick table is computed in Python at
+trace time and the schedule is emitted as an UNROLLED per-tick program —
+each tick traces only the work some stage actually performs that tick.
+Ramp ticks carry no backward, drain ticks no forward, and dead virtual-
+stage slots are statically elided, which is exactly the non-uniformity
+that makes interleaved virtual stages (``interleave > 1``) profitable on
+TPU — the trade pipeline.py's module docstring declares out of scope for
+its scan-based schedules.  The price is program size growing with
+``M + 2(S*V - 1)`` ticks; geometry is part of the compile key (and of the
+trace-lock identity), so the program still compiles exactly once per
+geometry (``TRACE_COUNTS`` observes this).
+
+Schedule mechanics (1F1B-with-recompute over C = S*V *virtual* stages,
+chunk ``c`` living on physical stage ``c % S``):
+
+  * Forward of microbatch ``m`` on virtual stage ``p`` runs at tick
+    ``p + m``; activations ride the ICI ring via a forward ``ppermute``
+    (consecutive virtual stages always sit on ring-adjacent devices, the
+    stage-wrap handled by a chunk-axis shift on the last/first device).
+  * Backward of ``m`` on ``p`` runs at tick ``2(C-1) - p + m``; cotangents
+    ride the reverse ring.  Each stage input is stashed in a per-chunk
+    ring buffer of ``min(M, 2C-1)`` slots and the stage forward is
+    recomputed at backward time (1F1B-with-recompute: O(C) activation
+    memory independent of M).
+  * The loss head runs only on the last virtual stage (a ``lax.cond`` so
+    the other stages never trace the vocab matmul); the embedding vjp
+    only on virtual stage 0.  Embedding- and head-side shared-param
+    gradients accumulate in SEPARATE buffers combined once after the
+    loop, so the floating-point reduction order is IDENTICAL across
+    PP degrees — see "bit-exactness" below.
+
+In-step sharded optimizer (``shard_optimizer=True``, the default — the
+cross-replica weight-update sharding of arXiv:2004.13336, upgrading the
+PR 7 manifest-only ZeRO-1 story): after the pipe-axis gradient assembly,
+each gradient leaf is flattened, zero-padded to a multiple of DP, and
+``lax.psum_scatter``-ed over the data axis, so every DP replica reduces
+AND keeps only its 1/DP gradient shard; the optimizer update (momentum,
+weight decay — elementwise transforms only) runs on that shard against a
+1/DP param slice and a 1/DP-resident optimizer state; ``lax.all_gather``
+then reassembles the full parameters for the next forward.  Optimizer
+state is physically sharded over ``data`` (and ``pipe`` for block
+leaves) in the TrainState itself — per-stage checkpoint shards fall out
+of the ordinary global-slice manifest format, and a stage fault takes
+the supervisor's existing voted-rollback path (docs/PIPELINE.md).
+
+Bit-exactness discipline (veScale, arXiv:2509.07003): at equal global
+batch, equal microbatch count, and equal DP degree, the LOSS trajectory
+is BIT-EXACT across PP degrees — the pipeline is pure transport.  This
+holds because (a) each chunk applies its layers as an unrolled Python
+loop, so the per-layer op sequence never depends on the partition;
+(b) every cross-microbatch accumulator adds in microbatch order on every
+geometry; (c) embed/head shared-gradient sums stay separate until one
+final add; and (d) ``ppermute`` moves bits, not arithmetic.  Parameters
+agree to within 1 ulp (XLA fuses a single-layer chunk's backward into a
+different — equally valid — op schedule than a multi-layer chunk's, via
+the residual edges between the recomputed forward and its vjp; an
+``optimization_barrier`` fence on the activation chain does not reach
+those edges, so the last ulp of dW is owned by the compiler, not the
+schedule).  tests/test_schedule.py pins loss trajectories at PP in
+{1,2,4} against the single-stage (PP=1) trainer, including through an
+injected stage fault + voted rollback, and parameter trajectories at
+1-ulp tolerance.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudp.mesh import DATA_AXIS
+from tpudp.parallel.pipeline import (PIPE_AXIS, _map_params_subtrees,
+                                     pipeline_spec_tree)
+
+#: Trace-time compile counter, one bump per (geometry, schedule) trace —
+#: the train-side analogue of tpudp.serve.TRACE_COUNTS: steady-state
+#: steps at a fixed geometry must never re-trace (tests/test_schedule.py
+#: observes the count across steps).
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePartition:
+    """Static placement of a model's block stack onto P pipeline stages.
+
+    With ``interleave == 1`` each stage owns one contiguous run of
+    ``num_layers / stages`` layers (the classic 1F1B partition).  With
+    ``interleave == V > 1`` the stack is cut into ``C = stages * V``
+    chunks of ``num_layers / C`` layers placed round-robin — chunk ``c``
+    on stage ``c % stages`` — so each device hosts V *virtual* stages
+    and the pipeline ramp shrinks from ``(S-1)`` full-stage slots to
+    ``(C-1)`` chunk slots each ``1/V`` the work (Megatron's interleaved
+    schedule, per-tick-programmable here because the MPMD schedule is
+    unrolled, not scanned).
+    """
+
+    num_layers: int
+    stages: int
+    interleave: int = 1
+
+    def __post_init__(self):
+        if self.stages < 1 or self.interleave < 1:
+            raise ValueError(
+                f"stages ({self.stages}) and interleave ({self.interleave}) "
+                "must be >= 1")
+        if self.num_layers % (self.stages * self.interleave):
+            raise ValueError(
+                f"{self.num_layers} layers not divisible into "
+                f"{self.stages} stages x {self.interleave} virtual chunks")
+
+    @property
+    def chunks(self) -> int:
+        """Total virtual-stage count ``C = stages * interleave``."""
+        return self.stages * self.interleave
+
+    @property
+    def layers_per_chunk(self) -> int:
+        return self.num_layers // self.chunks
+
+    def chunk_layers(self, chunk: int) -> tuple[int, ...]:
+        lo = chunk * self.layers_per_chunk
+        return tuple(range(lo, lo + self.layers_per_chunk))
+
+    def chunk_stage(self, chunk: int) -> int:
+        return chunk % self.stages
+
+    def stage_chunks(self, stage: int) -> tuple[int, ...]:
+        return tuple(stage + v * self.stages for v in range(self.interleave))
+
+    def stage_layers(self, stage: int) -> tuple[int, ...]:
+        """Layers hosted by ``stage``, in chunk-major execution order."""
+        return sum((self.chunk_layers(c) for c in self.stage_chunks(stage)),
+                   ())
+
+    def layer_order(self) -> tuple[int, ...]:
+        """Global stacking order, stage-major: sharding the stacked
+        leading axis over ``pipe`` in ``stages`` equal slices hands each
+        stage exactly :meth:`stage_layers`.  Identity for
+        ``interleave == 1`` (checkpoint-manifest compatible with
+        :func:`tpudp.parallel.pipeline.stack_block_params`)."""
+        return sum((self.stage_layers(s) for s in range(self.stages)), ())
+
+    def ticks(self, n_microbatches: int) -> int:
+        """Schedule length: ``M + 2(C-1)`` (ramp + steady 1F1B + drain)."""
+        return n_microbatches + 2 * (self.chunks - 1)
+
+    def bubble_fraction(self, n_microbatches: int) -> float:
+        from tpudp.utils.flops import pipeline_bubble_fraction
+
+        return pipeline_bubble_fraction(self.stages, n_microbatches,
+                                        interleave=self.interleave)
+
+
+def stack_partitioned(params: dict, part: StagePartition,
+                      prefix: str = "h_") -> dict:
+    """Re-layout GPT-2 params into the partition's pipeline layout: one
+    stacked ``blocks`` pytree whose leading axis follows
+    :meth:`StagePartition.layer_order` (so a ``pipe``-axis shard is one
+    stage's chunks, chunk-major), plus the shared params."""
+    blocks = [params[f"{prefix}{i}"] for i in part.layer_order()]
+    out = {k: v for k, v in params.items() if not k.startswith(prefix)}
+    out["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return out
+
+
+def unstack_partitioned(params_pp: dict, part: StagePartition,
+                        prefix: str = "h_") -> dict:
+    """Inverse of :func:`stack_partitioned` (checkpoint interop)."""
+    blocks = params_pp["blocks"]
+    out = {k: v for k, v in params_pp.items() if k != "blocks"}
+    for pos, layer in enumerate(part.layer_order()):
+        out[f"{prefix}{layer}"] = jax.tree.map(lambda x, p=pos: x[p], blocks)
+    return out
+
+
+def _chunk_slice(blocks: Any, part: StagePartition, v: int) -> Any:
+    """Virtual chunk ``v``'s ``(layers_per_chunk, ...)`` slice of this
+    device's ``(interleave * layers_per_chunk, ...)`` local block stack."""
+    lc = part.layers_per_chunk
+    return jax.tree.map(lambda a: a[v * lc:(v + 1) * lc], blocks)
+
+
+def _path_has_blocks(path) -> bool:
+    return "blocks" in [getattr(p, "key", getattr(p, "name", None))
+                        for p in path]
+
+
+def onef1b_mpmd_loss_and_grads(
+    cfg,
+    params: dict,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    part: StagePartition,
+    n_microbatches: int,
+    block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    axis_name: str = PIPE_AXIS,
+) -> tuple[jnp.ndarray, dict]:
+    """The unrolled per-tick 1F1B MPMD program, inside ``shard_map``.
+
+    Runs ``part.ticks(M)`` statically-specialized ticks.  Python decides
+    per tick which virtual-stage slots can be live ANYWHERE on the ring
+    (ramp ticks trace no backward, drain ticks no forward, dead chunk
+    slots trace nothing); the per-device microbatch index within a live
+    slot is the only dynamic quantity, resolved from
+    ``lax.axis_index``.  Returns ``(mean_loss, grads)`` with grads
+    structured like ``params`` — blocks stage-local, shared params as
+    separate embed/head sums combined by ONE final add (the caller's
+    structural psum over the pipe axis supplies the cross-stage terms).
+    """
+    from tpudp.models.gpt2 import embed_tokens, lm_head
+
+    s_size = part.stages
+    v_count = part.interleave
+    c_count = part.chunks
+    m_count = n_microbatches
+    sidx = lax.axis_index(axis_name)
+    b, t = tokens.shape
+    if b % m_count:
+        raise ValueError(f"per-data-shard batch {b} not divisible by "
+                         f"{m_count} microbatches")
+    mb = b // m_count
+    slots = min(m_count, 2 * c_count - 1)
+    blocks = params["blocks"]
+    shared = {k: v for k, v in params.items() if k != "blocks"}
+
+    tok_mb = tokens.reshape(m_count, mb, t)
+    tgt_mb = targets.reshape(m_count, mb, t)
+    fwd_perm = [(j, (j + 1) % s_size) for j in range(s_size)]
+    bwd_perm = [(j, (j - 1) % s_size) for j in range(s_size)]
+
+    def chunk_apply(p_chunk, x):
+        # Unrolled per-layer loop (NOT lax.scan): the per-layer op
+        # sequence is then partition-independent, which is what makes
+        # the loss trajectory bit-exact across PP degrees.
+        for i in range(part.layers_per_chunk):
+            x = block_fn(jax.tree.map(lambda a, i=i: a[i], p_chunk), x)
+        return x
+
+    def head_loss(sh, h, tgts):
+        """Sum (not mean) CE of one microbatch — normalized once at the
+        end so the reduction order is microbatch-major everywhere."""
+        logits = lm_head(cfg, sh, h)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgts).sum()
+
+    act = jax.eval_shape(lambda sh: embed_tokens(cfg, sh, tok_mb[0]), shared)
+    zeros_act = jnp.zeros(act.shape, act.dtype)
+
+    # Static liveness windows per virtual-chunk slot v (any stage live).
+    def fwd_live(v, tick):
+        return v * s_size <= tick <= v * s_size + (s_size - 1) + (m_count - 1)
+
+    def bwd_live(v, tick):
+        lo = 2 * (c_count - 1) - (v * s_size + s_size - 1)
+        hi = 2 * (c_count - 1) - v * s_size + (m_count - 1)
+        return lo <= tick <= hi
+
+    def head_live(tick):  # virtual stage C-1 backs up the tick it forwards
+        return c_count - 1 <= tick <= c_count - 1 + (m_count - 1)
+
+    fwd_in = [zeros_act for _ in range(v_count)]
+    bwd_in = [zeros_act for _ in range(v_count)]
+    stash = [jnp.zeros((slots,) + zeros_act.shape, zeros_act.dtype)
+             for _ in range(v_count)]
+    f32 = lambda tree: jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+    gchunk = [f32(_chunk_slice(blocks, part, v)) for v in range(v_count)]
+    gembed = f32(shared)
+    ghead = f32(shared)
+    loss_sum = jnp.zeros((), jnp.float32)
+
+    for tick in range(part.ticks(m_count)):
+        # ---- forward slots -------------------------------------------
+        ys = {}
+        for v in range(v_count):
+            if not fwd_live(v, tick):
+                continue
+            m_f = tick - v * s_size - sidx
+            f_active = (m_f >= 0) & (m_f < m_count)
+            m_f_c = jnp.clip(m_f, 0, m_count - 1)
+            if v == 0:
+                toks_f = lax.dynamic_index_in_dim(tok_mb, m_f_c, 0,
+                                                  keepdims=False)
+                x = jnp.where(sidx == 0, embed_tokens(cfg, shared, toks_f),
+                              fwd_in[0])
+            else:
+                x = fwd_in[v]
+            slot = m_f_c % slots
+            prev = lax.dynamic_index_in_dim(stash[v], slot, 0, keepdims=False)
+            stash[v] = lax.dynamic_update_index_in_dim(
+                stash[v], jnp.where(f_active, x, prev), slot, 0)
+            ys[v] = chunk_apply(_chunk_slice(blocks, part, v), x)
+
+        # ---- backward slots ------------------------------------------
+        dxs = {}
+        for v in range(v_count):
+            if not bwd_live(v, tick):
+                continue
+            m_b = tick - 2 * (c_count - 1) + v * s_size + sidx
+            b_active = (m_b >= 0) & (m_b < m_count)
+            m_b_c = jnp.clip(m_b, 0, m_count - 1)
+            slot = m_b_c % slots
+            x_b = lax.dynamic_index_in_dim(stash[v], slot, 0, keepdims=False)
+            tgts_b = lax.dynamic_index_in_dim(tgt_mb, m_b_c, 0,
+                                              keepdims=False)
+
+            if v == v_count - 1 and head_live(tick):
+                # Last virtual stage: loss + head cotangent from THIS
+                # tick's forward output.  lax.cond so the other stages
+                # never trace the (mb, t, vocab) head matmul + pullback.
+                def _head(operands):
+                    sh, h, tg = operands
+                    loss_mb, head_vjp = jax.vjp(
+                        lambda sh_, h_: head_loss(sh_, h_, tg), sh, h)
+                    dsh, dy_h = head_vjp(jnp.ones((), loss_mb.dtype))
+                    return loss_mb, dsh, dy_h
+
+                def _head_zero(operands):
+                    sh, h, _tg = operands
+                    return (jnp.zeros((), jnp.float32),
+                            jax.tree.map(jnp.zeros_like, sh),
+                            jnp.zeros_like(h))
+
+                loss_mb, dsh_head, dy_head = lax.cond(
+                    (sidx == s_size - 1) & b_active, _head, _head_zero,
+                    (shared, ys[v_count - 1], tgts_b))
+                dy = jnp.where(sidx == s_size - 1, dy_head, bwd_in[v])
+                ghead = jax.tree.map(lambda a, g: a + g, ghead, dsh_head)
+                loss_sum = loss_sum + loss_mb
+            else:
+                dy = bwd_in[v]
+            dy = jnp.where(b_active, dy, jnp.zeros_like(dy))
+
+            # Recompute this chunk's forward from the stashed input.
+            _, chunk_vjp = jax.vjp(chunk_apply,
+                                   _chunk_slice(blocks, part, v), x_b)
+            dchunk, dx = chunk_vjp(dy)
+            gchunk[v] = jax.tree.map(lambda a, g: a + g, gchunk[v], dchunk)
+            dxs[v] = dx
+
+            if v == 0 and tick >= 2 * (c_count - 1):
+                # Virtual stage 0: the input cotangent becomes embedding
+                # grads (its own accumulator — see module docstring).
+                toks_b = lax.dynamic_index_in_dim(tok_mb, m_b_c, 0,
+                                                  keepdims=False)
+
+                def _embed(operands):
+                    sh, tk, d = operands
+                    _, embed_vjp = jax.vjp(
+                        lambda sh_: embed_tokens(cfg, sh_, tk), sh)
+                    (dsh,) = embed_vjp(d)
+                    return dsh
+
+                def _embed_zero(operands):
+                    sh, _tk, _d = operands
+                    return jax.tree.map(jnp.zeros_like, sh)
+
+                dsh_embed = lax.cond((sidx == 0) & b_active, _embed,
+                                     _embed_zero, (shared, toks_b, dx))
+                gembed = jax.tree.map(lambda a, g: a + g, gembed, dsh_embed)
+
+        if s_size == 1:
+            # Single stage: the ring is a self-loop and nothing is ever
+            # read from the carries — elide the collectives entirely.
+            continue
+
+        # ---- ring transport to tick+1 --------------------------------
+        if any(fwd_live(v, tick + 1) for v in range(v_count)):
+            ystack = jnp.stack([ys.get(v, zeros_act)
+                                for v in range(v_count)])
+            # Chunk wrap: the last device's chunk v feeds the first
+            # device's chunk v+1 (virtual stage vS+S-1 -> vS+S).
+            shifted = jnp.concatenate(
+                [jnp.zeros_like(ystack[:1]), ystack[:-1]], axis=0)
+            moved = lax.ppermute(
+                jnp.where(sidx == s_size - 1, shifted, ystack),
+                axis_name, fwd_perm)
+            fwd_in = [moved[v] for v in range(v_count)]
+        if any(bwd_live(v, tick + 1) for v in range(v_count)):
+            dstack = jnp.stack([dxs.get(v, zeros_act)
+                                for v in range(v_count)])
+            # Reverse wrap: the first device's chunk v+1 cotangent feeds
+            # the last device's chunk v (virtual stage vS+S <- vS+S-1).
+            shifted = jnp.concatenate(
+                [dstack[1:], jnp.zeros_like(dstack[:1])], axis=0)
+            moved = lax.ppermute(
+                jnp.where(sidx == 0, shifted, dstack),
+                axis_name, bwd_perm)
+            bwd_in = [moved[v] for v in range(v_count)]
+
+    lc_axis = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *gchunk) \
+        if v_count > 1 else gchunk[0]
+    denom = jnp.asarray(b * t, jnp.float32)  # sum -> mean normalization
+    gshared = jax.tree.map(lambda e, h: e + h, gembed, ghead)
+    grads = {**{k: jax.tree.map(lambda g: g / denom, v)
+                for k, v in gshared.items()},
+             "blocks": jax.tree.map(lambda g: g / denom, lc_axis)}
+    grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+    return loss_sum / denom, grads
+
+
+def pipeline_forward_mpmd(
+    cfg,
+    params: dict,
+    tokens: jnp.ndarray,
+    part: StagePartition,
+    n_microbatches: int,
+    block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    axis_name: str = PIPE_AXIS,
+) -> jnp.ndarray:
+    """Forward-only MPMD ticks (``M + C - 1``): the eval twin of
+    :func:`onef1b_mpmd_loss_and_grads`.  Returns the ``(M, mb, t, d)``
+    last-virtual-stage hidden states — valid only on the last physical
+    stage (zeros elsewhere); callers mask and psum like
+    :func:`tpudp.parallel.pipeline.gpipe` consumers do."""
+    from tpudp.models.gpt2 import embed_tokens
+
+    s_size = part.stages
+    v_count = part.interleave
+    c_count = part.chunks
+    m_count = n_microbatches
+    sidx = lax.axis_index(axis_name)
+    b, t = tokens.shape
+    mb = b // m_count
+    blocks = params["blocks"]
+    shared = {k: v for k, v in params.items() if k != "blocks"}
+    tok_mb = tokens.reshape(m_count, mb, t)
+    fwd_perm = [(j, (j + 1) % s_size) for j in range(s_size)]
+
+    def chunk_apply(p_chunk, x):
+        for i in range(part.layers_per_chunk):
+            x = block_fn(jax.tree.map(lambda a, i=i: a[i], p_chunk), x)
+        return x
+
+    act = jax.eval_shape(lambda sh: embed_tokens(cfg, sh, tok_mb[0]), shared)
+    zeros_act = jnp.zeros(act.shape, act.dtype)
+
+    def fwd_live(v, tick):
+        return v * s_size <= tick <= v * s_size + (s_size - 1) + (m_count - 1)
+
+    fwd_in = [zeros_act for _ in range(v_count)]
+    outs = jnp.zeros((m_count,) + zeros_act.shape, zeros_act.dtype)
+
+    for tick in range(m_count + c_count - 1):
+        ys = {}
+        for v in range(v_count):
+            if not fwd_live(v, tick):
+                continue
+            m_f = tick - v * s_size - sidx
+            f_active = (m_f >= 0) & (m_f < m_count)
+            m_f_c = jnp.clip(m_f, 0, m_count - 1)
+            if v == 0:
+                toks_f = lax.dynamic_index_in_dim(tok_mb, m_f_c, 0,
+                                                  keepdims=False)
+                x = jnp.where(sidx == 0, embed_tokens(cfg, shared, toks_f),
+                              fwd_in[0])
+            else:
+                x = fwd_in[v]
+            ys[v] = chunk_apply(_chunk_slice(blocks, part, v), x)
+            if v == v_count - 1 and c_count - 1 <= tick:
+                # Last virtual stage emits microbatch m_f on the last
+                # physical stage once the pipe has filled.
+                write = (sidx == s_size - 1) & f_active
+                prev = lax.dynamic_index_in_dim(outs, m_f_c, 0,
+                                                keepdims=False)
+                outs = lax.dynamic_update_index_in_dim(
+                    outs, jnp.where(write, ys[v], prev), m_f_c, 0)
+
+        if s_size == 1:
+            continue
+        if any(fwd_live(v, tick + 1) for v in range(v_count)):
+            ystack = jnp.stack([ys.get(v, zeros_act)
+                                for v in range(v_count)])
+            shifted = jnp.concatenate(
+                [jnp.zeros_like(ystack[:1]), ystack[:-1]], axis=0)
+            moved = lax.ppermute(
+                jnp.where(sidx == s_size - 1, shifted, ystack),
+                axis_name, fwd_perm)
+            fwd_in = [moved[v] for v in range(v_count)]
+
+    return outs
+
+
+def _pad_to(n: int, k: int) -> int:
+    return k * math.ceil(n / k) if k > 1 else n
+
+
+def _opt_shard_layout(subtree: dict, part: StagePartition, dp: int) -> dict:
+    """Host-side re-layout of one params-shaped optimizer subtree (e.g.
+    the SGD momentum trace) into the in-step-sharded layout: pipeline-
+    stacked, then per leaf flattened and zero-padded to a multiple of
+    ``dp`` — per STAGE for block leaves (so a ``(pipe, data)`` sharding
+    of the flat axis hands each (stage, replica) device its own
+    contiguous 1/DP slice), whole-leaf for shared leaves."""
+    pp = stack_partitioned(subtree, part)
+
+    def one(path, x):
+        if _path_has_blocks(path):
+            per_stage = x.reshape(part.stages, -1)
+            n = per_stage.shape[1]
+            pad = _pad_to(n, dp) - n
+            if pad:
+                per_stage = jnp.pad(per_stage, ((0, 0), (0, pad)))
+            return per_stage.reshape(-1)
+        flat = x.reshape(-1)
+        pad = _pad_to(flat.size, dp) - flat.size
+        return jnp.pad(flat, (0, pad)) if pad else flat
+
+    return jax.tree_util.tree_map_with_path(one, pp)
+
+
+def _opt_shard_specs(subtree: dict, part: StagePartition,
+                     pipe_axis: str, data_axis: str | None) -> dict:
+    """Spec twin of :func:`_opt_shard_layout` (structure only)."""
+    pp = jax.eval_shape(lambda t: stack_partitioned(t, part), subtree)
+
+    def one(path, _x):
+        if _path_has_blocks(path):
+            return (P((pipe_axis, data_axis)) if data_axis is not None
+                    else P(pipe_axis))
+        return P(data_axis) if data_axis is not None else P()
+
+    return jax.tree_util.tree_map_with_path(one, pp)
+
+
+def make_pipeline_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state,
+    *,
+    n_microbatches: int,
+    interleave: int = 1,
+    data_axis: str | None = DATA_AXIS,
+    pipe_axis: str = PIPE_AXIS,
+    donate: bool = True,
+    remat: bool = False,
+    shard_optimizer: bool = True,
+) -> tuple[Any, Callable]:
+    """The 1F1B MPMD train step for tpudp.models.gpt2.GPT2: unrolled
+    per-tick schedule (``interleave`` virtual stages per device) on a
+    PP x DP mesh, with the optimizer update sharded across DP replicas
+    in-step when ``shard_optimizer=True`` (reduce-scatter grads → shard
+    update → allgather params; requires an elementwise ``tx`` — the
+    make_optimizer SGD/AdamW chains qualify).
+
+    Takes a standard (single-device-layout) TrainState and returns
+    ``(pp_state, step_fn)`` with ``step_fn(state, tokens, targets) ->
+    (state, loss)`` — the framework-wide contract, so the Trainer drives
+    it unchanged.  ``pp_state`` holds params in the partition's stacked
+    layout (blocks sharded over ``pipe``) and — under
+    ``shard_optimizer`` — optimizer state as flat 1/DP shards over
+    ``data`` (block leaves additionally over ``pipe``).
+    """
+    from tpudp.models.gpt2 import Block
+
+    cfg = getattr(model, "config", None)
+    if cfg is None or not hasattr(cfg, "num_layers"):
+        raise TypeError(
+            "make_pipeline_train_step drives tpudp.models.gpt2.GPT2 (a "
+            f"model with a GPT2Config at .config); got "
+            f"{type(model).__name__}")
+    if cfg.attn_impl == "ring" or cfg.mlp_impl != "dense":
+        raise ValueError(
+            "pipeline parallelism supports dense/flash attention and dense "
+            f"MLP blocks; got attn_impl={cfg.attn_impl!r} "
+            f"mlp_impl={cfg.mlp_impl!r}")
+    s = mesh.shape[pipe_axis]
+    part = StagePartition(cfg.num_layers, s, interleave)
+    missing = [f"h_{i}" for i in range(cfg.num_layers)
+               if f"h_{i}" not in state.params]
+    if missing:
+        raise ValueError(
+            f"params are missing block subtrees {missing[:3]}... — expected "
+            f"the GPT-2 layout h_0..h_{cfg.num_layers - 1}")
+    dp = mesh.shape[data_axis] if data_axis is not None else 1
+
+    pp_params = stack_partitioned(state.params, part)
+    params_struct = jax.tree.structure(state.params)
+    if shard_optimizer:
+        pp_opt = _map_params_subtrees(
+            state.opt_state, params_struct,
+            lambda sub: _opt_shard_layout(sub, part, dp))
+        opt_specs = _map_params_subtrees(
+            state.opt_state, params_struct,
+            lambda sub: _opt_shard_specs(sub, part, pipe_axis, data_axis))
+    else:
+        pp_opt = _map_params_subtrees(
+            state.opt_state, params_struct,
+            lambda sub: stack_partitioned(sub, part))
+        opt_specs = _map_params_subtrees(
+            state.opt_state, params_struct,
+            lambda sub: pipeline_spec_tree(
+                jax.eval_shape(lambda t: stack_partitioned(t, part), sub),
+                pipe_axis))
+    # Non-params optimizer leaves (schedule counts etc.) stay replicated.
+    opt_specs = jax.tree.map(
+        lambda x: x if isinstance(x, P) else P(), opt_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    pp_state = state.replace(params=pp_params, opt_state=pp_opt)
+    pp_state_specs = pp_state.replace(
+        step=P(),
+        params=pipeline_spec_tree(pp_params, pipe_axis),
+        batch_stats=jax.tree.map(lambda _: P(), pp_state.batch_stats),
+        opt_state=opt_specs,
+        loss_sum=P(),
+        obs_norms=P() if pp_state.obs_norms is not None else None,
+    )
+
+    block_fn = lambda p, x: Block(cfg).apply({"params": p}, x)
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def body(st, tokens, targets):
+        TRACE_COUNTS["pp_1f1b"] += 1
+        loss, grads = onef1b_mpmd_loss_and_grads(
+            cfg, st.params, tokens, targets, part, n_microbatches, block_fn,
+            pipe_axis)
+        # Shared-param grads live on the stages that produced them ->
+        # structural psum over pipe; block grads are stage-local.
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: g if "blocks" in jax.tree_util.keystr(path)
+            else lax.psum(g, pipe_axis),
+            grads)
+        loss = lax.psum(loss, pipe_axis)
+        if data_axis is not None:
+            loss = lax.psum(loss, data_axis) / dp
+
+        if shard_optimizer:
+            didx = (lax.axis_index(data_axis) if data_axis is not None
+                    else jnp.zeros((), jnp.int32))
+
+            def scatter_grad(g):
+                flat = g.reshape(-1)
+                pad = _pad_to(flat.size, dp) - flat.size
+                if pad:
+                    flat = jnp.pad(flat, (0, pad))
+                if data_axis is None or dp == 1:
+                    return flat
+                return lax.psum_scatter(flat, data_axis,
+                                        scatter_dimension=0,
+                                        tiled=True) / dp
+
+            def param_shard(x):
+                flat = x.reshape(-1)
+                pad = _pad_to(flat.size, dp) - flat.size
+                if pad:
+                    flat = jnp.pad(flat, (0, pad))
+                if data_axis is None or dp == 1:
+                    return flat
+                n = flat.shape[0] // dp
+                return lax.dynamic_slice(flat, (didx * n,), (n,))
+
+            g_sh = jax.tree.map(scatter_grad, grads)
+            p_sh = jax.tree.map(param_shard, st.params)
+            updates, new_opt = tx.update(g_sh, st.opt_state, p_sh)
+            new_p_sh = optax.apply_updates(p_sh, updates)
+
+            def regather(ps, old):
+                full = (lax.all_gather(ps, data_axis, axis=0, tiled=True)
+                        if data_axis is not None and dp > 1 else ps)
+                return full[:old.size].reshape(old.shape).astype(old.dtype)
+
+            new_params = jax.tree.map(regather, new_p_sh, st.params)
+        else:
+            if data_axis is not None and dp > 1:
+                grads = jax.tree.map(
+                    lambda g: lax.psum(g, data_axis) / dp, grads)
+            updates, new_opt = tx.update(grads, st.opt_state, st.params)
+            new_params = optax.apply_updates(st.params, updates)
+
+        return st.replace(
+            step=st.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            loss_sum=st.loss_sum + loss,
+        ), loss
+
+    tok_spec = P(data_axis) if data_axis is not None else P()
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pp_state_specs, tok_spec, tok_spec),
+        out_specs=(pp_state_specs, P()),
+        check_vma=False,
+    )
+    step = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+    placed = jax.device_put(
+        pp_state,
+        jax.tree.map(lambda sp: NamedSharding(mesh, sp), pp_state_specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+    return placed, step
+
+
+def make_pipeline_eval_step(
+    model,
+    mesh: Mesh,
+    state,
+    *,
+    n_microbatches: int,
+    interleave: int = 1,
+    data_axis: str | None = DATA_AXIS,
+    pipe_axis: str = PIPE_AXIS,
+):
+    """Eval twin for the MPMD schedule: ``(state, tokens, targets,
+    weights) -> (loss_sum, correct, count)`` per the Trainer eval
+    contract.  ``state`` must already be in the partition layout (the
+    output of :func:`make_pipeline_train_step`)."""
+    from tpudp.models.gpt2 import Block, lm_head
+
+    cfg = model.config
+    s = mesh.shape[pipe_axis]
+    part = StagePartition(cfg.num_layers, s, interleave)
+    block_fn = lambda p, x: Block(cfg).apply({"params": p}, x)
+
+    def body(st, tokens, targets, weights):
+        b, t = tokens.shape
+        h = pipeline_forward_mpmd(cfg, st.params, tokens, part,
+                                  n_microbatches, block_fn, pipe_axis)
+        h = h.reshape(b, t, cfg.d_model)
+        logits = lm_head(cfg, st.params, h)
+        per = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        w = jnp.broadcast_to(weights[:, None], per.shape)
+        # Only the last stage saw real pipeline outputs; zero elsewhere
+        # so the structural psum over pipe yields the true totals.
+        mask = (lax.axis_index(pipe_axis) == s - 1).astype(per.dtype)
+        loss_sum = mask * (per * w).sum()
+        correct = mask * ((jnp.argmax(logits, -1) == targets) * w).sum()
+        count = mask * w.sum()
+        axes = (pipe_axis,) if data_axis is None else (pipe_axis, data_axis)
+        return (lax.psum(loss_sum, axes), lax.psum(correct, axes),
+                lax.psum(count, axes))
+
+    # Eval reads params only; optimizer shards ride through untouched, so
+    # the spec tree must mirror the train step's state layout exactly.
+    state_specs = jax.tree.map(
+        lambda x: x.sharding.spec, state,
+        is_leaf=lambda x: hasattr(x, "sharding"))
+    tok_spec = P(data_axis) if data_axis is not None else P()
+    return jax.jit(jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(state_specs, tok_spec, tok_spec, tok_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    ))

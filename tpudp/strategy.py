@@ -22,7 +22,12 @@ Mesh axis requirements (build the mesh with tpudp.mesh.make_mesh_nd):
   ``tp``        ``data`` x ``model``         ``rules`` (partition rules)
   ``fsdp``      ``data``                     ``min_size``
   ``zero1``     ``data``                     ``min_size``
-  ``pp``        [``data`` x] ``pipe``        ``n_microbatches``, ``remat``
+  ``pp``        [``data`` x] ``pipe``        ``n_microbatches``, ``remat``,
+                                             ``schedule`` (``gpipe`` /
+                                             ``1f1b`` / ``1f1b_mpmd``);
+                                             under ``1f1b_mpmd`` also
+                                             ``interleave``,
+                                             ``shard_optimizer``
   ``ep``        ``data`` x ``expert``        ``aux_loss_coef``
   ``sp``        ``data`` x ``seq``           —
   ============  ===========================  ==========================
@@ -157,8 +162,7 @@ def _build_zero1(model, tx, mesh, state, donate, options):
 
 
 def _build_pp(model, tx, mesh, state, donate, options):
-    from tpudp.parallel.pipeline import (PIPE_AXIS, make_pp_eval_step,
-                                         make_pp_train_step)
+    from tpudp.parallel.pipeline import PIPE_AXIS
 
     n_microbatches = options.pop("n_microbatches")
     pipe_axis = options.pop("pipe_axis", PIPE_AXIS)
@@ -166,15 +170,39 @@ def _build_pp(model, tx, mesh, state, donate, options):
         "data_axis", DATA_AXIS if DATA_AXIS in mesh.shape else None)
     remat = options.pop("remat", False)
     schedule = options.pop("schedule", "gpipe")
-    _no_extra(options, "pp")
-    st, step = make_pp_train_step(model, tx, mesh, state,
-                                  n_microbatches=n_microbatches,
-                                  data_axis=data_axis, pipe_axis=pipe_axis,
-                                  donate=donate, remat=remat,
-                                  schedule=schedule)
-    eval_step = make_pp_eval_step(model, mesh, st,
-                                  n_microbatches=n_microbatches,
-                                  data_axis=data_axis, pipe_axis=pipe_axis)
+    if schedule == "1f1b_mpmd":
+        # The unrolled MPMD schedule (tpudp/parallel/schedule.py): per-tick
+        # programs, interleaved virtual stages, in-step sharded optimizer.
+        from tpudp.parallel.schedule import (make_pipeline_eval_step,
+                                             make_pipeline_train_step)
+
+        interleave = options.pop("interleave", 1)
+        shard_optimizer = options.pop("shard_optimizer", True)
+        _no_extra(options, "pp")
+        st, step = make_pipeline_train_step(
+            model, tx, mesh, state, n_microbatches=n_microbatches,
+            interleave=interleave, data_axis=data_axis,
+            pipe_axis=pipe_axis, donate=donate, remat=remat,
+            shard_optimizer=shard_optimizer)
+        eval_step = make_pipeline_eval_step(
+            model, mesh, st, n_microbatches=n_microbatches,
+            interleave=interleave, data_axis=data_axis,
+            pipe_axis=pipe_axis)
+    else:
+        from tpudp.parallel.pipeline import (make_pp_eval_step,
+                                             make_pp_train_step)
+
+        _no_extra(options, "pp")
+        st, step = make_pp_train_step(model, tx, mesh, state,
+                                      n_microbatches=n_microbatches,
+                                      data_axis=data_axis,
+                                      pipe_axis=pipe_axis,
+                                      donate=donate, remat=remat,
+                                      schedule=schedule)
+        eval_step = make_pp_eval_step(model, mesh, st,
+                                      n_microbatches=n_microbatches,
+                                      data_axis=data_axis,
+                                      pipe_axis=pipe_axis)
     spec = P(data_axis) if data_axis is not None else P()
     return BuiltStrategy(st, step, eval_step,
                          _leading_axis_sharder(mesh, spec))

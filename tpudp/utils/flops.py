@@ -139,6 +139,28 @@ def train_step_flops(fwd_flops: int) -> int:
     return 3 * fwd_flops
 
 
+def pipeline_bubble_fraction(stages: int, n_microbatches: int,
+                             interleave: int = 1) -> float:
+    """Idle fraction of a 1F1B pipeline schedule: ``(P-1)/(M+P-1)`` for
+    ``P`` stages and ``M`` microbatches — the fill/drain slots no
+    microbatch occupies.  ``interleave=V`` virtual stages per device cut
+    each ramp slot to ``1/V`` of a stage's work (Megatron's interleaved
+    schedule): ``(P-1)/(V*M + P-1)``.
+
+    Reported alongside MFU for pipeline bench rows so they are
+    comparable to DP rows: a PP row's achievable MFU ceiling is
+    ``(1 - bubble) * dp_mfu``, making a bubble-bound row distinguishable
+    from a kernel-bound one.  Degenerates to 0.0 at a single stage.
+    """
+    if stages < 1 or n_microbatches < 1 or interleave < 1:
+        raise ValueError(
+            f"stages ({stages}), n_microbatches ({n_microbatches}) and "
+            f"interleave ({interleave}) must all be >= 1")
+    if stages == 1:
+        return 0.0
+    return (stages - 1) / (interleave * n_microbatches + stages - 1)
+
+
 def xla_cost_flops(jitted_fn, *args) -> float | None:
     """XLA's own FLOPs estimate for a jitted function at these args — an
     independent cross-check of the analytic counts above (the two differ
