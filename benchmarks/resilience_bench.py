@@ -71,8 +71,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tools.bench_gaps import (TRAIN_SOAK_MULTIHOST_SEEDS,  # noqa: E402
-                              TRAIN_SOAK_SEEDS)
+from tools.bench_gaps import (SDC_SOAK_SEEDS,  # noqa: E402
+                              TRAIN_SOAK_MULTIHOST_SEEDS, TRAIN_SOAK_SEEDS)
 
 
 def _cfg() -> dict:
@@ -795,6 +795,113 @@ def run_soak(seed: int, workdir: str) -> dict:
     }
 
 
+def run_sdc_soak(seed: int, workdir: str) -> dict:
+    """Silent-corruption soak (metric ``sdc_soak``): three IN-PROCESS
+    fits over the same data grid — the SDC response never kills the
+    process, so no subprocess choreography is needed.
+
+      1. clean: fingerprint checks on, NO injected faults — the
+         false-positive gate (``clean_ok``: checks ran, zero
+         detections);
+      2. transient: a one-shot ``BitFlipParams`` flips one bit on one
+         replica at a seed-chosen step — the vote must LOCALIZE that
+         replica, grade it transient (the deterministic re-execution is
+         clean), and the final params must be **bit-identical** to the
+         clean run (``parity_ok``);
+      3. persistent: ``BitFlipParams(persist_from=...)`` re-corrupts on
+         every call — the supervisor must raise ``SdcPersistentError``
+         and drop the quarantine marker (``quarantine_ok``).
+
+    The flip site (step, replica, bit) is seed-jittered but always a
+    low mantissa bit: the checksum is a bitcast sum, so ANY flipped bit
+    trips it — the jitter varies WHERE, never WHETHER.
+    """
+    rng = random.Random(seed * 6007 + 11)
+    if "jax" not in sys.modules and "XLA_FLAGS" not in os.environ \
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    import numpy as np
+
+    if len(jax.devices()) < 2:
+        return {"seed": seed, "error":
+                "sdc soak needs >=2 devices for a replica vote (CPU "
+                "smoke: JAX_PLATFORMS=cpu + "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=4)"}
+    from tests.small_model import SmallConv
+    from tpudp.data.cifar10 import _synthetic
+    from tpudp.data.loader import DataLoader
+    from tpudp.mesh import make_mesh
+    from tpudp.resilience import ResiliencePolicy
+    from tpudp.sdc import QUARANTINE_MARKER, BitFlipParams, SdcPersistentError
+    from tpudp.train import Trainer
+
+    def loader():
+        ds = _synthetic(64, seed=3)
+        return DataLoader(ds, 16, train=True, seed=2, backend="numpy")
+
+    def trainer(hook=None):
+        return Trainer(SmallConv(), make_mesh(), log_every=2,
+                       log_fn=lambda s: None, track_sdc_fingerprint=True,
+                       sdc_fault_hook=hook)
+
+    def params_bytes(tr):
+        return b"".join(np.asarray(x).tobytes()
+                        for x in jax.tree_util.tree_leaves(tr.state.params))
+
+    def run(subdir, hook=None):
+        d = os.path.join(workdir, f"sdc_{seed}_{subdir}")
+        os.makedirs(d, exist_ok=True)
+        tr = trainer(hook=hook)
+        tr.fit(loader(), epochs=2,
+               resilience=ResiliencePolicy(checkpoint_dir=d,
+                                           sdc_check_every=2))
+        return tr, d
+
+    # 1. clean — the false-positive gate.
+    tr0, _ = run("clean")
+    clean = params_bytes(tr0)
+    clean_ok = (tr0.stats["sdc_checks"] > 0
+                and tr0.stats["sdc_detections"] == 0)
+
+    # 2. one-shot flip: detect, localize, repair bit-identical.
+    flip = (rng.randrange(2, 6), rng.randrange(1, len(jax.devices())),
+            rng.choice((3, 5, 7, 11)))
+    inj = BitFlipParams([flip])
+    tr1, _ = run("transient", hook=inj)
+    det = [e for e in tr1.stats["events"] if e["kind"] == "sdc_detected"]
+    localized = bool(det) and det[0].get("replicas") == [f"p0/d{flip[1]}"]
+    detect_ok = (len(inj.fired) == 1
+                 and tr1.stats["sdc_detections"] == 1
+                 and tr1.stats["sdc_transients"] == 1 and localized)
+    parity_ok = params_bytes(tr1) == clean
+
+    # 3. persistent flip: graded response escalates to quarantine.
+    inj2 = BitFlipParams(persist_from=rng.randrange(2, 5),
+                         replica=rng.randrange(1, len(jax.devices())),
+                         bit=rng.choice((3, 5, 7, 11)))
+    quarantine_ok = False
+    try:
+        tr2, d3 = run("persistent", hook=inj2)
+    except SdcPersistentError:
+        d3 = os.path.join(workdir, f"sdc_{seed}_persistent")
+        quarantine_ok = os.path.exists(os.path.join(d3, QUARANTINE_MARKER))
+    detections = (tr0.stats["sdc_detections"] + tr1.stats["sdc_detections"]
+                  + (1 if quarantine_ok else 0))
+    return {
+        "metric": "sdc_soak", "seed": seed, "value": detections,
+        "unit": "detections", "clean_ok": clean_ok, "parity_ok": parity_ok,
+        "quarantine_ok": quarantine_ok,
+        "accounted": detect_ok and quarantine_ok,
+        "sdc_checks": tr0.stats["sdc_checks"],
+        "transients": tr1.stats["sdc_transients"],
+        "flip": list(flip),
+        "device_kind": jax.devices()[0].device_kind,
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--worker", action="store_true",
@@ -808,14 +915,21 @@ def main() -> None:
                          "mid-epoch, byte-flip one host's shard, relaunch "
                          "at the same and at a reduced host geometry "
                          "(seeds via --soak / env TRAIN_SOAK_MULTIHOST)")
+    ap.add_argument("--sdc", action="store_true",
+                    help="run the silent-data-corruption soak instead: "
+                         "clean / one-shot-flip / persistent-flip fits "
+                         "in-process (seeds via --soak / env SDC_SOAK)")
     ap.add_argument("--workdir", type=str, default=None,
                     help="scratch root (default: a fresh temp dir)")
     args = ap.parse_args()
     if args.worker:
         raise SystemExit(_worker())
-    registry = (TRAIN_SOAK_MULTIHOST_SEEDS if args.multihost
+    registry = (SDC_SOAK_SEEDS if args.sdc
+                else TRAIN_SOAK_MULTIHOST_SEEDS if args.multihost
                 else TRAIN_SOAK_SEEDS)
-    env_name = "TRAIN_SOAK_MULTIHOST" if args.multihost else "TRAIN_SOAK"
+    env_name = ("SDC_SOAK" if args.sdc
+                else "TRAIN_SOAK_MULTIHOST" if args.multihost
+                else "TRAIN_SOAK")
     soak_env = args.soak or os.environ.get(env_name)
     if soak_env is not None and not soak_env.strip():
         return  # the gap helper said: nothing missing
@@ -830,8 +944,11 @@ def main() -> None:
         import tempfile
 
         workdir = tempfile.mkdtemp(prefix="tpudp_train_soak_")
-    runner = run_soak_multihost if args.multihost else run_soak
-    metric = "train_soak_multihost" if args.multihost else "train_soak"
+    runner = (run_sdc_soak if args.sdc
+              else run_soak_multihost if args.multihost else run_soak)
+    metric = ("sdc_soak" if args.sdc
+              else "train_soak_multihost" if args.multihost
+              else "train_soak")
     for seed in seeds:
         try:
             row = runner(seed, workdir)

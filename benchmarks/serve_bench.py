@@ -1255,10 +1255,15 @@ def main() -> None:
         (quarantine), two device steps are injected to fail
         (requeue-once containment), and a PREEMPTION STORM of scheduled
         high-priority bursts evicts low-tier slots through the tenancy
-        carry-over path.  The row passes only if nothing wedged
-        (bounded step count), the engine ended empty, and every
-        surviving COMPLETE request's greedy output — storm and
-        preempted requests included — is bit-identical to generate()."""
+        carry-over path — all with the SDC canary cadence ON
+        (``canary_every_s``), so pinned-reference replays interleave
+        with the chaos.  The row passes only if nothing wedged (bounded
+        step count), the engine ended empty, every surviving COMPLETE
+        request's greedy output — storm and preempted requests included
+        — is bit-identical to generate(), and the canaries ran CLEAN
+        (``canary_ok``: >=1 comparison, zero quarantines — the serving
+        false-positive gate; faults, preemptions, and requeues must
+        never read as corruption)."""
         from tpudp.serve import FinishReason
         from tpudp.serve.faults import (FailingDrafter, FaultySteps,
                                         PreemptionStorm)
@@ -1290,6 +1295,7 @@ def main() -> None:
             drafter=FailingDrafter(inner=NgramDrafter(),
                                    ok_proposals=int(srng.integers(1, 8))),
             drafter_timeout_s=30.0, step_fault_hook=hook,
+            canary_every_s=0.02, canary_new_tokens=4,
             tenants={"default": TenantClass(priority=0, queue_limit=6),
                      "urgent": TenantClass(priority=1)})
         # Request mix by kind: 0 -> impossible TTFT deadline (expires
@@ -1330,6 +1336,13 @@ def main() -> None:
             eng.step()
             steps += 1
             storm.tick(eng, steps)
+            if submitted >= n and storm.done:
+                # Workload fully in: stop LAUNCHING canaries (else the
+                # cadence keeps a slot busy and the drain never ends)
+                # but keep the comparison path live for the in-flight
+                # one — a huge interval, not None, so its completion is
+                # still checked against the pinned reference.
+                eng.canary_every_s = 1e9
             for i, h in enumerate(handles):
                 if (h is not None and not h.done and i in cancel_at
                         and len(h.tokens) >= cancel_at[i]):
@@ -1430,6 +1443,10 @@ def main() -> None:
         parity_ok = parity_ok and transfer_parity
         no_leak = no_leak and transfer_no_leak
         wedged = wedged or transfer_wedged
+        canary_runs = int(eng.stats["canary_runs"])
+        canary_quarantines = int(eng.stats["canary_mismatch"])
+        canary_ok = (canary_runs >= 1 and canary_quarantines == 0
+                     and not eng.quarantined)
         emit({
             "metric": SOAK_METRIC,
             "seed": soak_seed,
@@ -1449,6 +1466,9 @@ def main() -> None:
             "preempted": int(eng.stats["preempted"]),
             "step_failures": int(eng.stats["step_failures"]),
             "drafter_quarantined": int(eng.stats["drafter_quarantined"]),
+            "canary_runs": canary_runs,
+            "canary_quarantines": canary_quarantines,
+            "canary_ok": canary_ok,
             "transfer_faults": len(d_faults),
             "transfer_quarantined": int(transfer_quarantined),
             "transfer_retries": int(transfer_retries),

@@ -268,6 +268,11 @@ def test_error_row_skeleton():
     assert "git_rev" in row
 
 
+# Demoted to slow (PR 20 durations audit): the matrix row schema and
+# gap/history logic are covered fast by tests/test_bench_tools.py and
+# tools/record_bench.py's render test; the end-to-end subprocess run
+# stays in the slow tier and the TPU matrix stage.
+@pytest.mark.slow
 def test_matrix_bench_rows_parse():
     # Two configs, not three (r4 #8): part1_single covers the
     # single-device row shape, dp_ring covers the DP row shape + the
@@ -294,6 +299,10 @@ def test_matrix_bench_rows_parse():
     assert configs["dp_ring"]["ring_direction"] == "uni"
 
 
+# Demoted to slow (PR 20 durations audit): prefix-cache semantics are
+# covered fast by tests/test_prefix_cache.py and the serve_prefix gap
+# gate by tests/test_bench_tools.py; the subprocess smoke runs slow-tier.
+@pytest.mark.slow
 def test_serve_prefix_bench_rows_parse():
     """The serve_prefix stage's CPU smoke (tier-1's guard on the bench
     path the TPU watcher resumes): both registered workloads emit a
@@ -673,6 +682,10 @@ def test_serve_fused_bench_rows_parse():
     assert "decode_fuse" in (bad.stderr + bad.stdout)
 
 
+# Demoted to slow (PR 20 durations audit): the obs exposition contract
+# is covered fast by tests/test_obs.py and the sidecar/gap logic by
+# tests/test_bench_tools.py; the A/B subprocess row runs slow-tier.
+@pytest.mark.slow
 def test_serve_bench_obs_check_row_and_sidecar(tmp_path):
     """The tpudp.obs exposition contract on the bench: --obs-check
     emits the spans+counters-on vs off A/B row (the acceptance bar is
@@ -984,6 +997,11 @@ def test_serve_disagg_gap_gate(tmp_path):
     assert serve_disagg_missing(d) == [2]  # banked history row counts
 
 
+# Demoted to slow (PR 20 durations audit): the fault/resume machinery is
+# covered fast by tests/test_resilience.py and tests/test_sdc.py, the
+# gap gate by tests/test_bench_tools.py; the FULL 2-kill menu already
+# runs slow-tier as test_train_soak_full_menu.
+@pytest.mark.slow
 def test_train_soak_bench_row_parses():
     """The train_soak stage's CPU smoke (tier-1's guard on the kill/
     resume soak the TPU watcher resumes): a reduced 1-kill plan (loader

@@ -345,3 +345,58 @@ def test_analysis_gap_stage(tmp_path):
     # must agree
     (tmp_path / "benchmarks").rmdir()
     assert analysis_missing(str(tmp_path)) == ["lint", "audit", "budget"]
+
+
+def test_sdc_soak_gap_gate(tmp_path):
+    """A seed closes only on a TPU row where every verdict column holds:
+    clean fit raised nothing, the one-shot flip was detected/localized/
+    graded with the persistent flip quarantined, and the repaired params
+    matched the clean run bit-exactly.  Any single False keeps the seed
+    open — a soak that proved less than the full story must be rerun."""
+    from tools.bench_gaps import SDC_SOAK_SEEDS, sdc_soak_missing
+
+    d = str(tmp_path)
+    assert sdc_soak_missing(d) == list(SDC_SOAK_SEEDS)
+    ok = {"metric": "sdc_soak", "value": 2, "clean_ok": True,
+          "parity_ok": True, "accounted": True, "quarantine_ok": True,
+          "device_kind": "TPU v4"}
+    _write(os.path.join(d, "sdc_soak.jsonl"), [
+        dict(ok, seed=0),
+        dict(ok, seed=1, device_kind="cpu"),        # CPU smoke: open
+        dict(ok, seed=2, parity_ok=False),          # repair not bit-exact
+    ])
+    assert sdc_soak_missing(d) == [1, 2]
+    # banked history closes seeds the current file lacks
+    _write(os.path.join(d, "sdc_soak.history.jsonl"), [dict(ok, seed=1)])
+    assert sdc_soak_missing(d) == [2]
+    # every other verdict column gates too
+    for bad in ({"clean_ok": False}, {"accounted": False},
+                {"quarantine_ok": False}, {"value": 0},
+                {"error": "wedged", "value": None}):
+        _write(os.path.join(d, "sdc_soak.jsonl"), [dict(ok, seed=2, **bad)])
+        assert 2 in sdc_soak_missing(d), bad
+    _write(os.path.join(d, "sdc_soak.jsonl"),
+           [dict(ok, seed=0), dict(ok, seed=2)])
+    assert sdc_soak_missing(d) == []
+
+
+def test_tier1_headroom_gap(tmp_path):
+    """tier1-headroom fires only when the LAST summary in tier1.log
+    burned past TIER1_WARN_S; earlier (slower) runs in the same log are
+    history, and a missing log or summary is advisory — not a gap."""
+    from tools.bench_gaps import (TIER1_BUDGET_S, TIER1_WARN_S,
+                                  tier1_headroom_missing)
+
+    d = str(tmp_path)
+    assert TIER1_WARN_S < TIER1_BUDGET_S
+    assert tier1_headroom_missing(d) == []          # no log: advisory
+    log = os.path.join(d, "tier1.log")
+    with open(log, "w") as f:
+        f.write("collected 560 items\nnothing like a summary here\n")
+    assert tier1_headroom_missing(d) == []          # no summary line
+    with open(log, "a") as f:
+        f.write("558 passed, 2 skipped in 830.12s\n")
+    assert tier1_headroom_missing(d) == ["tier1-headroom"]
+    with open(log, "a") as f:                       # later, faster rerun
+        f.write("== 560 passed in 641.07s ==\n")
+    assert tier1_headroom_missing(d) == []

@@ -203,3 +203,118 @@ def test_multihost_sidecars_leave_with_the_dump(tmp_path):
     assert not os.path.exists(ck.host_manifest_path(step, 0))
     assert not os.path.exists(ck.commit_marker_path(step))
     ck.restore_checkpoint(step, state, verify=True)
+
+
+# -- transient-filesystem retry (tpudp/utils/checkpoint.py::_retry_fs) --
+
+
+class _FlakyFS:
+    """Flaky-fs injector: the first ``failures`` calls raise
+    ``OSError(errno_)``, then the wrapped callable runs for real."""
+
+    def __init__(self, fn, failures, errno_):
+        self.fn = fn
+        self.failures = failures
+        self.errno_ = errno_
+        self.calls = 0
+
+    def __call__(self, *a, **kw):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise OSError(self.errno_, "injected transient fault")
+        return self.fn(*a, **kw)
+
+
+def test_retry_fs_recovers_from_transient_eio(monkeypatch):
+    """EIO (a shared-FS blip) is retried with backoff and the call
+    succeeds once the FS heals — the save/restore seam never surfaces a
+    transient error the retry budget could have absorbed."""
+    import errno
+
+    from tpudp.utils import checkpoint as ck
+
+    monkeypatch.setattr(ck, "FS_BACKOFF_S", 0.0)
+    flaky = _FlakyFS(lambda: 7, failures=ck.FS_RETRIES, errno_=errno.EIO)
+    assert ck._retry_fs(flaky, "probe") == 7
+    assert flaky.calls == ck.FS_RETRIES + 1
+
+
+def test_retry_fs_budget_is_bounded(monkeypatch):
+    """A path that stays broken must become the caller's loud error
+    after exactly FS_RETRIES + 1 attempts — bounded by construction,
+    never a silent spin."""
+    import errno
+
+    import pytest
+
+    from tpudp.utils import checkpoint as ck
+
+    monkeypatch.setattr(ck, "FS_BACKOFF_S", 0.0)
+    flaky = _FlakyFS(lambda: 7, failures=99, errno_=errno.ESTALE)
+    with pytest.raises(OSError) as ei:
+        ck._retry_fs(flaky, "probe")
+    assert ei.value.errno == errno.ESTALE
+    assert flaky.calls == ck.FS_RETRIES + 1
+
+
+def test_retry_fs_non_transient_propagates_immediately(monkeypatch):
+    """ENOENT is a CORRECTNESS signal (wrong path, deleted step dir),
+    not weather — retrying it would mask the bug and burn the backoff
+    budget where no retry can succeed."""
+    import errno
+
+    import pytest
+
+    from tpudp.utils import checkpoint as ck
+
+    monkeypatch.setattr(ck, "FS_BACKOFF_S", 0.0)
+    flaky = _FlakyFS(lambda: 7, failures=99, errno_=errno.ENOENT)
+    with pytest.raises(FileNotFoundError):
+        ck._retry_fs(flaky, "probe")
+    assert flaky.calls == 1
+
+
+def test_save_restore_ride_through_flaky_fs(tmp_path, monkeypatch):
+    """End-to-end through the real seams: the orbax save and restore
+    calls each eat injected EIO blips (strictly fewer than the budget)
+    and the roundtrip completes bit-exactly — the retry wrapper wraps
+    the actual checkpointer calls, not just a helper."""
+    import errno
+
+    from tpudp.utils import checkpoint as ck
+
+    monkeypatch.setattr(ck, "FS_BACKOFF_S", 0.0)
+    state = {"w": np.arange(8.0), "b": np.ones(3, np.float32)}
+    path = str(tmp_path / "step_5")
+
+    real_ckptr = ck._checkpointer
+    blips = {"save": 2, "restore": 1}
+
+    def flaky_ckptr():
+        real = real_ckptr()
+
+        class _Proxy:
+            def save(self, *a, **kw):
+                if blips["save"]:
+                    blips["save"] -= 1
+                    raise OSError(errno.EIO, "injected EIO on save")
+                return real.save(*a, **kw)
+
+            def restore(self, *a, **kw):
+                if blips["restore"]:
+                    blips["restore"] -= 1
+                    raise OSError(errno.EIO, "injected EIO on restore")
+                return real.restore(*a, **kw)
+
+            def __getattr__(self, k):
+                return getattr(real, k)
+
+        return _Proxy()
+
+    monkeypatch.setattr(ck, "_checkpointer", flaky_ckptr)
+    ck.save_checkpoint(path, state)
+    assert blips["save"] == 0
+    got = ck.restore_checkpoint(path, state, verify=True)
+    assert blips["restore"] == 0
+    np.testing.assert_array_equal(got["w"], state["w"])
+    np.testing.assert_array_equal(got["b"], state["b"])

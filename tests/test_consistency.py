@@ -77,3 +77,41 @@ def test_fingerprint_differs_on_divergence(mesh8):
     other = {"w": _replicated_from([jnp.ones((8,)) * 2] * n, mesh8)}
     assert not np.array_equal(fingerprint(same), fingerprint(other))
     assert np.array_equal(fingerprint(same), fingerprint(same))
+
+
+def test_fingerprint_coverage_has_no_holes():
+    """Leaf-coverage regression for the corruption detector: every leaf
+    of the REAL TrainState — with the SDC fingerprint slot allocated —
+    must land in ``included`` (its bytes are in the fingerprint) or
+    ``excluded_sharded`` (covered by per-host shard manifests instead).
+    A new TrainState field silently falling into ``excluded_non_array``
+    is a HOLE in the detector, not an implementation detail."""
+    from tests.small_model import SmallConv
+    from tpudp.train import init_state, make_optimizer
+    from tpudp.utils.consistency import fingerprint_coverage
+
+    state = init_state(SmallConv(), make_optimizer(), track_sdc=True)
+    cov = fingerprint_coverage(state)
+    assert cov["excluded_non_array"] == [], (
+        "TrainState leaves invisible to the SDC fingerprint: "
+        f"{cov['excluded_non_array']}")
+    assert cov["included"], "nothing fingerprinted at all"
+    # the slots the detector depends on are all covered
+    got = set(cov["included"]) | set(cov["excluded_sharded"])
+    for needle in (".step", ".sdc_fp"):
+        assert any(p.startswith(needle) for p in got), needle
+    assert any("params" in p for p in cov["included"])
+    assert any("opt_state" in p for p in cov["included"])
+
+
+def test_fingerprint_coverage_classifies_non_arrays(mesh8):
+    """The classifier itself: a host numpy leaf is excluded_non_array, a
+    replicated jax.Array is included — the rule the coverage test above
+    relies on to catch holes."""
+    from tpudp.utils.consistency import fingerprint_coverage
+
+    tree = {"dev": jnp.ones((4,)), "host": np.ones((4,))}
+    cov = fingerprint_coverage(tree)
+    assert [p for p in cov["included"] if "dev" in p]
+    assert [p for p in cov["excluded_non_array"] if "host" in p]
+    assert cov["excluded_sharded"] == []

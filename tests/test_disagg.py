@@ -793,3 +793,198 @@ def test_two_process_round_quarantine_and_parity(tmp_path):
     assert dumps, os.listdir(flights[1]) if os.path.isdir(
         flights[1]) else "no flight dir"
     assert r1["flight_dumps"] >= 1 and r0["flight_dumps"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Canary quarantine -> live evacuation (the serving SDC response)
+# ---------------------------------------------------------------------------
+
+
+def test_canary_quarantine_evacuates_live_requests_bit_exact(
+        model_and_params):
+    """The full serving SDC response: a canary-only bit flip condemns
+    decode host 1 (no loud signal anywhere), the cluster quarantines the
+    rank and EVACUATES its live requests — journal-style fresh tickets
+    (tokens + per-slot PRNG chain), pages stripped, receivers
+    re-prefill — and every output, greedy AND sampled, finishes
+    bit-identical to a clean cluster.  Nothing exported from the suspect
+    engine's device memory is trusted."""
+    from tpudp.serve.faults import BitFlipLogits
+
+    model, params = model_and_params
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 61, size=4).astype(np.int32)
+               for _ in range(4)]
+
+    def mk(canary_hook=None):
+        # host 0 prefill; hosts 1-2 decode with the canary cadence armed
+        engs = [
+            Engine(model, params, num_slots=4, max_len=32,
+                   prefill_chunk=8),
+            Engine(model, params, num_slots=4, max_len=32,
+                   prefill_chunk=8, canary_every_s=0.0,
+                   canary_new_tokens=4, token_fault_hook=canary_hook),
+            Engine(model, params, num_slots=4, max_len=32,
+                   prefill_chunk=8, canary_every_s=0.0,
+                   canary_new_tokens=4),
+        ]
+        return engs, DisaggCluster(engs)
+
+    def run(cluster):
+        hs = [cluster.submit(prompts[0], 10),
+              cluster.submit(prompts[1], 10),
+              cluster.submit(prompts[2], 10, temperature=0.8, top_k=7,
+                             seed=5),
+              cluster.submit(prompts[3], 10, temperature=0.8, top_p=0.9,
+                             seed=9)]
+        cluster.run_until_complete()
+        return [h.result() for h in hs]
+
+    _, clean = mk()
+    want = run(clean)
+    assert not clean.quarantined
+
+    # flip bit 3 of the canary's 2nd-run token 1 (call 5 = 4 reference
+    # tokens + 1); canary_only=True leaves user traffic untouched — the
+    # ONLY signal is the canary byte-compare
+    inj = BitFlipLogits([(5, None, 3)], vocab=61, canary_only=True)
+    engs, cl = mk(canary_hook=inj)
+    got = run(cl)
+    assert cl.quarantined == {1}
+    assert engs[1].quarantined and engs[1].quarantine_reason
+    assert inj.fired and inj.fired[0][0] == 5
+    evac = [e for e in cl.events if e["kind"] == "evacuate"]
+    assert evac and all(e["from"] == 1 for e in evac)
+    assert sum(e.stats["evacuation_resumes"] for e in engs) == len(evac)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    # the condemned rank no longer takes placements; survivors leak-free
+    assert 1 not in cl.decode_ranks()
+    cl.check()
+
+
+def test_quarantined_engine_excluded_from_placement(model_and_params):
+    """decode_ranks must skip a canary-quarantined engine immediately —
+    new admissions and rebalances never land on a condemned host."""
+    model, params = model_and_params
+    engs = [_paged(model, params, num_slots=4, kv_pages=24)
+            for _ in range(3)]
+    cl = DisaggCluster(engs)
+    assert cl.decode_ranks() == [1, 2]
+    engs[1]._quarantined = True
+    assert cl.decode_ranks() == [2]
+
+
+# ---------------------------------------------------------------------------
+# Watchdog-armed round phases
+# ---------------------------------------------------------------------------
+
+
+class _RecordingWatchdog:
+    """Stands in for tpudp.utils.watchdog.Watchdog: records which named
+    regions DisaggHost.round arms, without deadlines."""
+
+    def __init__(self):
+        self.names = []
+
+    def step(self, timeout_s=None, name="step"):
+        import contextlib
+
+        self.names.append(name)
+        return contextlib.nullcontext()
+
+
+_PHASES = ["disagg.migrate_offer", "disagg.transfer", "disagg.adopt",
+           "disagg.release"]
+
+
+def test_round_arms_watchdog_phases_in_order(model_and_params):
+    """Every migration round arms one named deadline per protocol phase
+    — migrate_offer, transfer, adopt, release, in protocol order — so a
+    hang report names WHERE the handshake wedged instead of a generic
+    step timeout.  Idle rounds arm too: the rendezvous sequence is
+    identical whether or not this host has bytes to send."""
+    from tpudp.serve.disagg import DisaggHost
+
+    model, params = model_and_params
+    wd = _RecordingWatchdog()
+    h = DisaggHost(_paged(model, params), rank=0, n_hosts=1, watchdog=wd)
+    assert h.round(done=True)
+    assert wd.names == _PHASES
+    assert h.round(done=True)
+    assert wd.names == _PHASES * 2
+
+
+def test_round_phases_armed_through_torn_transfer(model_and_params,
+                                                  monkeypatch):
+    """The arming composes with the failure path: a sender SIGKILLed
+    mid-offer delivers a torn blob (the SenderKilledMidOffer wire
+    image), the receiver quarantines it inside the armed adopt phase
+    WITHOUT leaving the round, and all four phases still arm in order —
+    the with-blocks unwind cleanly, no deadline is leaked armed."""
+    import tpudp.serve.disagg as dg
+    from tpudp.serve.disagg import DisaggHost
+
+    model, params = model_and_params
+    # a real staged ticket from a sender host, torn in half mid-send
+    sender = DisaggHost(_paged(model, params, num_slots=4, kv_pages=24),
+                        rank=1, n_hosts=2)
+    r = sender.engine.submit(np.arange(4, dtype=np.int32), 6)
+    while not r.tokens:
+        sender.engine.step()
+    sender.stage(0, r)
+    blob = sender.outbox_blob()
+    torn = blob[: len(blob) // 2]
+
+    wd = _RecordingWatchdog()
+    h = DisaggHost(_paged(model, params, num_slots=4, kv_pages=24),
+                   rank=0, n_hosts=2, watchdog=wd)
+
+    calls = {"n": 0}
+
+    def fake_blob_gather(b):
+        calls["n"] += 1
+        if calls["n"] == 1:  # transfer phase: peer's blob arrives torn
+            return [bytes(b), torn]
+        return [bytes(b), dg._pack_acks(1, [], 0)]  # release phase
+
+    monkeypatch.setattr(dg, "gather_host_values", lambda v: [int(v)] * 2)
+    monkeypatch.setattr(dg, "gather_host_blobs", fake_blob_gather)
+    monkeypatch.setattr(dg, "all_hosts_ok",
+                        lambda ok, value=0: bool(ok))
+
+    assert h.round(done=True)
+    assert wd.names == _PHASES
+    assert h.engine.stats["quarantined_transfers"] == 1
+    assert h.engine.stats["migrated_in"] == 0
+    h.engine.check_paged()
+
+
+def test_round_hang_raises_named_phase(model_and_params, monkeypatch):
+    """kill=False watchdog + a wedged transfer gather: the recorded
+    hang and the StepHangError raised at the next armed region must
+    NAME disagg.transfer — the phase that actually wedged."""
+    import time as _time
+
+    import tpudp.serve.disagg as dg
+    from tpudp.serve.disagg import DisaggHost
+    from tpudp.utils.watchdog import StepHangError, Watchdog
+
+    model, params = model_and_params
+    real_gather = dg.gather_host_blobs
+
+    def wedged_gather(b):
+        _time.sleep(0.3)
+        return real_gather(b)
+
+    monkeypatch.setattr(dg, "gather_host_blobs", wedged_gather)
+    wd = Watchdog(timeout_s=0.05, kill=False, poll_s=0.01).start()
+    try:
+        h = DisaggHost(_paged(model, params), rank=0, n_hosts=1,
+                       watchdog=wd)
+        with pytest.raises(StepHangError) as ei:
+            h.round(done=True)
+        assert "disagg.transfer" in str(ei.value)
+        assert (wd.last_hang or {}).get("region") == "disagg.transfer"
+    finally:
+        wd.stop()

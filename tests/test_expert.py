@@ -82,7 +82,12 @@ def test_moe_gpt2_trains():
     assert losses[-1] < losses[0]  # learning
 
 
-@pytest.mark.parametrize("dp,ep", [(2, 2), (1, 4)])
+@pytest.mark.parametrize("dp,ep", [
+    (2, 2),
+    # (1,4) demoted to slow (PR 20 durations audit): (2,2) keeps the
+    # mixed dp×ep oracle fast; router semantics are pinned separately.
+    pytest.param(1, 4, marks=pytest.mark.slow),
+])
 def test_ep_matches_dense_oracle(dp, ep):
     mesh = make_mesh_nd({"data": dp, "expert": ep},
                         devices=jax.devices()[: dp * ep])

@@ -233,6 +233,10 @@ def test_paged_sampled_parity(model_and_params):
     assert tokens_of(12, True) == dense    # paged, table-write hit
 
 
+# Demoted to slow (PR 20 durations audit): spec-over-paged parity is
+# covered fast by tests/test_spec_fused.py::test_fused_spec_paged_parity
+# and the tests/test_speculate.py parity suite.
+@pytest.mark.slow
 def test_paged_speculation_parity(model_and_params):
     """Speculative verify windows read/write through the tables (the
     window may cross a page boundary — the host preallocates) and stay

@@ -33,7 +33,10 @@ def test_stack_unstack_roundtrip():
 
 @pytest.mark.parametrize("dp,pp,micro", [
     pytest.param(1, 4, 2, marks=pytest.mark.slow),
-    (2, 4, 4),
+    # (2,4,4) demoted to slow (PR 20 durations audit): pipeline.py is
+    # the reference scan-based implementation since PR 19 — the
+    # production 1F1B MPMD path is pinned fast by tests/test_schedule.py.
+    pytest.param(2, 4, 4, marks=pytest.mark.slow),
     pytest.param(1, 2, 1, marks=pytest.mark.slow),
 ])
 def test_pp_matches_single_device_trajectory(dp, pp, micro):
@@ -71,6 +74,10 @@ def test_pp_matches_single_device_trajectory(dp, pp, micro):
         want, got)
 
 
+# Demoted to slow (PR 20 durations audit): reference implementation;
+# the production schedule's resume/momentum behaviour is covered fast by
+# test_schedule.py and tests/test_resilience.py rollback paths.
+@pytest.mark.slow
 def test_pp_preserves_resumed_momentum():
     """A mid-training state handed to make_pp_train_step keeps its SGD
     momentum: the pipelined continuation matches the single-device one."""
@@ -130,7 +137,9 @@ def test_pp_remat_matches_plain():
 
 @pytest.mark.parametrize("dp,pp,micro", [
     pytest.param(1, 4, 2, marks=pytest.mark.slow),
-    (2, 4, 4),
+    # (2,4,4) demoted to slow (PR 20 durations audit): same cover as
+    # above — test_schedule.py pins the production MPMD trajectory fast.
+    pytest.param(2, 4, 4, marks=pytest.mark.slow),
     pytest.param(1, 2, 8, marks=pytest.mark.slow),
 ])
 def test_1f1b_matches_single_device_trajectory(dp, pp, micro):

@@ -364,6 +364,11 @@ def test_program_donations_mirror_rules_tables():
         # and is donation-free).
         "train.pp_1f1b": "train_step",
         "train.pp_1f1b_int": "train_step",
+        # SDC-fingerprint twins (ISSUE 20): the SAME train step with
+        # the TrainState's sdc_fp slot allocated — the checksum reads
+        # post-update VALUES, so the donation facts are unchanged.
+        "train.step_single_sdc": "train_step",
+        "train.step_dp_allreduce_sdc": "train_step",
     }
     for prog, callee in mirror.items():
         assert PROGRAM_DONATIONS[prog] == DONATING[callee], (

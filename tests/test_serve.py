@@ -260,6 +260,10 @@ def test_sample_tokens_masks():
     np.testing.assert_array_equal(all_greedy, greedy)
 
 
+# Demoted to slow (PR 20 durations audit): the combined top_k+top_p
+# sampling semantics are covered fast by
+# tests/test_generate.py::test_top_k_and_top_p_sampling.
+@pytest.mark.slow
 def test_combined_top_k_top_p_composes_like_truncate_logits():
     """top_k THEN nucleus-over-the-renormalized-distribution — the same
     composition as generate()'s _truncate_logits.  Pinned with the case

@@ -30,8 +30,9 @@ from tpudp.models.generate import generate
 from tpudp.models.gpt2 import gpt2_small
 from tpudp.serve import (Engine, EngineClosed, FinishReason, NgramDrafter,
                          QueueFull, RequestFailed)
-from tpudp.serve.faults import (FailingDrafter, FaultySteps, InjectedFault,
-                                MalformedDrafter, SlowDrafter, SlowSteps)
+from tpudp.serve.faults import (BitFlipLogits, FailingDrafter, FaultySteps,
+                                InjectedFault, MalformedDrafter, SlowDrafter,
+                                SlowSteps)
 from tpudp.train import init_state, make_optimizer
 from tpudp.utils.watchdog import Watchdog
 
@@ -534,12 +535,12 @@ def test_serve_soak_bench_gap_gate(tmp_path):
     assert serve_soak_missing(d) == list(SERVE_SOAK_SEEDS)
     rows = [
         {"metric": "serve_soak", "seed": 0, "value": 9,
-         "parity_ok": True, "no_leak": True,
+         "parity_ok": True, "no_leak": True, "canary_ok": True,
          "device_kind": "cpu"},                        # smoke: no
         {"metric": "serve_soak", "seed": 1,
          "error": "relay wedged"},                     # error: no
         {"metric": "serve_soak", "seed": 2, "value": 9,
-         "parity_ok": False, "no_leak": True,
+         "parity_ok": False, "no_leak": True, "canary_ok": True,
          "device_kind": "TPU v5 lite"},                # failed soak: no
     ]
     with open(os.path.join(d, "serve_soak.jsonl"), "w") as f:
@@ -549,6 +550,143 @@ def test_serve_soak_bench_gap_gate(tmp_path):
     with open(os.path.join(d, "serve_soak.history.jsonl"), "w") as f:
         f.write(json.dumps(
             {"metric": "serve_soak", "seed": 1, "value": 11,
-             "parity_ok": True, "no_leak": True,
+             "parity_ok": True, "no_leak": True, "canary_ok": True,
              "device_kind": "TPU v5 lite"}) + "\n")
     assert serve_soak_missing(d) == [0, 2]  # banked passing row counts
+    # canary false-positive gate: a quarantine during the clean soak
+    # (canary_ok false) keeps the seed open even with parity + no_leak
+    with open(os.path.join(d, "serve_soak.jsonl"), "a") as f:
+        f.write(json.dumps(
+            {"metric": "serve_soak", "seed": 2, "value": 9,
+             "parity_ok": True, "no_leak": True, "canary_ok": False,
+             "device_kind": "TPU v5 lite"}) + "\n")
+    assert serve_soak_missing(d) == [0, 2]
+
+
+# -- SDC canaries (silent corruption on the serving path) --------------
+
+
+def _canary_engine(model, params, **kw):
+    kw.setdefault("canary_every_s", 0.0)
+    kw.setdefault("canary_new_tokens", 4)
+    return _engine(model, params, **kw)
+
+
+def test_canary_pins_reference_and_runs_clean(model_and_params):
+    """Greedy decode is deterministic, so the first clean canary run IS
+    the oracle: later runs byte-compare against it.  A healthy engine
+    under real traffic must pin the reference, keep re-running, and
+    never quarantine — while user outputs stay bit-exact."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    p = rng.integers(0, 61, size=4).astype(np.int32)
+    eng = _canary_engine(model, params)
+    hs = [eng.submit(p, 5) for _ in range(3)]
+    eng.run_until_complete()
+    for _ in range(40):
+        eng.step()
+    m = eng.metrics()["canary"]
+    assert m["runs"] >= 2 and m["ref_pinned"]
+    assert m["mismatch"] == 0 and not m["quarantined"]
+    want = _reference(model, params, p, 5)[0, p.size:]
+    for h in hs:
+        assert h.finish_reason is FinishReason.COMPLETE
+        np.testing.assert_array_equal(want, np.asarray(h.tokens))
+
+
+def test_canary_pairs_never_emitted(model_and_params):
+    """Canary traffic is the engine's own probe: its (request, token)
+    pairs must never reach the emitted stream a server loop forwards
+    to clients."""
+    model, params = model_and_params
+    eng = _canary_engine(model, params)
+    emitted = []
+    for _ in range(60):
+        emitted += eng.step()
+    assert eng.metrics()["canary"]["runs"] >= 1
+    assert all(not getattr(r, "_canary", False) for r, _ in emitted)
+
+
+def test_canary_mismatch_quarantines_and_parks_live_work(
+        model_and_params):
+    """A canary-only bit flip (invisible to every loud detector — no
+    raise, no NaN, no counter) must: quarantine the engine with a
+    reason naming the first divergent token, stop admission with a
+    typed error, make step() a no-op, and PARK live requests unfinished
+    so the cluster can migrate them out — never finish them on the
+    condemned engine."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    p = rng.integers(0, 61, size=4).astype(np.int32)
+    # call 5 = token 1 of the SECOND canary run (4 tokens each): run 1
+    # pins the reference, run 2 diverges — and because the corrupted
+    # token conditions later decode steps, downstream tokens shift too.
+    inj = BitFlipLogits([(5, None, 3)], vocab=61, canary_only=True)
+    eng = _canary_engine(model, params, token_fault_hook=inj)
+    live = eng.submit(p, 20, seed=7)
+    for _ in range(200):
+        if eng.quarantined:
+            break
+        eng.step()
+    assert eng.quarantined
+    m = eng.metrics()["canary"]
+    assert m["mismatch"] == 1 and m["quarantined"]
+    assert "canary" in eng.quarantine_reason
+    assert inj.fired and inj.fired[0][0] == 5
+    assert live.finish_reason is None and eng.slots_in_use >= 1
+    with pytest.raises(EngineClosed):
+        eng.submit(p, 3)
+    assert eng.step() == []
+
+
+def test_canary_loud_failure_is_error_not_corruption(model_and_params):
+    """A canary that fails LOUDLY (deadline, error) is an availability
+    event, not corruption evidence: counted canary_errors, engine stays
+    in service."""
+    model, params = model_and_params
+    hook = FaultySteps(fail_at=set(range(1, 200)))  # every step raises
+    eng = _canary_engine(model, params, step_fault_hook=hook)
+    for _ in range(30):
+        eng.step()
+    m = eng.metrics()["canary"]
+    assert m["errors"] >= 1 and m["mismatch"] == 0
+    assert not eng.quarantined
+
+
+def test_canary_config_validation(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="canary_every_s"):
+        _engine(model, params, canary_every_s=-1.0)
+    with pytest.raises(ValueError, match="canary_new_tokens"):
+        _engine(model, params, canary_every_s=1.0, canary_new_tokens=0)
+
+
+def test_bitflip_logits_schedule_determinism():
+    """The serving injector mirrors the training injectors' pinned
+    determinism: calls index ELIGIBLE commits only (canary_only skips
+    user traffic WITHOUT counting, so a canary schedule is stable no
+    matter how much real traffic interleaves), a schedule entry fires
+    once, and the corrupted token is always in-vocab and different."""
+
+    class _R:
+        pass
+
+    canary = _R()
+    canary._canary = True
+    user = _R()
+    inj = BitFlipLogits([(1, None, 3)], vocab=61, canary_only=True)
+    assert inj(0, 7, user) == 7          # user commit: not counted
+    assert inj(0, 7, canary) == 7        # eligible call 0: no match
+    out = inj(2, 7, canary)              # eligible call 1: fires
+    assert out != 7 and 0 <= out < 61
+    assert inj.fired == [(1, 2, 7, out)]
+    assert inj(2, 7, canary) == 7        # schedule exhausted
+    # vocab fallback: a flip that would leave the vocabulary drops to
+    # lower bits until the corrupt token is decodable
+    inj2 = BitFlipLogits([(0, None, 6)], vocab=61)
+    got = inj2(0, 60, object())
+    assert got != 60 and 0 <= got < 61
+    with pytest.raises(ValueError):
+        BitFlipLogits([(-1, None, 0)])
+    with pytest.raises(ValueError):
+        BitFlipLogits([(0, None, 0)], vocab=1)

@@ -170,6 +170,10 @@ def test_greedy_parity_eos_mid_window(model_and_params):
     assert h.done and q.done and len(q.tokens) == 3
 
 
+# Demoted to slow (PR 20 durations audit): the budget-clamp edge is
+# exercised fast by the remaining speculate parity tests and
+# tests/test_spec_fused.py at the same k>budget geometry.
+@pytest.mark.slow
 def test_greedy_parity_k_longer_than_budget(model_and_params):
     """speculate_k larger than a request's whole budget: emitted tokens
     beyond max_new_tokens are dropped, the rest match exactly."""

@@ -182,6 +182,22 @@ TRAIN_SOAK_SEEDS = (0, 1, 2)
 # what the soak certifies — the coordination protocol — is
 # platform-independent.
 TRAIN_SOAK_MULTIHOST_SEEDS = (0, 1, 2)
+# Silent-data-corruption soak seeds (resilience_bench.py --sdc: a clean
+# fit with in-step replica fingerprints on, a one-shot injected bit
+# flip, and a persistent flip, against tpudp/sdc.py + the supervisor's
+# graded response) that must PASS on the TPU — a seed is closed only by
+# a row where the clean fit raised ZERO detections (clean_ok: the
+# false-positive gate), the one-shot flip was detected, localized to
+# the injected replica, and repaired BIT-IDENTICAL to the clean run
+# (accounted + parity_ok), and the persistent flip escalated to the
+# quarantine marker (quarantine_ok); same registry contract.
+SDC_SOAK_SEEDS = (0, 1, 2)
+# Tier-1 wall-clock headroom: the suite must stay under its 870 s
+# ceiling (ROADMAP.md), and a run that burns past 820 s is one flaky
+# collection away from timing out on the next PR — surface the gap
+# BEFORE the ceiling breaks, not after.
+TIER1_BUDGET_S = 870.0
+TIER1_WARN_S = 820.0
 # Pipeline-parallel training geometries (benchmarks/pipeline_bench.py:
 # the unrolled 1F1B MPMD schedule of tpudp/parallel/schedule.py over a
 # pp{P}dp{D}[v{V}] mesh — P stages x D replicas, V virtual stages per
@@ -472,10 +488,13 @@ def serve_soak_missing(d: str) -> list[int]:
     """Soak seeds still lacking a PASSING real-TPU run.  A soak row
     closes its seed only when it measured something (``value`` =
     completed requests > 0), the surviving outputs matched generate()
-    bit-exactly (``parity_ok``), and the engine ended empty
-    (``no_leak``) — a soak that wedged, leaked a slot, or diverged is a
-    FAILURE to retry, exactly like an error row.  CPU smoke rows never
-    close a seed (same rules as serve_missing)."""
+    bit-exactly (``parity_ok``), the engine ended empty (``no_leak``),
+    and the canary cadence ran clean — canaries actually fired and ZERO
+    quarantines (``canary_ok``, the serving false-positive gate: a
+    canary that condemns a healthy engine is as much a bug as one that
+    misses corruption) — a soak that wedged, leaked a slot, or diverged
+    is a FAILURE to retry, exactly like an error row.  CPU smoke rows
+    never close a seed (same rules as serve_missing)."""
     done = set()
     for r in rows_with_history(os.path.join(d, "serve_soak.jsonl")):
         if (r.get("metric") == "serve_soak"
@@ -483,6 +502,7 @@ def serve_soak_missing(d: str) -> list[int]:
                 and measured(r)
                 and r.get("parity_ok") is True
                 and r.get("no_leak") is True
+                and r.get("canary_ok") is True
                 and "TPU" in str(r.get("device_kind", ""))):
             done.add(r["seed"])
     return [s for s in SERVE_SOAK_SEEDS if s not in done]
@@ -597,6 +617,55 @@ def train_soak_multihost_missing(d: str) -> list[int]:
                 and r.get("elastic_resumes", 0) > 0):
             done.add(r["seed"])
     return [s for s in TRAIN_SOAK_MULTIHOST_SEEDS if s not in done]
+
+
+def sdc_soak_missing(d: str) -> list[int]:
+    """SDC soak seeds still lacking a PASSING real-TPU run.  A row
+    closes its seed only when it measured something (``value`` =
+    detections > 0 — a soak that detected nothing proved nothing),
+    the clean fit raised zero detections (``clean_ok`` — the
+    false-positive gate), the one-shot flip was detected, localized to
+    the injected replica, and graded transient with the persistent
+    flip quarantined (``accounted``/``quarantine_ok``), and the
+    repaired params matched the clean run bit-exactly (``parity_ok``).
+    CPU smoke rows never close a seed (same rules as
+    train_soak_missing)."""
+    done = set()
+    for r in rows_with_history(os.path.join(d, "sdc_soak.jsonl")):
+        if (r.get("metric") == "sdc_soak"
+                and r.get("seed") in SDC_SOAK_SEEDS
+                and measured(r)
+                and r.get("clean_ok") is True
+                and r.get("parity_ok") is True
+                and r.get("accounted") is True
+                and r.get("quarantine_ok") is True
+                and "TPU" in str(r.get("device_kind", ""))):
+            done.add(r["seed"])
+    return [s for s in SDC_SOAK_SEEDS if s not in done]
+
+
+def tier1_headroom_missing(d: str) -> list[str]:
+    """``tier1-headroom`` when the LAST recorded tier-1 run burned past
+    TIER1_WARN_S of the TIER1_BUDGET_S ceiling.  The record is
+    ``<dir>/tier1.log`` — a tee of the tier-1 pytest run (ROADMAP.md's
+    command) — parsed for pytest's final summary line (``... passed
+    ... in 812.34s``); only the LAST summary counts (a log may hold
+    several runs).  No log or no summary line is NOT a gap: headroom
+    tracking is advisory until a run is recorded, and absence must not
+    block TPU stages that never run the suite."""
+    import re
+
+    try:
+        with open(os.path.join(d, "tier1.log"), errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return []
+    took = None
+    for m in re.finditer(r"\bpassed\b[^\n]*?\bin (\d+(?:\.\d+)?)s\b", text):
+        took = float(m.group(1))
+    if took is not None and took > TIER1_WARN_S:
+        return ["tier1-headroom"]
+    return []
 
 
 def epoch_missing(d: str) -> bool:
@@ -803,6 +872,7 @@ def main() -> None:
                                      "serve_tenancy",
                                      "train_soak",
                                      "train_soak_multihost",
+                                     "sdc_soak", "tier1_headroom",
                                      "train_pipeline", "analysis",
                                      "obs", "stale"])
     p.add_argument("--dir", default="bench_results")
@@ -841,6 +911,11 @@ def main() -> None:
         print(",".join(str(s)
                        for s in train_soak_multihost_missing(args.dir)),
               end="")
+    elif args.stage == "sdc_soak":
+        print(",".join(str(s) for s in sdc_soak_missing(args.dir)),
+              end="")
+    elif args.stage == "tier1_headroom":
+        print(",".join(tier1_headroom_missing(args.dir)), end="")
     elif args.stage == "train_pipeline":
         print(",".join(train_pipeline_missing(args.dir)), end="")
     elif args.stage == "serve_prefix":

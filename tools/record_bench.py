@@ -590,6 +590,39 @@ def main() -> None:
                   f"{r.get('elastic_resumes')} reduced-geometry resumes) | "
                   f"`resilience_bench.py --multihost` | |")
 
+    # Silent-data-corruption soak rows: pass/fail mirrors
+    # bench_gaps.sdc_soak_missing — the clean fit must raise ZERO
+    # detections (false-positive gate), the one-shot flip must be
+    # detected/localized/graded with bit-exact repair, and the
+    # persistent flip must quarantine.
+    sdcsoak = _dedupe(
+        (r for r in _rows(os.path.join(args.dir, "sdc_soak.jsonl"))
+         if "seed" in r and r.get("metric") == "sdc_soak"), "seed")
+    for r in sorted(sdcsoak.values(), key=lambda r: r.get("seed", 0)):
+        if (not measured(r) or not r.get("clean_ok")
+                or not r.get("parity_ok") or not r.get("accounted")
+                or not r.get("quarantine_ok")):
+            why = r.get("error") or ", ".join(
+                w for w, bad in (("false positive on clean run",
+                                  not r.get("clean_ok")),
+                                 ("repair not bit-exact",
+                                  not r.get("parity_ok")),
+                                 ("flip not localized/graded",
+                                  not r.get("accounted")),
+                                 ("persistent flip not quarantined",
+                                  not r.get("quarantine_ok")))
+                if bad) or "no real measurement"
+            print(f"| sdc_soak seed={r.get('seed')} | FAILED: "
+                  f"{str(why)[:120]} | `resilience_bench.py --sdc` | |")
+        else:
+            print(f"| SDC soak seed={r['seed']} (clean / one-shot flip / "
+                  f"persistent flip at {r.get('flip')}) | PASS: "
+                  f"{r['value']} detections, clean run zero false "
+                  f"positives over {r.get('sdc_checks')} checks, "
+                  f"one-shot flip localized + repaired bit-exact, "
+                  f"persistent flip quarantined | "
+                  f"`resilience_bench.py --sdc` | |")
+
     flash = _dedupe(
         (r for r in _rows(os.path.join(args.dir, "flash.jsonl"))
          if "t" in r), "t")
@@ -624,6 +657,7 @@ STAGE_FILES = {
     "serve_tenancy": "serve_tenancy.jsonl",
     "train_soak": "train_soak.jsonl",
     "train_soak_multihost": "train_soak_multihost.jsonl",
+    "sdc_soak": "sdc_soak.jsonl",
     "train_pipeline": "train_pipeline.jsonl",
 }
 

@@ -134,6 +134,10 @@ train_soak_multihost_ok() {
   local out; out=$(python tools/bench_gaps.py train_soak_multihost) || return 1
   [ -z "$out" ]
 }
+sdc_soak_ok() {
+  local out; out=$(python tools/bench_gaps.py sdc_soak) || return 1
+  [ -z "$out" ]
+}
 train_pipeline_ok() {
   local out; out=$(python tools/bench_gaps.py train_pipeline) || return 1
   [ -z "$out" ]
@@ -529,6 +533,25 @@ PYEOF
         > bench_results/train_soak.jsonl 2> bench_results/train_soak.err
       log "train_soak rc=$? -> bench_results/train_soak.jsonl"
     fi
+    if sdc_soak_ok; then
+      log "sdc_soak.jsonl already good; skipping SDC soak"
+    else
+      # Silent-data-corruption soak (tpudp/sdc.py + the supervisor's
+      # graded response): in-process clean / one-shot-flip /
+      # persistent-flip fits; a seed passes only when the clean fit
+      # raised zero detections (false-positive gate), the one-shot flip
+      # was detected, localized to the injected replica, and repaired
+      # BIT-IDENTICAL to the clean run, and the persistent flip dropped
+      # the quarantine marker — resumes at seed granularity via
+      # bench_gaps, like the train_soak stage.
+      bank bench_results/sdc_soak.jsonl
+      ensure_window
+      SDC_SOAK="$(python tools/bench_gaps.py sdc_soak)" \
+        timeout -k "$GRACE" "$(stage_t 900)" python benchmarks/resilience_bench.py \
+        --sdc \
+        > bench_results/sdc_soak.jsonl 2> bench_results/sdc_soak.err
+      log "sdc_soak rc=$? -> bench_results/sdc_soak.jsonl"
+    fi
     if train_soak_multihost_ok; then
       log "train_soak_multihost.jsonl already good; skipping pod soak"
     else
@@ -605,7 +628,7 @@ PYEOF
         && serve_soak_ok && serve_disagg_ok && serve_prefix_ok \
         && serve_paged_ok \
         && serve_tenancy_ok \
-        && train_soak_ok && train_soak_multihost_ok \
+        && train_soak_ok && train_soak_multihost_ok && sdc_soak_ok \
         && train_pipeline_ok; then
       log "battery done"
       exit 0

@@ -126,6 +126,11 @@ PROGRAM_DONATIONS = {
     "train.step_single": (0,),
     "train.step_dp_allreduce": (0,),
     "train.step_dp_ring": (0,),
+    # SDC-fingerprint twins donate identically: the fingerprint reads
+    # the post-update params/opt_state VALUES before the donated input
+    # buffers are reused — same aliasing facts, two extra u32 words.
+    "train.step_single_sdc": (0,),
+    "train.step_dp_allreduce_sdc": (0,),
     "train.eval_step": (),
     # MPMD pipeline steps (tpudp/parallel/schedule.py): the TrainState
     # (params + flat-sharded optimizer shards) donates, like every train
@@ -462,6 +467,19 @@ def build_programs() -> dict:
             make_train_step(model, tx, mesh, sync), (state, images, labels))
     programs[f"train.eval_step@mesh{TRAIN['devices']}"] = (
         make_eval_step(model, mesh), (state, images, labels, weights))
+    # SDC-fingerprint twins (tpudp/sdc.py): the SAME fused step with
+    # the TrainState's ``sdc_fp`` slot allocated (init_state(
+    # track_sdc=True)) — the u32 checksum of the post-update params +
+    # optimizer bits rides the step, structure-gated at trace time.
+    # Pinned separately so growth in the corruption detector's traced
+    # footprint is a lockfile diff, not silent drift.
+    sdc_state = init_state(model, tx, input_shape=(1, *TRAIN["input"]),
+                           track_sdc=True)
+    programs["train.step_single_sdc@tiny"] = (
+        make_train_step(model, tx, None), (sdc_state, images, labels))
+    programs[f"train.step_dp_allreduce_sdc@mesh{TRAIN['devices']}"] = (
+        make_train_step(model, tx, mesh, "allreduce"),
+        (sdc_state, images, labels))
 
     # -- MPMD pipeline programs (parallel/schedule.py) ------------------
     import jax

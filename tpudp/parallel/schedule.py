@@ -620,6 +620,7 @@ def make_pipeline_train_step(
         opt_state=opt_specs,
         loss_sum=P(),
         obs_norms=P() if pp_state.obs_norms is not None else None,
+        sdc_fp=P() if pp_state.sdc_fp is not None else None,
     )
 
     block_fn = lambda p, x: Block(cfg).apply({"params": p}, x)
@@ -684,11 +685,30 @@ def make_pipeline_train_step(
             updates, new_opt = tx.update(grads, st.opt_state, st.params)
             new_params = optax.apply_updates(st.params, updates)
 
+        # In-step SDC fingerprint (tpudp.sdc): stage-local u32 checksum
+        # of the post-update params, summed over the pipe axis so every
+        # device carries the FULL-model checksum — DP replicas (pipe
+        # columns across `data`) hold bit-identical params after the
+        # all-gather, so healthy fingerprints agree bit-for-bit.  The
+        # 1/DP-sharded optimizer state is excluded (a different slice
+        # per replica, the same exclusion rule as
+        # consistency.fingerprint); the stage-stacked optimizer of the
+        # unsharded path IS replicated over data and rides along.
+        new_fp = st.sdc_fp
+        if new_fp is not None:
+            from tpudp.sdc import traced_fingerprint
+
+            fp_tree = {"params": new_params}
+            if not shard_optimizer:
+                fp_tree["opt_state"] = new_opt
+            new_fp = lax.psum(traced_fingerprint(fp_tree), pipe_axis)
+
         return st.replace(
             step=st.step + 1,
             params=new_params,
             opt_state=new_opt,
             loss_sum=st.loss_sum + loss,
+            sdc_fp=new_fp,
         ), loss
 
     tok_spec = P(data_axis) if data_axis is not None else P()

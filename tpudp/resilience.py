@@ -35,6 +35,18 @@ Recovery taxonomy (docs/RESILIENCE.md):
   * **Loader containment** — an exception out of the loader/Prefetcher
     worker restarts the pipeline and replays to the exact batch offset
     (same host-RNG draws), bounded by ``max_loader_restarts`` per epoch.
+  * **Silent-data-corruption defense** (``sdc_check_every=N`` +
+    ``Trainer(track_sdc_fingerprint=True)``, tpudp.sdc) — every N
+    optimizer steps, at the window-edge seam the host already pays
+    for, per-replica fingerprints of the params/optimizer bytes are
+    majority-voted (shard groups locally, the in-step ``sdc_fp``
+    checksum across hosts).  A mismatch names the minority replica and
+    rides the divergence rollback; the bit-exact replay is the oracle
+    that GRADES it — a clean re-check is a transient flip (continue,
+    params repaired bit-identically), the same replica diverging again
+    is a persistently bad chip: quarantine (marker +
+    :data:`~tpudp.sdc.SDC_QUARANTINE_EXIT`) and reduced-geometry
+    relaunch through the elastic verified restore.
 
 Every recovery is a typed event in ``trainer.stats["events"]`` with
 counters (``rollbacks`` / ``step_retries`` / ``ckpt_fallbacks`` /
@@ -75,6 +87,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from tpudp.sdc import (SDC_QUARANTINE_EXIT, QUARANTINE_MARKER, SdcDetected,
+                       SdcPersistentError)
 from tpudp.utils.watchdog import StepHangError
 
 # Per-host outcome codes for the multi-host recovery vote, ordered by
@@ -146,7 +160,15 @@ class ResiliencePolicy:
     vote: if no peer joins the allgather within it — the peer is dead,
     not merely recovering — the process hard-exits with
     :data:`VOTE_TIMEOUT_EXIT` so the scheduler relaunches the pod into
-    the coordinated resume path instead of hanging forever."""
+    the coordinated resume path instead of hanging forever.
+
+    ``sdc_check_every=N`` arms the silent-data-corruption check
+    (tpudp.sdc): every N optimizer steps the per-replica state
+    fingerprints are majority-voted at the window-edge seam.  Requires
+    the trainer to carry the in-step fingerprint
+    (``Trainer(track_sdc_fingerprint=True)``) so detection inherits the
+    zero-new-host-syncs contract; ``None`` — the default — adds no
+    check and no work."""
 
     checkpoint_dir: str
     max_rollbacks: int = 3
@@ -159,6 +181,7 @@ class ResiliencePolicy:
     checkpoint_writer: Any = None
     on_event: Callable[[dict], None] | None = None
     vote_timeout_s: float = 120.0
+    sdc_check_every: int | None = None
 
 
 def make_emergency_dump(checkpoint_dir: str, get_state,
@@ -276,11 +299,31 @@ class Supervisor:
         self._multihost = jax.process_count() > 1
         self._vote_seq = 0
         trainer.stats.update(rollbacks=0, step_retries=0, ckpt_fallbacks=0,
-                             loader_restarts=0, events=[])
+                             loader_restarts=0, events=[], sdc_checks=0,
+                             sdc_detections=0, sdc_transients=0,
+                             sdc_quarantines=0)
         self._window_losses: deque[float] = deque(maxlen=policy.spike_window)
         self._last_failed_step: int | None = None
         self._consecutive_at_step = 0
         self._per_epoch: int | None = None
+        if policy.sdc_check_every is not None:
+            if policy.sdc_check_every < 1:
+                raise ValueError(
+                    f"sdc_check_every must be >= 1, got "
+                    f"{policy.sdc_check_every}")
+            if getattr(trainer.state, "sdc_fp", None) is None:
+                # The fingerprint slot must exist BEFORE the step
+                # programs are built (shard_map specs are a fixed
+                # pytree), so it cannot be allocated lazily here.
+                raise ValueError(
+                    "sdc_check_every requires the in-step fingerprint: "
+                    "construct the Trainer with track_sdc_fingerprint="
+                    "True so the sdc_fp slot is allocated before the "
+                    "step programs are built")
+        # SDC grading state: the last checked optimizer step, and the
+        # unresolved detection awaiting its post-replay verdict.
+        self._sdc_last_check = 0
+        self._sdc_pending: dict | None = None
 
     # -- event log ------------------------------------------------------
     def record(self, kind: str, **fields) -> None:
@@ -313,6 +356,170 @@ class Supervisor:
                             median=med, step=step)
                 raise LossSpikeError(loss, med, step)
         self._window_losses.append(loss)
+
+    # -- silent-data-corruption check (tpudp.sdc) -----------------------
+    def observe_window_state(self, state, *, epoch: int, it: int) -> None:
+        """Called at every completed log window, right after
+        :meth:`observe_window_loss` — the host is already synchronized
+        there (it just fetched ``loss_sum``), so the fingerprint check
+        adds no new hot-path sync.  Cadence-gated by
+        ``policy.sdc_check_every`` (None: immediate no-op).
+
+        A check majority-votes the per-replica state bytes: shard
+        groups locally (:func:`tpudp.sdc.vote_shard_groups` — correct
+        under PP x DP, where only same-stage copies are comparable) and
+        the in-step ``sdc_fp`` checksum across hosts (bounded gather,
+        the vote layer's timeout discipline).  On mismatch the minority
+        replica is recorded and :class:`~tpudp.sdc.SdcDetected` rides
+        the divergence rollback; the post-replay re-check grades it —
+        clean means transient (continue), the same replica again means
+        persistent (:meth:`_sdc_quarantine`)."""
+        every = self.policy.sdc_check_every
+        if every is None:
+            return
+        gstep = int(state.step)
+        if gstep - self._sdc_last_check < every:
+            return
+        self._sdc_last_check = gstep
+        self.trainer.stats["sdc_checks"] += 1
+        from tpudp.sdc import SdcDetected, localize_minority, \
+            vote_shard_groups
+
+        minority, majority = vote_shard_groups(
+            {"params": state.params, "opt_state": state.opt_state,
+             "sdc_fp": state.sdc_fp})
+        if self._multihost:
+            host_fps = {f"p{i}": v for i, v in
+                        enumerate(self._sdc_gather(self._fetch_fp(state)))}
+            h_min, h_maj = localize_minority(host_fps)
+            minority = sorted(set(minority) | set(h_min))
+            majority = sorted(set(majority) | set(h_maj))
+        pending = self._sdc_pending
+        if not minority:
+            if pending is not None and gstep >= pending["step"]:
+                # The bit-exact replay re-crossed the detection point
+                # clean: the flip was TRANSIENT and the rollback
+                # repaired it — params are bit-identical to a run that
+                # never saw it (the trajectory-consistency oracle).
+                self.trainer.stats["sdc_transients"] += 1
+                self.record("sdc_transient", replicas=pending["minority"],
+                            step=pending["step"], cleared_at=gstep)
+                self.trainer.log(
+                    f"[tpudp] resilience: SDC at step {pending['step']} "
+                    f"(replica(s) {pending['minority']}) did not recur "
+                    f"through step {gstep} — transient flip, repaired by "
+                    "rollback; continuing")
+                self._sdc_pending = None
+            return
+        self.trainer.stats["sdc_detections"] += 1
+        self.record("sdc_detected", replicas=minority, step=gstep,
+                    epoch=epoch, it=it, localized=bool(majority))
+        if pending is not None and set(minority) & set(pending["minority"]):
+            self._sdc_quarantine(minority, gstep)  # raises / exits
+        self._sdc_pending = {"minority": minority, "step": gstep}
+        named = (f"minority replica(s) {minority}" if majority
+                 else f"replicas disagree ({minority}) with no strict "
+                      "majority — corruption proven, culprit unnamed")
+        raise SdcDetected(
+            f"silent data corruption at step {gstep}: {named}",
+            step=gstep, replica=minority if majority else None)
+
+    @staticmethod
+    def _fetch_fp(state):
+        """This host's in-step fingerprint value (device 0's buffer of
+        the logically-replicated ``sdc_fp`` leaf)."""
+        import numpy as np
+
+        shards = getattr(state.sdc_fp, "addressable_shards", None)
+        if shards:
+            return np.asarray(shards[0].data)
+        return np.asarray(state.sdc_fp)
+
+    def _sdc_gather(self, fp):
+        """Bounded cross-host exchange of the in-step fingerprint —
+        the same timeout discipline as :meth:`_vote`: every host
+        reaches this gather at the same checked step (the check cadence
+        is a pure function of the replicated ``state.step``), and a
+        host whose peers never join hard-exits for the scheduler
+        instead of hanging the rendezvous."""
+        import threading
+
+        import numpy as np
+
+        result: dict = {}
+
+        def gather() -> None:
+            try:
+                import jax.numpy as jnp
+                from jax.experimental import multihost_utils
+
+                # tpudp: lint-ok(protocol-divergent-entry): the except
+                # arm IS the bounded-gather mitigation — a locally
+                # failing collective (torn TCP, dead peer) becomes a
+                # timeout verdict and a hard exit (43), and any peer
+                # still inside the gather times out the same way.
+                out = np.asarray(multihost_utils.process_allgather(
+                    jnp.asarray(fp, jnp.uint32)))
+                result["fps"] = [np.asarray(row, np.uint64) for row in out]
+            except BaseException as e:  # gloo/XLA surface various types
+                result["error"] = e
+
+        th = threading.Thread(target=gather, daemon=True,
+                              name="tpudp-sdc-gather")
+        th.start()
+        th.join(self.policy.vote_timeout_s)
+        if "fps" not in result:
+            why = (f"fingerprint gather failed: {result['error']!r}"
+                   if "error" in result else
+                   f"no peer joined within {self.policy.vote_timeout_s}s")
+            self.record("vote_timeout", outcome="sdc_check", reason=why)
+            self.trainer.log(
+                f"[tpudp] resilience: SDC fingerprint gather got no "
+                f"answer ({why}); peer host dead or wedged — hard-exiting "
+                f"for scheduler relaunch (exit {VOTE_TIMEOUT_EXIT})")
+            self.trainer.flight.dump("vote_timeout", extra={
+                "reason": why, "outcome": "sdc_check"})
+            os._exit(VOTE_TIMEOUT_EXIT)
+        return result["fps"]
+
+    def _sdc_quarantine(self, minority, gstep: int) -> None:
+        """The persistent verdict: the same replica diverged again
+        after a bit-exact replay, so the chip — not a cosmic ray — is
+        at fault.  Record + flight-dump, write the on-disk marker
+        naming the replica(s) (the relaunch harness reads it to shrink
+        the geometry), then hard-exit the owning host with
+        :data:`~tpudp.sdc.SDC_QUARANTINE_EXIT` (multi-host) or raise
+        :class:`~tpudp.sdc.SdcPersistentError` (single-host / healthy
+        hosts — whose crash sends them to the reduced-geometry relaunch
+        alongside the quarantined peer).  The verdict is computed from
+        identically-gathered fingerprints, so every host grades the
+        same round the same way."""
+        import json
+
+        import jax
+
+        t = self.trainer
+        t.stats["sdc_quarantines"] += 1
+        self.record("sdc_quarantine", replicas=minority, step=gstep)
+        t.flight.dump("sdc_quarantine",
+                      extra={"replicas": minority, "step": gstep})
+        proc = jax.process_index()
+        mine = [k for k in minority if k.split("/")[0] == f"p{proc}"]
+        if mine or not self._multihost:
+            marker = os.path.join(self.policy.checkpoint_dir,
+                                  QUARANTINE_MARKER)
+            with open(marker, "w") as f:
+                json.dump({"replicas": minority, "step": gstep,
+                           "host": proc}, f)
+        t.log(f"[tpudp] resilience: SDC on replica(s) {minority} recurred "
+              f"after a bit-exact replay (step {gstep}) — persistent bad "
+              "chip; quarantining for reduced-geometry relaunch")
+        if self._multihost and mine:
+            os._exit(SDC_QUARANTINE_EXIT)
+        raise SdcPersistentError(
+            f"replica(s) {minority} diverged again after a bit-exact "
+            f"replay at step {gstep} — persistent silent corruption; "
+            "host quarantined", replica=minority)
 
     def guard_batches(self, loader, epoch: int, base):
         """Wrap one epoch's batch iterator with loader containment: an
@@ -748,7 +955,16 @@ class Supervisor:
                     raise e.original from e
                 except (KeyboardInterrupt, SystemExit):
                     raise
-                except (FloatingPointError, LossSpikeError) as e:
+                except SdcPersistentError:
+                    # The quarantine verdict is computed from
+                    # identically-gathered fingerprints, so every host
+                    # leaves the vote loop in the same round (the named
+                    # chip's host already hard-exited
+                    # SDC_QUARANTINE_EXIT); the crash routes survivors
+                    # to the reduced-geometry relaunch.
+                    raise
+                except (FloatingPointError, LossSpikeError,
+                        SdcDetected) as e:
                     if self._multihost:
                         # tpudp: lint-ok(divergent-collective): this vote
                         # IS the mitigation the rule demands — every host

@@ -1,0 +1,443 @@
+"""Silent-data-corruption defense: replica fingerprints, majority-vote
+localization, and deterministic bit-flip injectors.
+
+Every detector the resilience stack owns fires on LOUD faults — NaN
+windows, escaped exceptions, hangs, crc-mismatched checkpoints — but a
+chip that computes wrong-but-finite numbers sails through all of them,
+and the DP all-reduce then SPREADS the corruption to every replica
+before the next checkpoint seals it in.  At pod scale this is the
+dominant unhandled fault class (arXiv:2204.06514's TPUv4 regime).  The
+repo is unusually well-armed against it:
+
+  * Data-parallel replication makes post-update parameters a free
+    dual-modular-redundancy check — healthy replicas hold bit-identical
+    bytes, so any per-replica checksum disagreement IS corruption, and
+    with three or more replicas a majority vote NAMES the bad one.
+  * The bit-exact trajectory discipline (arXiv:2509.07003) that already
+    referees every recovery path is exactly the oracle an SDC responder
+    needs: restore the newest verified checkpoint and deterministically
+    replay, and the repaired run is bit-identical to one that never saw
+    the flip.
+
+Three pieces live here; the policy/vote glue lives in
+``tpudp/resilience.py`` (``ResiliencePolicy(sdc_check_every=N)``) and
+the serving canary in ``tpudp/serve/engine.py`` (``Engine(
+canary_every_s=...)``):
+
+  * :func:`traced_fingerprint` — the IN-STEP fingerprint: an exact
+    wraparound-u32 checksum over the raw bits of every leaf, computed
+    inside the jitted train step and carried as the optional
+    ``TrainState.sdc_fp`` leaf (the ``obs_norms`` zero-sync piggyback
+    pattern).  The host fetches it at the window-edge seam where it
+    already synchronizes for ``loss_sum``, so designated hot paths gain
+    ZERO new host syncs.  Bit-exact by construction: float sums would
+    round a low-mantissa flip away in a large model; an integer
+    checksum of the bit pattern cannot.
+  * :func:`replica_fingerprints` / :func:`localize_minority` — the
+    host-side localization half: per-replica checksums from the actual
+    addressable shard BYTES (the same shard-level view
+    ``tpudp/utils/consistency.py`` compares), majority-voted to name
+    the minority replica.  Works under plain DP (params replicated per
+    device) and the PP schedule's ZeRO-1 layout (params all-gathered
+    each step; the 1/DP-sharded optimizer state is excluded exactly
+    like ``fingerprint()`` excludes it, with checkpoint shard manifests
+    covering those bytes instead).
+  * :class:`BitFlipParams` / :class:`BitFlipGrads` — deterministic
+    injectors with a ``(step, replica, bit)`` schedule, driving the
+    unit matrix (``tests/test_sdc.py``) and the ``sdc_soak`` bench
+    stage (``benchmarks/resilience_bench.py --sdc``).  The serving
+    analogue (``BitFlipLogits``) lives in ``tpudp/serve/faults.py``.
+
+Response grading (implemented by the Supervisor): a first detection
+rolls back to the newest verified checkpoint and replays the window —
+the existing bit-exact path.  A clean re-check classifies the flip
+TRANSIENT (a cosmic-ray event: continue, params repaired
+bit-identically); the SAME replica diverging again after a bit-exact
+replay classifies the chip PERSISTENT — the host is quarantined
+(:data:`SDC_QUARANTINE_EXIT`, plus an on-disk marker naming it) and the
+relaunch harness resumes at reduced geometry through the elastic
+verified restore + ``ShardedSampler(batch_contiguous=)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+#: Exit code when a PERSISTENT SDC verdict quarantines this host: the
+#: process exits for the scheduler/relauncher, which excludes the host
+#: named in the ``sdc_quarantine.json`` marker and relaunches the pod
+#: at reduced geometry into the elastic verified restore.  Distinct
+#: from the watchdog's 42 and the vote layer's 43 so the soak can
+#: attribute the exit to the SDC path.
+SDC_QUARANTINE_EXIT = 44
+
+#: Marker file (under ``ResiliencePolicy.checkpoint_dir``) written
+#: before a quarantine exit; the relaunch harness reads it to shrink
+#: the geometry around the named host.
+QUARANTINE_MARKER = "sdc_quarantine.json"
+
+
+class SdcDetected(RuntimeError):
+    """Replica fingerprints disagree: some chip computed wrong-but-
+    finite numbers.  Raised at the window-edge check; the supervisor
+    routes it through the divergence-class recovery (restore newest
+    verified checkpoint + bit-exact replay), whose re-check grades the
+    fault transient or persistent.  ``replica`` names the minority
+    replica when the vote could localize one (None on a 2-replica tie
+    — corruption proven, culprit unknown)."""
+
+    def __init__(self, message: str, *, step: int | None = None,
+                 replica=None, fingerprints=None):
+        super().__init__(message)
+        self.step = step
+        self.replica = replica
+        self.fingerprints = dict(fingerprints or {})
+
+
+class SdcPersistentError(RuntimeError):
+    """The SAME replica diverged again after a bit-exact replay — a
+    persistently bad chip, not a transient flip.  Escalates out of the
+    supervisor (single-host) or hard-exits with
+    :data:`SDC_QUARANTINE_EXIT` (multi-host) after the quarantine
+    marker is written."""
+
+    def __init__(self, message: str, *, replica=None):
+        super().__init__(message)
+        self.replica = replica
+
+
+def _np_bits_u32(a: np.ndarray) -> np.ndarray:
+    """The raw bits of ``a`` widened to uint32 (uint64 splits into two
+    u32 halves so no bit goes unchecked)."""
+    a = np.ascontiguousarray(a)
+    if a.dtype == np.bool_:
+        a = a.astype(np.uint8)
+    nbytes = a.dtype.itemsize
+    if nbytes >= 8:
+        v = a.view(np.uint64).ravel()
+        return ((v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                .astype(np.uint64)
+                + (v >> np.uint64(32)).astype(np.uint32).astype(np.uint64)
+                ).astype(np.uint32)
+    view = {1: np.uint8, 2: np.uint16, 4: np.uint32}[nbytes]
+    return a.view(view).ravel().astype(np.uint32)
+
+
+def np_fingerprint(arrays) -> np.ndarray:
+    """Host-side twin of :func:`traced_fingerprint`: exact wraparound-
+    u32 checksum + element count over numpy arrays.  Shared by the
+    per-replica shard walk and the tests' oracles (the two must agree
+    bit-for-bit on identical bytes)."""
+    total = np.uint64(0)
+    count = np.uint64(0)
+    for a in arrays:
+        bits = _np_bits_u32(np.asarray(a))
+        total = (total + np.uint64(bits.sum(dtype=np.uint64))) \
+            & np.uint64(0xFFFFFFFF)
+        count = (count + np.uint64(bits.size)) & np.uint64(0xFFFFFFFF)
+    return np.array([total, count], dtype=np.uint64)
+
+
+def traced_fingerprint(tree):
+    """The in-step fingerprint: ``[checksum, count]`` (u32, stacked) of
+    every leaf's raw bits, safe to call INSIDE a jitted step.  Integer
+    wraparound sums are exact and order-independent, so a single
+    flipped bit anywhere in ``tree`` changes the checksum with
+    certainty (a float accumulator would round a low-mantissa flip away
+    at scale), and healthy replicas — which hold bit-identical bytes —
+    produce bit-identical fingerprints."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    total = jnp.zeros((), jnp.uint32)
+    count = jnp.zeros((), jnp.uint32)
+    for leaf in jax.tree.leaves(tree):
+        a = jnp.asarray(leaf)
+        if a.dtype == jnp.bool_:
+            a = a.astype(jnp.uint8)
+        nbytes = a.dtype.itemsize
+        if nbytes >= 8:
+            v = lax.bitcast_convert_type(a, jnp.uint64)
+            bits = ((v & jnp.uint64(0xFFFFFFFF))
+                    + (v >> jnp.uint64(32))).astype(jnp.uint32)
+        else:
+            view = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}[nbytes]
+            bits = lax.bitcast_convert_type(a, view).astype(jnp.uint32)
+        total = total + jnp.sum(bits, dtype=jnp.uint32)
+        count = count + jnp.uint32(a.size & 0xFFFFFFFF)
+    return jnp.stack([total, count])
+
+
+def _leaf_paths(tree):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        yield jax.tree_util.keystr(path), leaf
+
+
+def replica_fingerprints(tree) -> dict:
+    """Per-replica checksums from the actual shard bytes on this host:
+    ``{replica_key: np.array([checksum, count])}`` where a replica key
+    is ``"p<process>/d<device>"``.  For every leaf that is REPLICATED
+    across local devices (each device holds the same logical slice),
+    each device's copy is checksummed into ITS replica's fingerprint —
+    healthy replicas therefore agree bit-for-bit and a corrupted
+    device's fingerprint stands out.  Genuinely sharded leaves (ZeRO-1
+    optimizer state: a different slice per device) are excluded, the
+    same rule as ``tpudp.utils.consistency.fingerprint`` — their bytes
+    are covered by the per-host checkpoint shard manifests.  A leaf
+    sharded over SOME devices but replicated within groups contributes
+    each group's bytes to its members, so partial replication still
+    gets DMR cover."""
+    import jax
+
+    proc = jax.process_index()
+    sums: dict = {}
+    counts: dict = {}
+
+    def _add(dev, bits_sum: int, n: int) -> None:
+        key = f"p{proc}/d{getattr(dev, 'id', dev)}"
+        sums[key] = (sums.get(key, 0) + bits_sum) & 0xFFFFFFFF
+        counts[key] = (counts.get(key, 0) + n) & 0xFFFFFFFF
+
+    for _name, leaf in _leaf_paths(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            continue
+        by_index: dict = {}
+        for s in shards:
+            by_index.setdefault(str(s.index), []).append(s)
+        # replicated = some index group holds >1 device, or the leaf is
+        # fully replicated with a single local device (still this
+        # replica's copy — it participates in the cross-host vote)
+        for group in by_index.values():
+            if len(group) < 2 and len(by_index) > 1:
+                # a uniquely-held slice of a sharded leaf: excluded
+                continue
+            for s in group:
+                bits = _np_bits_u32(np.asarray(s.data))
+                _add(s.device, int(bits.sum(dtype=np.uint64)), bits.size)
+    return {k: np.array([sums[k], counts[k]], dtype=np.uint64)
+            for k in sorted(sums)}
+
+
+def vote_shard_groups(tree) -> tuple[list, list]:
+    """Majority-vote the raw shard bytes per REPLICATION GROUP and name
+    corrupt devices: for every leaf, devices holding the same logical
+    slice (same shard index) form one group, each member's bytes are
+    checksummed, and the group's minority members are suspects.  Voting
+    within groups — not across all devices flat — is what makes this
+    correct under PP x DP layouts, where stage-0 and stage-1 devices
+    legitimately hold DIFFERENT bytes but each stage's DP copies must
+    match.  Returns ``(minority_keys, majority_keys)`` over
+    ``"p<process>/d<device>"`` keys; a device minority in ANY group is
+    a suspect.  Single-member groups (genuinely sharded slices, or a
+    single local device) have no redundancy and are skipped — the
+    checkpoint manifests and the cross-host in-step fingerprint cover
+    those."""
+    import jax
+
+    proc = jax.process_index()
+    minority: set = set()
+    majority: set = set()
+    for _name, leaf in _leaf_paths(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            continue
+        by_index: dict = {}
+        for s in shards:
+            by_index.setdefault(str(s.index), []).append(s)
+        for group in by_index.values():
+            if len(group) < 2:
+                continue
+            fps = {f"p{proc}/d{getattr(s.device, 'id', s.device)}":
+                   np_fingerprint([np.asarray(s.data)]) for s in group}
+            g_min, g_maj = localize_minority(fps)
+            minority.update(g_min)
+            majority.update(g_maj)
+    majority -= minority  # corrupt in ANY group outranks clean elsewhere
+    return sorted(minority), sorted(majority)
+
+
+def localize_minority(fps: dict) -> tuple[list, list]:
+    """Majority vote over replica fingerprints: returns
+    ``(minority_keys, majority_keys)``.  Empty minority = all replicas
+    agree.  A strict majority (> half) is required to NAME the bad
+    replica; without one (the 2-replica disagreement, or a 2-2 split)
+    corruption is still proven but unlocalizable — every key lands in
+    ``minority_keys`` and ``majority_keys`` is empty, which callers
+    treat as "roll back, cannot quarantine"."""
+    if not fps:
+        return [], []
+    groups: dict = {}
+    for k, v in fps.items():
+        groups.setdefault(np.asarray(v).tobytes(), []).append(k)
+    if len(groups) == 1:
+        return [], sorted(fps)
+    best = max(groups.values(), key=len)
+    if len(best) * 2 <= len(fps):
+        return sorted(fps), []  # no strict majority: unlocalizable
+    minority = sorted(k for k in fps if k not in best)
+    return minority, sorted(best)
+
+
+# -- deterministic injectors -------------------------------------------
+
+
+@dataclass(frozen=True)
+class BitFlip:
+    """One scheduled flip: at trainer step ``step`` (the injector's own
+    monotonic step counter — deterministic, no device fetch), flip bit
+    ``bit`` of the target leaf's first element on replica ``replica``
+    (an index into this host's addressable replica devices)."""
+
+    step: int
+    replica: int = 0
+    bit: int = 0
+
+
+def _first_float_leaf(tree):
+    """Deterministic target choice: the first floating leaf in path
+    order — the same leaf every run, so a soak seed replays exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    best = None
+    for name, leaf in _leaf_paths(tree):
+        if isinstance(leaf, jax.Array) and jnp.issubdtype(
+                leaf.dtype, jnp.floating) and leaf.size > 0:
+            if best is None or name < best[0]:
+                best = (name, leaf)
+    if best is None:
+        raise ValueError("no floating-point leaf to corrupt")
+    return best
+
+
+def flip_bit_on_replica(leaf, replica: int, bit: int):
+    """Flip ``bit`` of element 0 of ``leaf`` on ONE replica's buffer,
+    leaving every other replica's bytes untouched — the
+    replicated-by-assumption, divergent-in-fact state a real SDC event
+    produces.  Reassembles the array from per-device buffers under the
+    ORIGINAL sharding (``jax.make_array_from_single_device_arrays``),
+    so the step programs keep running; only the bytes lie."""
+    import jax
+
+    shards = list(leaf.addressable_shards)
+    if not shards:
+        raise ValueError("leaf has no addressable shards to corrupt")
+    replica = replica % len(shards)
+    bufs = []
+    for i, s in enumerate(shards):
+        a = np.array(s.data)  # owning copy
+        if i == replica:
+            flat = a.reshape(-1)
+            view = _np_bits_u32(flat[:1].copy())
+            word = int(view[0]) ^ (1 << (bit % 32))
+            nbytes = a.dtype.itemsize
+            if nbytes == 4:
+                flat[0:1] = np.array([word], np.uint32).view(a.dtype)
+            elif nbytes == 2:
+                flat[0:1] = np.array([word & 0xFFFF],
+                                     np.uint16).view(a.dtype)
+            elif nbytes == 1:
+                flat[0:1] = np.array([word & 0xFF], np.uint8).view(a.dtype)
+            else:  # 8-byte: flip within the low word
+                v = flat[:1].copy().view(np.uint64)
+                flat[0:1] = (v ^ np.uint64(1 << (bit % 64))).view(a.dtype)
+            a = flat.reshape(a.shape)
+        bufs.append(jax.device_put(a, s.device))
+    if len(shards) == 1:
+        return bufs[0]
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, bufs)
+
+
+class _BitFlipInjector:
+    """Shared mechanics of the trainer-side injectors: a deterministic
+    ``(step, replica, bit)`` schedule applied through the
+    ``Trainer(sdc_fault_hook=...)`` seam (called after each train step
+    as ``state = hook(state)``).  Steps are counted by the injector
+    itself — monotonic across rollback replays, so a one-shot schedule
+    entry fires ONCE ever (the replay is clean → transient verdict)
+    while ``persist_from=K`` re-corrupts every step from its K-th call
+    onward (the replay re-diverges → persistent verdict).  ``fired``
+    records ``(step, replica, bit)`` for soak accounting."""
+
+    def __init__(self, schedule=(), *, persist_from: int | None = None,
+                 replica: int = 0, bit: int = 0):
+        self.schedule = tuple(
+            e if isinstance(e, BitFlip) else BitFlip(*e) for e in schedule)
+        if persist_from is not None and persist_from < 0:
+            raise ValueError(f"persist_from must be >= 0, got {persist_from}")
+        self.persist_from = persist_from
+        self.replica = replica
+        self.bit = bit
+        self.fired: list[tuple[int, int, int]] = []
+        self._calls = 0
+
+    def _target(self, state):
+        raise NotImplementedError
+
+    def _rebuild(self, state, leaf_name, new_leaf):
+        raise NotImplementedError
+
+    def __call__(self, state):
+        self._calls += 1
+        step = self._calls
+        flips = [f for f in self.schedule
+                 if f.step == step and (f.step, f.replica, f.bit)
+                 not in self.fired]
+        if self.persist_from is not None and step >= self.persist_from:
+            flips.append(BitFlip(step, self.replica, self.bit))
+        for f in flips:
+            name, leaf = self._target(state)
+            state = self._rebuild(
+                state, name, flip_bit_on_replica(leaf, f.replica, f.bit))
+            self.fired.append((f.step, f.replica, f.bit))
+        return state
+
+
+def _replace_leaf(tree, name: str, new_leaf):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [new_leaf if jax.tree_util.keystr(p) == name else x
+              for p, x in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class BitFlipParams(_BitFlipInjector):
+    """Flip a bit in one replica's POST-UPDATE parameter bytes — the
+    corrupted-weight case.  Detected by the very next fingerprint check
+    (params are fingerprinted directly)."""
+
+    def _target(self, state):
+        return _first_float_leaf(state.params)
+
+    def _rebuild(self, state, name, new_leaf):
+        return state.replace(
+            params=_replace_leaf(state.params, name, new_leaf))
+
+
+class BitFlipGrads(_BitFlipInjector):
+    """Flip a bit in one replica's OPTIMIZER-STATE bytes (the momentum
+    trace — where a corrupted gradient lands and keeps poisoning every
+    later update).  Detected through the optimizer-state half of the
+    fingerprint; distinct from :class:`BitFlipParams` because the
+    params stay healthy until the next update applies the poisoned
+    trace."""
+
+    def _target(self, state):
+        return _first_float_leaf(state.opt_state)
+
+    def _rebuild(self, state, name, new_leaf):
+        return state.replace(
+            opt_state=_replace_leaf(state.opt_state, name, new_leaf))

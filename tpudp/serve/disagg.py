@@ -58,6 +58,7 @@ from the journal, continuing bit-exactly.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 import zlib
@@ -297,7 +298,7 @@ class DisaggHost:
 
     def __init__(self, engine, *, rank: int = 0, n_hosts: int = 1,
                  role: str = "decode", faults=(), retries: int = 2,
-                 backoff_s: float = 0.0, on_admit=None):
+                 backoff_s: float = 0.0, on_admit=None, watchdog=None):
         self.engine = engine
         self.rank = int(rank)
         self.n_hosts = int(n_hosts)
@@ -306,11 +307,23 @@ class DisaggHost:
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
         self.on_admit = on_admit   # callback(src, ticket, request)
+        self.watchdog = watchdog
         self.seq = 0
         self.alive = True
         self.failures: list[MigrationFailed] = []
         self._outbox: list[_Pending] = []
         self._pending: list[_Pending] = []
+
+    def _wd(self, phase: str):
+        """Scoped watchdog deadline naming one round phase.  A peer
+        that dies mid-round leaves this host blocked INSIDE a
+        collective — undetectable from within the blocked call — so
+        each rendezvous is armed by name (``disagg.migrate_offer`` /
+        ``disagg.transfer`` / ``disagg.adopt`` / ``disagg.release``)
+        and a hang report says exactly which phase never completed."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.step(name=f"disagg.{phase}")
 
     # -- sender side ---------------------------------------------------
 
@@ -455,35 +468,44 @@ class DisaggHost:
         Every collective below is unconditional, and the adopt arm's
         quarantine handler contains no collective and no early exit —
         the exact properties the protocol verifier and the migration
-        model checker prove against this source."""
+        model checker prove against this source.  With a ``watchdog``
+        attached, each phase runs under a NAMED scoped deadline
+        (:meth:`_wd`): a peer SIGKILLed mid-offer leaves the survivors
+        blocked in the transfer gather forever, and the hang report
+        must name that phase instead of a generic timeout."""
         blob = self.outbox_blob()
-        with self.engine.obs.span("migrate_offer_phase", seq=self.seq):
+        with self._wd("migrate_offer"), \
+                self.engine.obs.span("migrate_offer_phase", seq=self.seq):
             sizes = gather_host_values(len(blob))
             dones = gather_host_values(
                 1 if (done and not self.pending) else 0)
-        with self.engine.obs.span("migrate_transfer", seq=self.seq,
-                                  nbytes=len(blob)):
+        with self._wd("transfer"), \
+                self.engine.obs.span("migrate_transfer", seq=self.seq,
+                                     nbytes=len(blob)):
             blobs = gather_host_blobs(blob)
         ack_entries: list = []
-        for src, b in enumerate(blobs):
-            if src == self.rank or not b:
-                continue
-            try:
-                ack_entries.extend(self.admit_blob(src, b))
-            except TransferCorrupt as exc:
-                # Quarantine WITHOUT leaving the round: the ack gather
-                # below is a rendezvous every peer is already committed
-                # to — an early exit here would strand the sender in
-                # phase 3 forever (exactly the mutation the protocol
-                # verifier's early-exit rule catches).
-                self._quarantine(src, b, exc)
-        acks = gather_host_blobs(
-            _pack_acks(self.rank, ack_entries, self.seq))
-        merged: list = []
-        for b in acks:
-            merged.extend(_unpack_acks(b))
-        self.release_acks(merged)
-        sealed = all_hosts_ok(True, value=self.seq)
+        with self._wd("adopt"):
+            for src, b in enumerate(blobs):
+                if src == self.rank or not b:
+                    continue
+                try:
+                    ack_entries.extend(self.admit_blob(src, b))
+                except TransferCorrupt as exc:
+                    # Quarantine WITHOUT leaving the round: the ack
+                    # gather below is a rendezvous every peer is
+                    # already committed to — an early exit here would
+                    # strand the sender in phase 3 forever (exactly
+                    # the mutation the protocol verifier's early-exit
+                    # rule catches).
+                    self._quarantine(src, b, exc)
+        with self._wd("release"):
+            acks = gather_host_blobs(
+                _pack_acks(self.rank, ack_entries, self.seq))
+            merged: list = []
+            for b in acks:
+                merged.extend(_unpack_acks(b))
+            self.release_acks(merged)
+            sealed = all_hosts_ok(True, value=self.seq)
         self.seq += 1
         del sizes, sealed
         return min(dones) == 1
@@ -581,7 +603,7 @@ class DisaggCluster:
     their most-recently-admitted slots."""
 
     def __init__(self, engines, *, prefill: int = 0, retries: int = 2,
-                 backoff_s: float = 0.0, faults=()):
+                 backoff_s: float = 0.0, faults=(), watchdog=None):
         if len(engines) < 2:
             raise ValueError("a disaggregated arena needs >= 2 engines "
                              "(one prefill + at least one decode host)")
@@ -595,11 +617,13 @@ class DisaggCluster:
                              else "decode"),
                        faults=wire, retries=retries,
                        backoff_s=backoff_s,
-                       on_admit=self._make_rebind(i))
+                       on_admit=self._make_rebind(i),
+                       watchdog=watchdog)
             for i, eng in enumerate(engines)]
         self.requests: list[ClusterRequest] = []
         self._by_key: dict[tuple[int, int], ClusterRequest] = {}
         self.dead: set[int] = set()
+        self.quarantined: set[int] = set()
         self.events: list[dict] = []
         self.ticks = 0
 
@@ -628,8 +652,12 @@ class DisaggCluster:
         return creq
 
     def decode_ranks(self) -> list[int]:
+        """Decode hosts eligible for NEW placement: alive and not
+        canary-quarantined (a quarantined engine still joins rounds —
+        its step is a no-op — but nothing new lands on it)."""
         return [h.rank for h in self.hosts
-                if h.alive and h.rank != self.prefill]
+                if h.alive and h.rank != self.prefill
+                and not getattr(h.engine, "quarantined", False)]
 
     def live_hosts(self) -> list[DisaggHost]:
         return [h for h in self.hosts if h.alive]
@@ -720,12 +748,17 @@ class DisaggCluster:
             h.seq += 1
 
     def tick(self) -> None:
-        """One cluster iteration: step every live engine, refresh the
-        failover journal, hand off prefill-complete requests, run one
-        migration round."""
+        """One cluster iteration: step every live engine, evacuate any
+        engine whose canary just condemned it, refresh the failover
+        journal, hand off prefill-complete requests, run one migration
+        round."""
         for h in self.live_hosts():
             h.engine.step()
         self.ticks += 1
+        for h in self.live_hosts():
+            if (getattr(h.engine, "quarantined", False)
+                    and h.rank not in self.quarantined):
+                self.evacuate(h.rank)
         self._journal()
         self._handoff()
         self._round()
@@ -805,6 +838,69 @@ class DisaggCluster:
             self.events.append({"kind": "failover",
                                 "rid": ticket.rid, "from": rank,
                                 "to": dest, "tick": self.ticks})
+        return moved
+
+    def evacuate(self, rank: int) -> list[ClusterRequest]:
+        """Migrate every live request OFF a canary-quarantined engine
+        (:meth:`tick` calls this the tick the engine condemns itself;
+        also callable directly).  Unlike :meth:`kill_host` the host
+        process is still running — but its chips are SUSPECT, so
+        nothing it could export is trusted: tickets are rebuilt from
+        the cluster's own view of each stream (committed tokens + the
+        per-slot PRNG chain, read the same way the failover journal
+        reads them) with NO pages, and receivers re-prefill — which is
+        deterministic, so the continuation is bit-exact for greedy and
+        sampled requests alike (the chain is the sampler's whole
+        state).  The quarantined engine keeps its wreckage: it stopped
+        emitting the moment the canary mismatched, and it stays out of
+        :meth:`decode_ranks` so nothing new lands on it."""
+        h = self.hosts[rank]
+        if rank in self.quarantined or not h.alive:
+            return []
+        self.quarantined.add(rank)
+        eng = h.engine
+        survivors = [k for k in self.decode_ranks() if k != rank]
+        if not survivors and rank != self.prefill:
+            survivors = [self.prefill]
+        if not survivors:
+            raise RuntimeError(
+                f"no healthy host left to evacuate host {rank} to")
+        orphans = [c for c in self.requests
+                   if c.host == rank and not c.done]
+        moved = []
+        for i, creq in enumerate(
+                sorted(orphans, key=lambda c: c.handle.id)):
+            r = creq.handle
+            if r._slot is not None and eng._slots[r._slot] is r:
+                key = np.asarray(eng._keys[r._slot])
+            else:
+                key = r._resume_key
+            dest = survivors[i % len(survivors)]
+            ticket = MigrationTicket(
+                rid=r.id, model=r._ms.name, prompt=creq.prompt,
+                tokens=tuple(int(t) for t in r.tokens),
+                max_new_tokens=r.max_new_tokens,
+                temperature=r.temperature, top_k=r.top_k,
+                top_p=r.top_p, seed=r.seed, eos_id=r.eos_id,
+                deadline_s=None, tenant=r.tenant,
+                migrations=r.migrations + 1,
+                preemptions=r.preemptions,
+                draft_proposed=r.draft_proposed,
+                draft_accepted=r.draft_accepted,
+                resume_key=key, page_tokens=0, pages=())
+            deng = self.hosts[dest].engine
+            r2 = deng.admit_ticket(ticket)
+            deng.obs.event("evacuate", rid=ticket.rid, from_host=rank,
+                           to_host=dest, tokens=len(ticket.tokens))
+            deng.stats["evacuation_resumes"] += 1
+            creq.handle = r2
+            creq.host = dest
+            if creq.cancel_pending:
+                deng.cancel(r2)
+            moved.append(creq)
+            self.events.append({"kind": "evacuate", "rid": ticket.rid,
+                                "from": rank, "to": dest,
+                                "tick": self.ticks})
         return moved
 
     # -- explicit migration / rebalancing ------------------------------

@@ -1480,7 +1480,25 @@ class Engine:
     blocking device call; ``step_fault_hook`` (a public attribute; also
     settable later) is called as ``hook(kind, index)`` immediately
     before each device call — the fault-injection seam
-    ``tpudp.serve.faults`` plugs into.
+    ``tpudp.serve.faults`` plugs into.  ``token_fault_hook(slot, tok,
+    request) -> tok`` sits in the single token-commit funnel — the
+    SILENT-corruption seam (a flipped sampled token commits and
+    conditions every later decode step, exactly what corrupted logits
+    produce); ``tpudp.serve.faults.BitFlipLogits`` plugs in here.
+
+    Serving canary (``canary_every_s``; the serve half of the tpudp.sdc
+    silent-data-corruption defense): every that-many seconds the engine
+    submits a pinned known-prompt GREEDY request through the normal
+    scheduler and byte-compares its token stream against the reference
+    pinned by the first clean run — greedy decode on fixed weights is
+    deterministic, so ANY divergence means a chip computed
+    wrong-but-finite numbers somewhere under this engine.  A mismatch
+    QUARANTINES the engine (:attr:`quarantined`: admission stops, the
+    step loop idles, emitted-so-far tokens stay valid) so
+    ``DisaggCluster`` can migrate the live requests out by ticket with
+    bit-exact continuation.  Canary requests never appear in
+    ``step()``'s emitted pairs; loud canary failures (containment,
+    deadline) count as ``canary_errors``, not corruption.
 
     Tenancy knobs (``tpudp.serve.tenancy``; module docstring
     "Multi-tenancy layer"): ``tenants={name: TenantClass(...)}`` turns
@@ -1506,7 +1524,10 @@ class Engine:
                  queue_limit: int | None = None,
                  drafter_timeout_s: float | None = None,
                  watchdog=None, step_timeout_s: float | None = None,
-                 step_fault_hook=None, tenants: dict | None = None,
+                 step_fault_hook=None, token_fault_hook=None,
+                 canary_every_s: float | None = None,
+                 canary_prompt=None, canary_new_tokens: int = 8,
+                 tenants: dict | None = None,
                  models: dict | None = None, obs: bool = True,
                  flight_dir: str | None = None):
         cfg = model.config
@@ -1771,6 +1792,30 @@ class Engine:
         self.queue_limit = queue_limit
         self.drafter_timeout_s = drafter_timeout_s
         self.step_fault_hook = step_fault_hook
+        self.token_fault_hook = token_fault_hook
+        # Serving canary (silent-corruption defense, module docstring):
+        # reference pinned by the first clean completion; a later
+        # mismatch quarantines the engine.
+        if canary_every_s is not None and canary_every_s < 0:
+            raise ValueError(
+                f"canary_every_s must be >= 0 (0 = a canary in flight "
+                f"whenever possible), got {canary_every_s}")
+        if canary_new_tokens < 1:
+            raise ValueError(
+                f"canary_new_tokens must be >= 1, got {canary_new_tokens}")
+        self.canary_every_s = canary_every_s
+        if canary_prompt is None:
+            # Deterministic pinned prompt: fixed tokens valid for any
+            # vocab — the same bytes every process lifetime.
+            canary_prompt = (np.arange(1, 9, dtype=np.int32)
+                             % model.config.vocab_size)
+        self._canary_prompt = np.asarray(canary_prompt, np.int32)
+        self._canary_new_tokens = canary_new_tokens
+        self._canary_ref: tuple | None = None
+        self._canary_active = None
+        self._canary_last = -float("inf")  # first canary fires at once
+        self._quarantined = False
+        self.quarantine_reason: str | None = None
         self._watchdog = watchdog
         self._step_timeout_s = step_timeout_s
         self._device_calls = 0
@@ -2077,8 +2122,11 @@ class Engine:
         the engine keeps serving — the one failure mode this layer
         forbids is a wedge.  A closed engine's step is a no-op."""
         emitted: list[tuple[Request, int]] = []
-        if self._closed:
+        if self._closed or self._quarantined:
             return emitted
+        self._maybe_canary()
+        if self._quarantined:
+            return emitted  # the canary just condemned this engine
         try:
             # Deadline expiry and admission sit INSIDE the containment
             # region: with prefix caching on, a deadline retirement can
@@ -2138,6 +2186,11 @@ class Engine:
         except Exception as exc:  # noqa: BLE001 — containment by design
             self._contain_step_failure(exc)
         self.stats["steps"] += 1
+        if self.canary_every_s is not None:
+            # Canary tokens are the engine's own probe traffic — they
+            # live on the canary handle, never in the emitted pairs.
+            emitted = [(r, t) for (r, t) in emitted
+                       if not getattr(r, "_canary", False)]
         return emitted
 
     def cancel(self, request: Request) -> bool:
@@ -2168,8 +2221,14 @@ class Engine:
         return True
 
     def run_until_complete(self) -> None:
-        """Drive the engine until every queue and every slot is empty."""
+        """Drive the engine until every queue and every slot is empty.
+        Stops early if a canary quarantine fires — a quarantined
+        engine's step is a no-op, and its live requests are waiting to
+        be MIGRATED out (``DisaggCluster.evacuate``), not finished
+        here."""
         while self.queue_depth or any(r is not None for r in self._slots):
+            if self._quarantined:
+                return
             self.step()
 
     # -- cross-host migration hooks (tpudp/serve/disagg.py) ------------
@@ -2373,6 +2432,14 @@ class Engine:
         return self._drafter_quarantined
 
     @property
+    def quarantined(self) -> bool:
+        """True once a canary mismatch has condemned this engine
+        (``quarantine_reason`` says why).  A quarantined engine stops
+        admission and stepping; its live requests wait to be migrated
+        out (``DisaggCluster.evacuate``)."""
+        return self._quarantined
+
+    @property
     def slots_in_use(self) -> int:
         return sum(r is not None for r in self._slots)
 
@@ -2421,6 +2488,16 @@ class Engine:
             "obs_counters": dict(self.obs.counters),
             "flight_dumps": self.flight.dumps,
         }
+        if self.canary_every_s is not None or self._quarantined:
+            out["canary"] = {
+                "runs": self.stats["canary_runs"],
+                "errors": self.stats["canary_errors"],
+                "skipped": self.stats["canary_skipped"],
+                "mismatch": self.stats["canary_mismatch"],
+                "ref_pinned": self._canary_ref is not None,
+                "quarantined": self._quarantined,
+                "quarantine_reason": self.quarantine_reason,
+            }
         if self._sched is not None:
             out["tenants"] = {name: dict(c)
                               for name, c in self.tenant_stats.items()}
@@ -3187,6 +3264,75 @@ class Engine:
             r.draft_proposed += proposed
             self.stats["draft_tokens"] += proposed
 
+    # -- serving canary (silent-corruption defense) --------------------
+
+    def _maybe_canary(self) -> None:
+        """Drive the canary lifecycle, one call per scheduler iteration
+        (``canary_every_s`` set).  Harvest a finished canary first:
+        compare its token stream against the pinned reference — the
+        first clean completion pins it; greedy decode of a fixed prompt
+        is deterministic, so ANY later byte difference is evidence of
+        silent corruption and quarantines the engine.  Then launch the
+        next canary once the cadence interval has elapsed.  Loud canary
+        failures (deadline, containment ERROR) count as
+        ``canary_errors``, not corruption — those fault classes already
+        have their own detectors."""
+        if self.canary_every_s is None or not self._accepting:
+            return
+        r = self._canary_active
+        if r is not None:
+            if r.finish_reason is None:
+                return  # still decoding; one canary in flight at a time
+            self._canary_active = None
+            if r.finish_reason is not FinishReason.COMPLETE:
+                self.stats["canary_errors"] += 1
+            else:
+                got = tuple(int(t) for t in r.tokens)
+                self.stats["canary_runs"] += 1
+                if self._canary_ref is None:
+                    self._canary_ref = got
+                    self.obs.event("canary_pin", tokens=len(got))
+                elif got != self._canary_ref:
+                    self._quarantine_canary(self._canary_ref, got)
+                    return
+        if time.monotonic() - self._canary_last < self.canary_every_s:
+            return
+        try:
+            req = self.submit(self._canary_prompt, self._canary_new_tokens,
+                              temperature=0.0, seed=0)
+        except (QueueFull, ValueError):
+            # Saturated (or tenancy without a default class): skip this
+            # cadence tick rather than shed real traffic for a probe.
+            self.stats["canary_skipped"] += 1
+            self._canary_last = time.monotonic()
+            return
+        req._canary = True
+        self._canary_active = req
+        self._canary_last = time.monotonic()
+
+    def _quarantine_canary(self, expected: tuple, got: tuple) -> None:
+        """Canary mismatch == silent corruption somewhere under this
+        engine: stop admission AND stop stepping, leaving live requests
+        in place for ``DisaggCluster.evacuate`` to migrate out
+        bit-exactly (the prefix-replay ticket protocol).  Unlike
+        drafter quarantine (drafts are hints — outputs unchanged), this
+        engine's OUTPUTS are no longer trustworthy, so it must not emit
+        another token."""
+        self._quarantined = True
+        self._accepting = False
+        self.stats["canary_mismatch"] += 1
+        self.stats["quarantined"] = 1
+        diff = next((i for i, (a, b) in enumerate(zip(expected, got))
+                     if a != b), min(len(expected), len(got)))
+        self.quarantine_reason = (
+            f"canary token stream diverged from pinned reference at "
+            f"token {diff}: expected {list(expected)}, got {list(got)}")
+        self.obs.event("canary_quarantine", first_diff=diff,
+                       expected=list(expected), got=list(got))
+        self.flight.dump("canary_quarantine", extra={
+            "expected": list(expected), "got": list(got),
+            "first_diff": diff})
+
     def _gather_drafts(self, ms, active, k):
         """Host-side draft proposals for every decoding slot, behind the
         fault-isolation wall: a drafter that raises, returns non-integer
@@ -3504,6 +3650,12 @@ class Engine:
 
     def _commit(self, s: int, tok: int, emitted) -> None:
         r = self._slots[s]
+        if self.token_fault_hook is not None:
+            # The silent-corruption seam (tpudp.serve.faults): a flipped
+            # token committed here conditions every later decode step of
+            # this slot — exactly the downstream signature corrupted
+            # logits would produce.
+            tok = int(self.token_fault_hook(s, tok, r))
         r.tokens.append(tok)
         r.token_times.append(time.perf_counter())
         self._last[s] = tok

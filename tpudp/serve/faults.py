@@ -28,6 +28,13 @@ Two injection seams, both first-class engine API:
     stays one-shot).  Raising simulates a device-step failure (XLA
     error, preempted TPU); sleeping simulates a wedged step for the
     watchdog to catch.
+  * **Token faults** — :class:`BitFlipLogits` is an
+    ``Engine(token_fault_hook=...)`` callable invoked as
+    ``hook(slot, tok, request) -> tok`` where each sampled token is
+    committed to its stream.  It corrupts SILENTLY (no exception, no
+    counter) — the loud seams above prove the containment machinery;
+    this one proves the serving canary (``Engine(canary_every_s=...)``)
+    catches what containment cannot see.
 
 A third seam exercises the TENANCY layer rather than a fault contract:
 :class:`PreemptionStorm` submits short bursts into a high-priority
@@ -217,6 +224,62 @@ class SlowSteps:
                                        or kind == self.kind):
             self.fired.append((kind, index))
             time.sleep(self.delay_s)
+
+
+class BitFlipLogits:
+    """Silent-corruption injector for the serving path: XORs one bit of
+    a committed token via ``Engine(token_fault_hook=...)`` — the seam
+    runs where the sampled token enters the request's stream, so the
+    corrupted token conditions every later decode step of that slot,
+    exactly the downstream signature of corrupted logits on a bad chip.
+    Nothing raises and no counter trips: the ONLY way this fault is
+    visible is that the bytes are wrong, which is what makes it the
+    driver for the serving canary (``Engine(canary_every_s=...)``).
+
+    ``flips`` is a ``(call, slot, bit)`` schedule, mirroring the
+    ``(step, replica, bit)`` convention of the training injectors
+    (``tpudp.sdc``): ``call`` indexes the injector's own monotonic
+    count of ELIGIBLE commits (all commits, or only canary commits
+    with ``canary_only=True`` — so a canary-only schedule is stable no
+    matter how much real traffic interleaves), ``slot`` restricts to
+    one arena slot (``None`` = any), ``bit`` is the bit to XOR.  With
+    ``vocab`` set, a flip that would leave the vocabulary falls back to
+    progressively lower bits (then ``(tok + 1) % vocab``), so the
+    corrupted token is always decodable and always different.
+    ``fired`` records ``(call, slot, clean, corrupt)``."""
+
+    def __init__(self, flips, vocab: int | None = None,
+                 canary_only: bool = False):
+        self.flips = [(int(c), None if s is None else int(s), int(b))
+                      for (c, s, b) in flips]
+        for c, _, b in self.flips:
+            if c < 0 or b < 0:
+                raise ValueError(
+                    f"call and bit must be >= 0, got ({c}, {b})")
+        if vocab is not None and vocab < 2:
+            raise ValueError(f"vocab must be >= 2, got {vocab}")
+        self.vocab = vocab
+        self.canary_only = canary_only
+        self.calls = 0
+        self.fired: list[tuple[int, int, int, int]] = []
+
+    def __call__(self, slot: int, tok: int, request) -> int:
+        if self.canary_only and not getattr(request, "_canary", False):
+            return tok
+        call = self.calls
+        self.calls += 1
+        for c, s, b in self.flips:
+            if c != call or (s is not None and s != slot):
+                continue
+            for bb in (b, *range(b - 1, -1, -1)):
+                corrupt = tok ^ (1 << bb)
+                if self.vocab is None or 0 <= corrupt < self.vocab:
+                    break
+            else:
+                corrupt = (tok + 1) % self.vocab
+            self.fired.append((call, slot, tok, corrupt))
+            return corrupt
+        return tok
 
 
 # -- cross-host transfer faults (tpudp/serve/disagg.py) ---------------

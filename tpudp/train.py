@@ -72,7 +72,18 @@ class TrainState(struct.PyTreeNode):
     only by ``Trainer.metrics()``, never on the per-step path.  The
     default ``None`` contributes no pytree leaf, so the state (and
     every checkpoint/sharding/fingerprint consumer) is byte-for-byte
-    the pre-obs layout."""
+    the pre-obs layout.
+
+    ``sdc_fp`` is the third rider on the pattern: the in-step
+    silent-data-corruption fingerprint (tpudp.sdc.traced_fingerprint —
+    an exact wraparound-u32 checksum of the post-update params +
+    optimizer-state bits) recomputed INSIDE the jitted step when
+    allocated (``init_state(track_sdc=True)`` / ``Trainer(
+    track_sdc_fingerprint=True)``).  Healthy DP replicas hold
+    bit-identical bytes, so their fingerprints agree bit-for-bit; the
+    resilience layer fetches it only at the window-edge seam where the
+    host already synchronizes for ``loss_sum`` and majority-votes it
+    across replicas (``ResiliencePolicy(sdc_check_every=N)``)."""
 
     step: jnp.ndarray
     params: Any
@@ -80,6 +91,7 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     loss_sum: jnp.ndarray
     obs_norms: Any = None
+    sdc_fp: Any = None
 
 
 def make_optimizer(
@@ -210,12 +222,14 @@ def init_state(
     seed: int = 0,
     input_dtype=None,
     track_grad_norm: bool = False,
+    track_sdc: bool = False,
 ) -> TrainState:
     """Initialize params/batch_stats/optimizer state (reference seeds both
     RNGs with 0: ``src/Part 2a/main.py:20-21``).  ``input_dtype`` defaults to
     float32 for image-shaped (>2-D) inputs and int32 for 2-D token inputs.
     ``track_grad_norm`` allocates the ``obs_norms`` device accumulator
-    (see :class:`TrainState`); off — the default — adds no leaf."""
+    and ``track_sdc`` the ``sdc_fp`` in-step fingerprint slot (see
+    :class:`TrainState`); off — the default — adds no leaf."""
     if input_dtype is None:
         input_dtype = jnp.float32 if len(input_shape) > 2 else jnp.int32
     variables = model.init(jax.random.PRNGKey(seed),
@@ -230,6 +244,7 @@ def init_state(
         loss_sum=jnp.zeros((), jnp.float32),
         obs_norms=(jnp.zeros((2,), jnp.float32) if track_grad_norm
                    else None),
+        sdc_fp=(jnp.zeros((2,), jnp.uint32) if track_sdc else None),
     )
 
 
@@ -341,6 +356,18 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
         new_norms = new_norms + jnp.stack([gn, gn * gn])
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
+    # In-step SDC fingerprint (tpudp.sdc): exact u32 checksum of the
+    # post-update params + optimizer-state BITS, recomputed each step
+    # when the slot is allocated.  Healthy replicas hold bit-identical
+    # bytes after the synced update, so fingerprints agree bit-for-bit;
+    # the host fetches this only at the window-edge seam.  The presence
+    # test is pytree structure, static at trace time.
+    new_fp = state.sdc_fp
+    if new_fp is not None:
+        from tpudp.sdc import traced_fingerprint
+
+        new_fp = traced_fingerprint({"params": new_params,
+                                     "opt_state": new_opt})
     return (
         TrainState(
             step=state.step + 1,
@@ -349,6 +376,7 @@ def _loss_and_updates(model, tx, state: TrainState, images, labels, sync_fn,
             opt_state=new_opt,
             loss_sum=state.loss_sum + loss,
             obs_norms=new_norms,
+            sdc_fp=new_fp,
         ),
         loss,
     )
@@ -791,6 +819,8 @@ class Trainer:
         verify_replicas: bool = False,
         step_fault_hook: Callable[[str, int], None] | None = None,
         track_grad_norm: bool = False,
+        track_sdc_fingerprint: bool = False,
+        sdc_fault_hook: Callable[[TrainState], TrainState] | None = None,
         flight_dir: str | None = None,
     ):
         from tpudp.obs import FlightRecorder, Recorder
@@ -826,6 +856,14 @@ class Trainer:
         # trainer analogue of serve's Engine(step_fault_hook=).
         self.step_fault_hook = step_fault_hook
         self._device_calls = 0  # monotonic: a retried step gets a NEW index
+        # SDC injection seam (tpudp.sdc.BitFlipParams/BitFlipGrads):
+        # called as state = hook(state) AFTER each train step, so the
+        # injector can corrupt one replica's post-update buffers —
+        # replicated-by-assumption, divergent-in-fact, the byte-level
+        # state a real silent flip produces.  Test/soak only; None (the
+        # default) costs nothing.
+        self.sdc_fault_hook = sdc_fault_hook
+        self.track_sdc_fingerprint = track_sdc_fingerprint
         # Post-epoch DP desync detector (tpudp.utils.consistency): torch
         # DDP's _verify_params_across_processes analogue, opt-in because
         # it fetches every replicated shard to the host.
@@ -848,7 +886,8 @@ class Trainer:
                               if compress is not None else None))
         self.state = init_state(model, self.tx, input_shape=input_shape,
                                 seed=seed,
-                                track_grad_norm=track_grad_norm)
+                                track_grad_norm=track_grad_norm,
+                                track_sdc=track_sdc_fingerprint)
         self.timing_mode = timing_mode
         self.log_every = log_every
         self.log = log_fn
@@ -1102,6 +1141,11 @@ class Trainer:
                 step_tok = self.obs.begin("train.dispatch")
                 self.state, _ = self.train_step(self.state, images, labels)
                 self.obs.end(step_tok)
+            if self.sdc_fault_hook is not None:
+                # SDC seam (tpudp.sdc): the injector flips a bit in ONE
+                # replica's post-update buffers — the corruption model
+                # under test.  Host-side buffer surgery, no device sync.
+                self.state = self.sdc_fault_hook(self.state)
             if it % self.log_every == 0:
                 # Window barrier: a device->host FETCH of a parameter leaf —
                 # under some device transports (axon relay) even
@@ -1121,6 +1165,11 @@ class Trainer:
                 if self._resilience is not None:
                     self._resilience.observe_window_loss(
                         losses[-1], epoch=epoch, it=it)
+                    # SDC fingerprint check rides the SAME window-edge
+                    # seam the loss fetch just paid for — cadence-gated
+                    # inside (policy.sdc_check_every), no-op otherwise.
+                    self._resilience.observe_window_state(
+                        self.state, epoch=epoch, it=it)
                 prev_loss_sum = cum
                 # Reference-parity window lines through the span-backed
                 # formatter (tpudp.obs.reference_window_lines) — the
@@ -1166,6 +1215,8 @@ class Trainer:
             if self._resilience is not None:
                 self._resilience.observe_window_loss(
                     losses[-1], epoch=epoch, it=it)
+                self._resilience.observe_window_state(
+                    self.state, epoch=epoch, it=it)
             beat()
         return float(np.mean(losses)) if losses else 0.0
 

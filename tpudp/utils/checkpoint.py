@@ -30,13 +30,21 @@ all).  Shard records verify elastically: a checkpoint saved at N hosts
 re-verifies at M hosts by checking every recorded global slice that is
 addressable on the current topology (the reassembled view covers all of
 them when the pod shrinks).
+
+Transient I/O (``_retry_fs``): every save/restore/manifest touch of the
+checkpoint filesystem retries EIO-class errnos a bounded number of
+times with linear backoff — on a real pod that path is NFS/GCS-fuse,
+where a dropped lease surfaces as a one-off EIO on a healthy file.
+Non-transient errnos (ENOENT, EACCES, ENOSPC) propagate immediately.
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import re
-from typing import Any
+import time
+from typing import Any, Callable
 
 import jax
 
@@ -46,6 +54,41 @@ try:
     HAVE_ORBAX = True
 except ImportError:  # pragma: no cover - orbax is baked into this image
     HAVE_ORBAX = False
+
+
+# Errnos worth retrying: the I/O path under a checkpoint dir on a real
+# pod is NFS/GCS-fuse, where a dropped lease or a congested link
+# surfaces as EIO/ESTALE/EAGAIN on an otherwise healthy file — a retry
+# a moment later succeeds.  ENOENT/EACCES/ENOSPC and friends are NOT
+# here on purpose: a missing file, bad permission, or full disk is a
+# real answer, and retrying it only delays the real error.
+_TRANSIENT_ERRNOS = frozenset({
+    errno.EIO, errno.EAGAIN, errno.EBUSY, errno.EINTR, errno.ESTALE,
+    errno.ETIMEDOUT,
+})
+
+# Module-level knobs so tests (and unusual deployments) can tune the
+# policy without threading arguments through every save/restore call.
+FS_RETRIES = 3          # attempts after the first = FS_RETRIES
+FS_BACKOFF_S = 0.05     # linear: sleep(FS_BACKOFF_S * attempt)
+
+
+def _retry_fs(fn: Callable[[], Any], what: str):
+    """Run ``fn()`` retrying TRANSIENT filesystem errors (the
+    ``_TRANSIENT_ERRNOS`` set) up to ``FS_RETRIES`` times with linear
+    ``FS_BACKOFF_S`` backoff; any other ``OSError`` — and the final
+    transient failure — propagates unchanged.  Bounded by construction:
+    a checkpoint path that stays broken must become the caller's loud
+    error (save fails, restore falls back to the previous verified
+    step), never a silent spin."""
+    for attempt in range(FS_RETRIES + 1):
+        try:
+            return fn()
+        except OSError as exc:
+            if (exc.errno not in _TRANSIENT_ERRNOS
+                    or attempt >= FS_RETRIES):
+                raise
+            time.sleep(FS_BACKOFF_S * (attempt + 1))
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -306,16 +349,26 @@ def write_manifest(path: str | os.PathLike, state: Any) -> str:
 
     if jax.process_count() > 1:
         hpath = host_manifest_path(path, jax.process_index())
-        with open(hpath, "w") as f:
-            json.dump({"format": 2, "host": jax.process_index(),
-                       "nprocs": jax.process_count(),
-                       "leaves": leaf_shard_checksums(state)}, f)
-            f.flush()
-            os.fsync(f.fileno())
+        payload = {"format": 2, "host": jax.process_index(),
+                   "nprocs": jax.process_count(),
+                   "leaves": leaf_shard_checksums(state)}
+
+        def _write_host() -> None:
+            with open(hpath, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+
+        _retry_fs(_write_host, f"host manifest write ({hpath})")
         return hpath
     mpath = manifest_path(path)
-    with open(mpath, "w") as f:
-        json.dump({"format": 1, "leaves": leaf_checksums(state)}, f)
+    payload = {"format": 1, "leaves": leaf_checksums(state)}
+
+    def _write() -> None:
+        with open(mpath, "w") as f:
+            json.dump(payload, f)
+
+    _retry_fs(_write, f"manifest write ({mpath})")
     return mpath
 
 
@@ -334,12 +387,16 @@ def commit_after_all_hosts(path: str | os.PathLike) -> None:
         f"tpudp_ckpt_commit:{os.path.basename(os.fspath(path))}")
     if jax.process_index() != 0:
         return
-    with open(commit_marker_path(path), "w") as f:
-        json.dump({"nprocs": jax.process_count(),
-                   "committed_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                                 time.gmtime())}, f)
-        f.flush()
-        os.fsync(f.fileno())
+
+    def _write_marker() -> None:
+        with open(commit_marker_path(path), "w") as f:
+            json.dump({"nprocs": jax.process_count(),
+                       "committed_at": time.strftime(
+                           "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    _retry_fs(_write_marker, "commit marker write")
 
 
 def read_manifest(path: str | os.PathLike) -> dict | None:
@@ -347,9 +404,14 @@ def read_manifest(path: str | os.PathLike) -> dict | None:
     absent/unreadable (checkpoints saved before manifests existed)."""
     import json
 
-    try:
+    def _read() -> dict:
         with open(manifest_path(path)) as f:
             return json.load(f)
+
+    try:
+        # Retried: a transient EIO here would otherwise read as "no
+        # manifest" and silently skip verification of a real one.
+        return _retry_fs(_read, "manifest read")
     except (FileNotFoundError, json.JSONDecodeError, OSError):
         return None
 
@@ -494,7 +556,8 @@ def save_checkpoint(path: str | os.PathLike, state: Any, *,
         # once wrote): a leftover host manifest would be verified
         # against the new bytes and reject the fresh save forever.
         invalidate_commit(path)
-    _checkpointer().save(path, state, force=force)
+    _retry_fs(lambda: _checkpointer().save(path, state, force=force),
+              f"checkpoint save ({path})")
     if manifest:
         write_manifest(path, state)
         if multihost:
@@ -540,8 +603,10 @@ def restore_checkpoint(path: str | os.PathLike, target: Any, *,
 
     abstract = jax.tree.map(as_abstract, target)
     restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
-    restored = _checkpointer().restore(path, item=abstract,
-                                       restore_args=restore_args)
+    restored = _retry_fs(
+        lambda: _checkpointer().restore(path, item=abstract,
+                                        restore_args=restore_args),
+        f"checkpoint restore ({path})")
     if verify:
         ok, detail = verify_restored(path, restored)
         if not ok:

@@ -148,3 +148,34 @@ def fingerprint(tree) -> np.ndarray:
             sqs += float((a * a).sum())
             n += a.size
     return np.array([sums, sqs, n])
+
+
+def fingerprint_coverage(tree) -> dict:
+    """Classify every leaf of ``tree`` by how :func:`fingerprint` (and
+    the in-step SDC checksum, which applies the same rule) treats it:
+
+    * ``included`` — fully addressable or fully replicated: its bytes
+      are in the fingerprint, so corruption there is detectable;
+    * ``excluded_sharded`` — genuinely sharded across processes
+      (ZeRO-1 optimizer state, FSDP params): per-host sums differ by
+      construction, so it is EXCLUDED by rule and covered by the
+      per-host checkpoint shard manifests instead;
+    * ``excluded_non_array`` — not a ``jax.Array`` (a Python scalar or
+      host numpy leaf): invisible to the fingerprint.
+
+    The leaf-coverage regression test pins this classification for the
+    real TrainState: every leaf must land in ``included`` or
+    ``excluded_sharded`` — a new leaf silently falling into
+    ``excluded_non_array`` is a HOLE in the corruption detector, not an
+    implementation detail."""
+    out: dict[str, list[str]] = {"included": [], "excluded_sharded": [],
+                                 "excluded_non_array": []}
+    for path, leaf in _leaf_paths(tree):
+        if not isinstance(leaf, jax.Array):
+            out["excluded_non_array"].append(path)
+        elif (not getattr(leaf, "is_fully_addressable", True)
+                and not getattr(leaf, "is_fully_replicated", False)):
+            out["excluded_sharded"].append(path)
+        else:
+            out["included"].append(path)
+    return out
