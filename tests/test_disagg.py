@@ -863,6 +863,54 @@ def test_canary_quarantine_evacuates_live_requests_bit_exact(
     cl.check()
 
 
+def test_evacuation_trusts_journal_not_suspect_device_memory(
+        model_and_params):
+    """The evacuation contract's sharp edge: tickets must be rebuilt
+    from the cluster's failover-journal snapshot, NOT fetched from the
+    condemned engine's device memory.  Poison the suspect engine's
+    per-slot PRNG chains and its committed tail token AFTER the last
+    journal refresh — the journal-sourced rebuild still finishes every
+    stream, greedy and sampled, bit-identical to a clean cluster."""
+    model, params = model_and_params
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, 61, size=4).astype(np.int32)
+               for _ in range(2)]
+
+    def mk():
+        engs = [Engine(model, params, num_slots=4, max_len=32,
+                       prefill_chunk=8) for _ in range(2)]
+        return engs, DisaggCluster(engs)
+
+    def submit(cl):
+        return [cl.submit(prompts[0], 10),
+                cl.submit(prompts[1], 10, temperature=0.8, top_k=7,
+                          seed=5)]
+
+    _, clean = mk()
+    want = [h.result() for h in submit(clean)]
+
+    engs, cl = mk()
+    hs = submit(cl)
+    for _ in range(60):  # both decoding on host 1, journal refreshed
+        cl.tick()
+        if all(h.host == 1 and len(h.tokens) >= 2 for h in hs):
+            break
+    assert all(h.host == 1 and not h.done for h in hs)
+    assert all(c.snap[0] for c in hs)  # journal carries the streams
+    # The silent-corruption moment: device memory lies (chains bumped,
+    # tail token rewritten), the already-journaled snapshot does not.
+    engs[1]._keys = engs[1]._keys + 1
+    for h in hs:
+        h.handle.tokens[-1] = (h.handle.tokens[-1] + 1) % 61
+    engs[1]._quarantined = True
+    engs[1].quarantine_reason = "test: condemned"
+    cl.run_until_complete()
+    assert cl.quarantined == {1}
+    assert [e for e in cl.events if e["kind"] == "evacuate"]
+    for w, h in zip(want, hs):
+        np.testing.assert_array_equal(w, h.result())
+
+
 def test_quarantined_engine_excluded_from_placement(model_and_params):
     """decode_ranks must skip a canary-quarantined engine immediately —
     new admissions and rebalances never land on a condemned host."""

@@ -23,9 +23,10 @@ from tpudp.data.loader import DataLoader
 from tpudp.mesh import make_mesh, make_mesh_nd
 from tpudp.resilience import ResiliencePolicy
 from tpudp.sdc import (QUARANTINE_MARKER, BitFlipGrads, BitFlipParams,
-                       SdcPersistentError, flip_bit_on_replica,
-                       localize_minority, np_fingerprint,
-                       replica_fingerprints, traced_fingerprint,
+                       SdcDetected, SdcPersistentError,
+                       flip_bit_on_replica, localize_minority,
+                       np_fingerprint, replica_fingerprints,
+                       traced_fingerprint, vote_fp_shards,
                        vote_shard_groups)
 from tpudp.train import Trainer
 
@@ -129,6 +130,45 @@ def test_vote_groups_by_shard_index_pp_layout():
     assert minority == [f"p0/d{dev}"]
     assert f"p0/d{dev}" not in majority
     assert len(majority) == 7  # both groups' healthy members
+
+
+def test_flip_bit_respects_dtype_width():
+    """Bit indices beyond the dtype's width must wrap to a REAL bit of
+    the word (bit % (8*itemsize)), never silently no-op above it while
+    the injector records the flip as fired — a no-op 'flip' would make
+    a soak count a detection for corruption that never happened."""
+    mesh = make_mesh()
+    for dtype, bit in [(np.float16, 20), (np.uint8, 10),
+                       (np.float32, 37)]:
+        leaf = jax.device_put(
+            np.ones(4, dtype),
+            jax.sharding.NamedSharding(mesh,
+                                       jax.sharding.PartitionSpec()))
+        once = flip_bit_on_replica(leaf, 1, bit)
+        assert not np.array_equal(
+            np.asarray(once.addressable_shards[1].data),
+            np.asarray(leaf.addressable_shards[1].data)), dtype
+        twice = flip_bit_on_replica(once, 1, bit)
+        assert np.array_equal(
+            np.asarray(twice.addressable_shards[1].data),
+            np.asarray(leaf.addressable_shards[1].data)), dtype
+
+
+def test_vote_fp_shards_names_divergent_replica():
+    """The cheap detection path: each device's shard of the
+    'replicated' sdc_fp leaf is its own computed checksum, so voting
+    the (2,)-u32 shards names a divergent replica without touching the
+    model bytes."""
+    mesh = make_mesh()
+    n = len(jax.devices())
+    fp = jax.device_put(
+        np.array([123456, 99], np.uint32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    assert vote_fp_shards(fp) == ([], sorted(f"p0/d{i}" for i in range(n)))
+    bad = 3 % n
+    minority, majority = vote_fp_shards(flip_bit_on_replica(fp, bad, 11))
+    assert minority == [f"p0/d{bad}"]
+    assert len(majority) == n - 1
 
 
 def test_localize_minority_verdicts():
@@ -291,6 +331,31 @@ def test_persistent_flip_quarantines(tmp_path):
     with open(marker) as f:
         m = json.load(f)
     assert m["replicas"] == ["p0/d1"] and m["host"] == 0
+
+
+def test_unlocalizable_tie_never_quarantines(tmp_path):
+    """Two replicas disagreeing is corruption PROVEN but the culprit
+    unknowable — repeated unlocalizable detections must keep riding the
+    rollback (whose budget escalates with the original SdcDetected),
+    never quarantine: a quarantine naming every replica would condemn
+    the healthy chip alongside the sick one."""
+    inj = BitFlipParams(persist_from=3, replica=1, bit=7)
+    tr = Trainer(SmallConv(), make_mesh(2), log_every=2,
+                 log_fn=lambda s: None, track_sdc_fingerprint=True,
+                 sdc_fault_hook=inj)
+    with pytest.raises(SdcDetected) as ei:
+        tr.fit(_loader(), epochs=2,
+               resilience=ResiliencePolicy(checkpoint_dir=str(tmp_path),
+                                           sdc_check_every=2,
+                                           max_rollbacks=2))
+    assert ei.value.replica is None  # culprit never named
+    assert tr.stats["sdc_quarantines"] == 0
+    assert tr.stats["rollbacks"] == 2
+    assert tr.stats["sdc_detections"] >= 2
+    det = [e for e in tr.stats["events"] if e["kind"] == "sdc_detected"]
+    assert det and all(not e["localized"] for e in det)
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           QUARANTINE_MARKER))
 
 
 def test_sdc_check_requires_fingerprint_tracking(tmp_path):

@@ -38,15 +38,21 @@ Recovery taxonomy (docs/RESILIENCE.md):
   * **Silent-data-corruption defense** (``sdc_check_every=N`` +
     ``Trainer(track_sdc_fingerprint=True)``, tpudp.sdc) — every N
     optimizer steps, at the window-edge seam the host already pays
-    for, per-replica fingerprints of the params/optimizer bytes are
-    majority-voted (shard groups locally, the in-step ``sdc_fp``
-    checksum across hosts).  A mismatch names the minority replica and
-    rides the divergence rollback; the bit-exact replay is the oracle
-    that GRADES it — a clean re-check is a transient flip (continue,
-    params repaired bit-identically), the same replica diverging again
-    is a persistently bad chip: quarantine (marker +
+    for, the per-device shards of the in-step ``sdc_fp`` checksum are
+    majority-voted (locally, and host-granular across hosts via one
+    bounded gather of each host's fingerprint + local vote summary —
+    every host derives the SAME verdict from the same rows, so every
+    host raises in the same protocol round); raw param/optimizer
+    shard bytes are fetched only AFTER a mismatch, to localize the
+    corrupt device.  A detection rides the divergence rollback; the
+    bit-exact replay is the oracle that GRADES it — a clean re-check
+    is a transient flip (continue, params repaired bit-identically),
+    the same LOCALIZED replica diverging again is a persistently bad
+    chip: quarantine (marker +
     :data:`~tpudp.sdc.SDC_QUARANTINE_EXIT`) and reduced-geometry
-    relaunch through the elastic verified restore.
+    relaunch through the elastic verified restore.  An unlocalizable
+    detection (2-replica tie) never quarantines — the rollback budget
+    bounds it.
 
 Every recovery is a typed event in ``trainer.stats["events"]`` with
 counters (``rollbacks`` / ``step_retries`` / ``ckpt_fallbacks`` /
@@ -365,15 +371,31 @@ class Supervisor:
         adds no new hot-path sync.  Cadence-gated by
         ``policy.sdc_check_every`` (None: immediate no-op).
 
-        A check majority-votes the per-replica state bytes: shard
-        groups locally (:func:`tpudp.sdc.vote_shard_groups` — correct
-        under PP x DP, where only same-stage copies are comparable) and
-        the in-step ``sdc_fp`` checksum across hosts (bounded gather,
-        the vote layer's timeout discipline).  On mismatch the minority
-        replica is recorded and :class:`~tpudp.sdc.SdcDetected` rides
-        the divergence rollback; the post-replay re-check grades it —
-        clean means transient (continue), the same replica again means
-        persistent (:meth:`_sdc_quarantine`)."""
+        Detection reads ONLY the in-step checksum: each device's
+        ``sdc_fp`` shard is the fingerprint THAT device computed over
+        its own params/optimizer bytes, so voting the (2,)-u32 shards
+        (:func:`tpudp.sdc.vote_fp_shards`) convicts a divergent
+        replica without fetching one raw parameter byte — the
+        zero-new-host-syncs contract holds at any model size.  The
+        raw-byte walk (:func:`tpudp.sdc.vote_shard_groups`) runs only
+        AFTER a mismatch, to localize the corrupt device under layouts
+        where the fp vote names a whole pipeline column.
+
+        Multi-host, the verdict is made GLOBAL before anyone raises:
+        every host contributes its device-0 fingerprint plus its local
+        vote summary to ONE bounded gather, every host derives the
+        same minority set (host-granular ``p<i>`` keys) from the same
+        rows, and every host raises :class:`~tpudp.sdc.SdcDetected` in
+        the same protocol round — a host raising alone on a local-only
+        verdict would leave its peers wedged inside the next step
+        collective, breaking the every-host-votes-each-round
+        invariant.  The post-replay re-check grades a detection: clean
+        means transient (continue), the same LOCALIZED culprit again
+        means persistent (:meth:`_sdc_quarantine`).  An unlocalizable
+        detection (2-replica disagreement, tie votes) NEVER
+        quarantines, however often it recurs — it keeps riding the
+        divergence rollback, whose ``max_rollbacks`` budget bounds it
+        and escalates with the original :class:`SdcDetected`."""
         every = self.policy.sdc_check_every
         if every is None:
             return
@@ -382,18 +404,36 @@ class Supervisor:
             return
         self._sdc_last_check = gstep
         self.trainer.stats["sdc_checks"] += 1
-        from tpudp.sdc import SdcDetected, localize_minority, \
-            vote_shard_groups
+        import numpy as np
 
-        minority, majority = vote_shard_groups(
-            {"params": state.params, "opt_state": state.opt_state,
-             "sdc_fp": state.sdc_fp})
+        from tpudp.sdc import (SdcDetected, localize_minority,
+                               vote_fp_shards, vote_shard_groups)
+
+        minority, majority = vote_fp_shards(state.sdc_fp)
+        localized = bool(majority)
+        if minority:
+            # Localization only: the corrupt device's raw shard bytes
+            # are fetched AFTER the checksum vote proved a mismatch,
+            # never on the clean-path cadence.
+            d_min, d_maj = vote_shard_groups(
+                {"params": state.params, "opt_state": state.opt_state})
+            if d_min and d_maj:
+                minority, majority = sorted(d_min), sorted(d_maj)
+                localized = True
+        devices = list(minority)
         if self._multihost:
-            host_fps = {f"p{i}": v for i, v in
-                        enumerate(self._sdc_gather(self._fetch_fp(state)))}
+            rows = self._sdc_gather(np.concatenate([
+                np.asarray(self._fetch_fp(state), np.uint64),
+                np.array([len(minority),
+                          int(localized or not minority)], np.uint64)]))
+            host_fps = {f"p{i}": r[:2] for i, r in enumerate(rows)}
             h_min, h_maj = localize_minority(host_fps)
-            minority = sorted(set(minority) | set(h_min))
-            majority = sorted(set(majority) | set(h_maj))
+            flagged = {f"p{i}" for i, r in enumerate(rows) if int(r[2])}
+            minority = sorted(set(h_min) | flagged)
+            localized = bool(minority) and all(
+                int(r[3]) for r in rows) and (not h_min or bool(h_maj))
+            majority = (sorted({f"p{i}" for i in range(len(rows))}
+                               - set(minority)) if localized else [])
         pending = self._sdc_pending
         if not minority:
             if pending is not None and gstep >= pending["step"]:
@@ -413,16 +453,19 @@ class Supervisor:
             return
         self.trainer.stats["sdc_detections"] += 1
         self.record("sdc_detected", replicas=minority, step=gstep,
-                    epoch=epoch, it=it, localized=bool(majority))
-        if pending is not None and set(minority) & set(pending["minority"]):
-            self._sdc_quarantine(minority, gstep)  # raises / exits
-        self._sdc_pending = {"minority": minority, "step": gstep}
-        named = (f"minority replica(s) {minority}" if majority
+                    epoch=epoch, it=it, localized=localized,
+                    devices=devices)
+        if (pending is not None and localized and pending["localized"]
+                and set(minority) & set(pending["minority"])):
+            self._sdc_quarantine(minority, gstep, devices)  # raises/exits
+        self._sdc_pending = {"minority": minority, "step": gstep,
+                             "localized": localized}
+        named = (f"minority replica(s) {minority}" if localized
                  else f"replicas disagree ({minority}) with no strict "
                       "majority — corruption proven, culprit unnamed")
         raise SdcDetected(
             f"silent data corruption at step {gstep}: {named}",
-            step=gstep, replica=minority if majority else None)
+            step=gstep, replica=minority if localized else None)
 
     @staticmethod
     def _fetch_fp(state):
@@ -436,12 +479,15 @@ class Supervisor:
         return np.asarray(state.sdc_fp)
 
     def _sdc_gather(self, fp):
-        """Bounded cross-host exchange of the in-step fingerprint —
-        the same timeout discipline as :meth:`_vote`: every host
-        reaches this gather at the same checked step (the check cadence
-        is a pure function of the replicated ``state.step``), and a
-        host whose peers never join hard-exits for the scheduler
-        instead of hanging the rendezvous."""
+        """Bounded cross-host exchange of this host's check record —
+        ``[fp_checksum, fp_count, local_minority_count,
+        local_localized]`` — with the same timeout discipline as
+        :meth:`_vote`: every host reaches this gather at the same
+        checked step (the check cadence is a pure function of the
+        replicated ``state.step``), every host derives the verdict
+        from the same gathered rows, and a host whose peers never
+        join hard-exits for the scheduler instead of hanging the
+        rendezvous."""
         import threading
 
         import numpy as np
@@ -482,18 +528,21 @@ class Supervisor:
             os._exit(VOTE_TIMEOUT_EXIT)
         return result["fps"]
 
-    def _sdc_quarantine(self, minority, gstep: int) -> None:
-        """The persistent verdict: the same replica diverged again
-        after a bit-exact replay, so the chip — not a cosmic ray — is
-        at fault.  Record + flight-dump, write the on-disk marker
-        naming the replica(s) (the relaunch harness reads it to shrink
-        the geometry), then hard-exit the owning host with
-        :data:`~tpudp.sdc.SDC_QUARANTINE_EXIT` (multi-host) or raise
-        :class:`~tpudp.sdc.SdcPersistentError` (single-host / healthy
-        hosts — whose crash sends them to the reduced-geometry relaunch
-        alongside the quarantined peer).  The verdict is computed from
-        identically-gathered fingerprints, so every host grades the
-        same round the same way."""
+    def _sdc_quarantine(self, minority, gstep: int, devices=None) -> None:
+        """The persistent verdict: the same LOCALIZED replica diverged
+        again after a bit-exact replay, so the chip — not a cosmic ray
+        — is at fault (unlocalizable detections never reach here: with
+        no named culprit there is nothing safe to quarantine, and the
+        rollback budget bounds them instead).  Record + flight-dump,
+        write the on-disk marker naming the replica(s) (the relaunch
+        harness reads it to shrink the geometry; ``devices`` adds this
+        host's device-level detail), then hard-exit the owning host
+        with :data:`~tpudp.sdc.SDC_QUARANTINE_EXIT` (multi-host) or
+        raise :class:`~tpudp.sdc.SdcPersistentError` (single-host /
+        healthy hosts — whose crash sends them to the reduced-geometry
+        relaunch alongside the quarantined peer).  The verdict is
+        derived from identically-gathered check records, so every host
+        grades the same round the same way."""
         import json
 
         import jax
@@ -510,6 +559,7 @@ class Supervisor:
                                   QUARANTINE_MARKER)
             with open(marker, "w") as f:
                 json.dump({"replicas": minority, "step": gstep,
+                           "devices": sorted(devices or []),
                            "host": proc}, f)
         t.log(f"[tpudp] resilience: SDC on replica(s) {minority} recurred "
               f"after a bit-exact replay (step {gstep}) — persistent bad "

@@ -33,14 +33,20 @@ canary_every_s=...)``):
     ZERO new host syncs.  Bit-exact by construction: float sums would
     round a low-mantissa flip away in a large model; an integer
     checksum of the bit pattern cannot.
-  * :func:`replica_fingerprints` / :func:`localize_minority` — the
-    host-side localization half: per-replica checksums from the actual
-    addressable shard BYTES (the same shard-level view
-    ``tpudp/utils/consistency.py`` compares), majority-voted to name
-    the minority replica.  Works under plain DP (params replicated per
-    device) and the PP schedule's ZeRO-1 layout (params all-gathered
-    each step; the 1/DP-sharded optimizer state is excluded exactly
-    like ``fingerprint()`` excludes it, with checkpoint shard manifests
+  * :func:`vote_fp_shards` — the CHEAP detection half: each device's
+    shard of the logically-replicated ``sdc_fp`` leaf is the checksum
+    THAT device computed over its own bytes, so majority-voting the
+    (2,)-u32 shards names a divergent replica while moving ~8 bytes
+    per device — never the model.  :func:`replica_fingerprints` /
+    :func:`vote_shard_groups` / :func:`localize_minority` are the
+    raw-BYTE localization half, run only AFTER a checksum mismatch:
+    per-replica checksums from the actual addressable shard bytes (the
+    same shard-level view ``tpudp/utils/consistency.py`` compares),
+    majority-voted per replication group to name the corrupt device.
+    Works under plain DP (params replicated per device) and the PP
+    schedule's ZeRO-1 layout (params all-gathered each step; the
+    1/DP-sharded optimizer state is excluded exactly like
+    ``fingerprint()`` excludes it, with checkpoint shard manifests
     covering those bytes instead).
   * :class:`BitFlipParams` / :class:`BitFlipGrads` — deterministic
     injectors with a ``(step, replica, bit)`` schedule, driving the
@@ -267,6 +273,31 @@ def vote_shard_groups(tree) -> tuple[list, list]:
     return sorted(minority), sorted(majority)
 
 
+def vote_fp_shards(fp_leaf) -> tuple[list, list]:
+    """Majority-vote the per-device shards of the in-step ``sdc_fp``
+    leaf — the cheap DETECTION path.  Each device's shard of the
+    logically-replicated fingerprint is the checksum THAT device
+    computed over its own params/optimizer bytes inside the step
+    (under the PP schedule the pipe-axis psum makes it the pipeline
+    total, still replicated across healthy DP columns), so healthy
+    replicas hold bit-identical shards and a corrupt replica's shard
+    stands out.  Voting these fetches ~8 bytes per device instead of
+    the model: the raw-byte walk (:func:`vote_shard_groups`) is
+    reserved for localizing AFTER a mismatch.  Returns
+    ``(minority_keys, majority_keys)`` over ``"p<process>/d<device>"``
+    keys; fewer than two local shards yields no vote (the cross-host
+    fingerprint exchange covers single-device hosts)."""
+    import jax
+
+    proc = jax.process_index()
+    shards = getattr(fp_leaf, "addressable_shards", None)
+    if not shards or len(shards) < 2:
+        return [], []
+    fps = {f"p{proc}/d{getattr(s.device, 'id', s.device)}":
+           np.asarray(s.data) for s in shards}
+    return localize_minority(fps)
+
+
 def localize_minority(fps: dict) -> tuple[list, list]:
     """Majority vote over replica fingerprints: returns
     ``(minority_keys, majority_keys)``.  Empty minority = all replicas
@@ -339,19 +370,19 @@ def flip_bit_on_replica(leaf, replica: int, bit: int):
         a = np.array(s.data)  # owning copy
         if i == replica:
             flat = a.reshape(-1)
-            view = _np_bits_u32(flat[:1].copy())
-            word = int(view[0]) ^ (1 << (bit % 32))
             nbytes = a.dtype.itemsize
-            if nbytes == 4:
-                flat[0:1] = np.array([word], np.uint32).view(a.dtype)
-            elif nbytes == 2:
-                flat[0:1] = np.array([word & 0xFFFF],
-                                     np.uint16).view(a.dtype)
-            elif nbytes == 1:
-                flat[0:1] = np.array([word & 0xFF], np.uint8).view(a.dtype)
-            else:  # 8-byte: flip within the low word
+            if nbytes >= 8:
                 v = flat[:1].copy().view(np.uint64)
                 flat[0:1] = (v ^ np.uint64(1 << (bit % 64))).view(a.dtype)
+            else:
+                # Reduce the bit index to the dtype's OWN width: an
+                # out-of-range index must wrap to a real bit, never
+                # silently no-op above the word while the injector
+                # records the flip as fired.
+                view = _np_bits_u32(flat[:1].copy())
+                word = int(view[0]) ^ (1 << (bit % (8 * nbytes)))
+                store = {1: np.uint8, 2: np.uint16, 4: np.uint32}[nbytes]
+                flat[0:1] = np.array([word], store).view(a.dtype)
             a = flat.reshape(a.shape)
         bufs.append(jax.device_put(a, s.device))
     if len(shards) == 1:

@@ -846,19 +846,20 @@ class DisaggCluster:
         also callable directly).  Unlike :meth:`kill_host` the host
         process is still running — but its chips are SUSPECT, so
         nothing it could export is trusted: tickets are rebuilt from
-        the cluster's own view of each stream (committed tokens + the
-        per-slot PRNG chain, read the same way the failover journal
-        reads them) with NO pages, and receivers re-prefill — which is
-        deterministic, so the continuation is bit-exact for greedy and
-        sampled requests alike (the chain is the sampler's whole
-        state).  The quarantined engine keeps its wreckage: it stopped
+        the cluster's own failover-journal snapshot of each stream
+        (committed tokens + the per-slot PRNG chain as of the last
+        clean tick — the journal refreshes AFTER the evacuation check,
+        so the snapshot predates the condemning step) with NO pages,
+        and receivers re-prefill — which is deterministic, so the
+        continuation is bit-exact for greedy and sampled requests
+        alike (the chain is the sampler's whole state).  The
+        quarantined engine keeps its wreckage: it stopped
         emitting the moment the canary mismatched, and it stays out of
         :meth:`decode_ranks` so nothing new lands on it."""
         h = self.hosts[rank]
         if rank in self.quarantined or not h.alive:
             return []
         self.quarantined.add(rank)
-        eng = h.engine
         survivors = [k for k in self.decode_ranks() if k != rank]
         if not survivors and rank != self.prefill:
             survivors = [self.prefill]
@@ -871,22 +872,17 @@ class DisaggCluster:
         for i, creq in enumerate(
                 sorted(orphans, key=lambda c: c.handle.id)):
             r = creq.handle
-            if r._slot is not None and eng._slots[r._slot] is r:
-                key = np.asarray(eng._keys[r._slot])
-            else:
-                key = r._resume_key
+            tokens, key, migs, preempts, dp, da = creq.snap
             dest = survivors[i % len(survivors)]
             ticket = MigrationTicket(
                 rid=r.id, model=r._ms.name, prompt=creq.prompt,
-                tokens=tuple(int(t) for t in r.tokens),
+                tokens=tuple(int(t) for t in tokens),
                 max_new_tokens=r.max_new_tokens,
                 temperature=r.temperature, top_k=r.top_k,
                 top_p=r.top_p, seed=r.seed, eos_id=r.eos_id,
                 deadline_s=None, tenant=r.tenant,
-                migrations=r.migrations + 1,
-                preemptions=r.preemptions,
-                draft_proposed=r.draft_proposed,
-                draft_accepted=r.draft_accepted,
+                migrations=migs + 1, preemptions=preempts,
+                draft_proposed=dp, draft_accepted=da,
                 resume_key=key, page_tokens=0, pages=())
             deng = self.hosts[dest].engine
             r2 = deng.admit_ticket(ticket)
